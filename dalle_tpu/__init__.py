@@ -1,0 +1,23 @@
+"""dalle_tpu — a TPU-native collaborative DALL-E training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+learning-at-home/dalle (NeurIPS-2021 "Training Transformers Together"):
+the DALL-E model with its attention zoo and weight sharing, swarm-synchronous
+collaborative optimization over a DHT with compressed butterfly all-reduce,
+8-bit block-quantized LAMB, and elastic fault-tolerant peers — with intra-peer
+parallelism as sharded ``jit`` collectives over a device mesh instead of the
+reference's torch_xla multiprocess machinery.
+"""
+
+__version__ = "0.1.0"
+
+from dalle_tpu.config import (  # noqa: F401
+    AuxConfig,
+    CollabConfig,
+    ModelConfig,
+    OptimizerConfig,
+    PeerConfig,
+    TrainerConfig,
+    flagship_model_config,
+    tiny_model_config,
+)

@@ -1,0 +1,209 @@
+"""Configuration dataclasses for the TPU-native collaborative DALL-E trainer.
+
+Mirrors the reference's three-axis config split (model/trainer || swarm ||
+peer-role) from ``arguments.py:8-165`` of learning-at-home/dalle, redesigned
+for a JAX/XLA stack: model shape lives in :class:`ModelConfig` (reference
+hard-codes it in ``task.py:62-83``), optimizer hyperparameters in
+:class:`OptimizerConfig` (reference ``arguments.py:18-27``), collaboration
+behavior in :class:`CollabConfig` (reference ``arguments.py:60-78``) and peer
+identity/networking in :class:`PeerConfig` (reference ``arguments.py:81-137``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+# Attention layer kinds supported by the attention zoo (reference
+# ``task.py:63-64`` selects from dalle-pytorch's attn_types).
+ATTN_FULL = "full"
+ATTN_AXIAL_ROW = "axial_row"
+ATTN_AXIAL_COL = "axial_col"
+ATTN_CONV_LIKE = "conv_like"
+
+VALID_ATTN_TYPES = (ATTN_FULL, ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_CONV_LIKE)
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """DALL-E transformer shape.
+
+    Defaults reproduce the reference's flagship configuration
+    (``task.py:62-83``): dim 1024, depth 64 with 4 weight-shared unique
+    blocks cycling ``axial_row, axial_col, axial_row, axial_row`` plus a
+    final distinct ``conv_like`` block, 16 heads x 64 head dim, rotary
+    embeddings, tied input/output embeddings, text 256 + image 32x32 tokens.
+    """
+
+    vocab_text: int = 32100          # T5 tokenizer vocab (task.py:58, 32100)
+    vocab_image: int = 8192          # VQGAN f8 Gumbel codebook (task.py:26-32)
+    text_seq_len: int = 256          # arguments.py:15
+    image_grid: int = 32             # 256px / f8 VQGAN -> 32x32 codes
+    dim: int = 1024
+    depth: int = 64
+    heads: int = 16
+    head_dim: int = 64
+    ff_mult: int = 4
+    # Attention types cycled over the unique shared blocks (task.py:63-64).
+    attn_types: Tuple[str, ...] = (
+        ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_AXIAL_ROW, ATTN_AXIAL_ROW)
+    # Number of unique weight-shared blocks the depth cycles through
+    # (task.py:65,78-79: shared_attn_ids/shared_ff_ids cycle(0,1,2,3)).
+    # 0 disables sharing (every layer owns parameters).
+    shared_block_cycle: int = 4
+    # Whether the final layer is a distinct conv_like block with its own
+    # parameters ('w_conv' shared id in task.py:65).
+    final_conv_block: bool = True
+    conv_kernel: int = 11            # local window size for conv_like attn
+    rotary: bool = True              # task.py:80
+    tied_embeddings: bool = True     # share_input_output_emb, task.py:82
+    dropout: float = 0.0             # ff_dropout/attn_dropout = 0 (task.py:76-77)
+    loss_img_weight: float = 7.0     # dalle-pytorch default weighting
+    # Memory saving: jax.checkpoint (remat) replaces the reference's
+    # reversible layers (task.py:81) with the XLA-idiomatic equivalent.
+    remat: bool = True
+    dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
+    param_dtype: str = "float32"
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_grid * self.image_grid
+
+    @property
+    def total_seq_len(self) -> int:
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def vocab_total(self) -> int:
+        return self.vocab_text + self.vocab_image
+
+    def layer_schedule(self) -> Tuple[Tuple[int, str], ...]:
+        """(unique_block_id, attn_type) per layer.
+
+        Layers cycle through ``shared_block_cycle`` unique blocks; if
+        ``final_conv_block`` the last layer is a standalone conv block with
+        block id -1 (reference 'w_conv', task.py:65).
+        """
+        sched = []
+        body = self.depth - (1 if self.final_conv_block else 0)
+        cycle = self.shared_block_cycle or body
+        for i in range(body):
+            uid = i % cycle
+            sched.append((uid, self.attn_types[uid % len(self.attn_types)]))
+        if self.final_conv_block:
+            sched.append((-1, ATTN_CONV_LIKE))
+        return tuple(sched)
+
+    def validate(self) -> None:
+        for t in self.attn_types:
+            if t not in VALID_ATTN_TYPES:
+                raise ValueError(f"unknown attention type {t!r}")
+        if self.dim != self.heads * self.head_dim:
+            raise ValueError("dim must equal heads * head_dim")
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """LAMB hyperparameters (reference ``arguments.py:18-27``)."""
+
+    learning_rate: float = 2.5e-3
+    warmup_steps: int = 3125
+    total_steps: int = 31250
+    beta1: float = 0.9
+    beta2: float = 0.96
+    eps: float = 1e-6
+    weight_decay: float = 0.045
+    max_grad_norm: float = 4.0        # global clip inside LAMB (lamb_8bit.py:84-88)
+    clamp_value: float = 10000.0      # weight-norm clamp in trust ratio (lamb_8bit.py:149-158)
+    # 8-bit block-quantized moments (lamb_8bit.py); "fp32" uses dense state.
+    state_bits: int = 8
+    block_size: int = 4096            # quantization block (lamb_8bit.py:49)
+    min_8bit_size: int = 65536        # fp32 fallback below this (lamb_8bit.py:49,103)
+    # Reference offloads optimizer state to host (offload.py, task.py:130);
+    # on TPU the idiomatic default is sharded on-device state.
+    offload: bool = False
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    """Local training-loop knobs (reference ``arguments.py:8-56``)."""
+
+    per_device_batch: int = 2         # arguments.py:12-14
+    grad_accum_steps: int = 1
+    seed: int = 0
+    text_pad_id: int = 1              # T5 pad token (=eos in reference, task.py:58-59)
+    # Mesh axis sizes; -1 means "use all remaining devices" on the dp axis.
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1                       # sequence parallelism (ring attention)
+
+    @property
+    def local_batch_per_step(self) -> int:
+        return self.per_device_batch * self.grad_accum_steps
+
+
+@dataclass(frozen=True)
+class CollabConfig:
+    """Swarm-wide optimizer semantics (reference ``arguments.py:60-78``)."""
+
+    run_id: str = "dalle-tpu"
+    target_batch_size: int = 4096     # arguments.py:62-65
+    matchmaking_time: float = 15.0    # arguments.py:66-68
+    allreduce_timeout: float = 60.0   # arguments.py:69-71
+    averaging_timeout: float = 180.0  # arguments.py:72-74
+    # Average params+opt state with peers every N epochs to bound drift.
+    average_state_every: int = 1
+    # Compression: tensors with <= threshold elems -> fp16, else uniform 8-bit
+    # (SizeAdaptiveCompression(threshold=2**16+1, ...), task.py:125-126).
+    size_adaptive_threshold: int = 2 ** 16 + 1
+    grad_compression: str = "size_adaptive"
+    state_compression: str = "size_adaptive"
+    delay_optimizer_step: bool = True  # task.py:129
+    reuse_grad_buffers: bool = True    # task.py:133
+    metrics_expiration: float = 600.0  # statistics_expiration, arguments.py:129-131
+
+
+@dataclass(frozen=True)
+class PeerConfig:
+    """Peer identity and networking (reference ``arguments.py:81-137``)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 = ephemeral, like /ip4/0.0.0.0/tcp/0
+    initial_peers: Tuple[str, ...] = ()
+    client_mode: bool = False          # outbound-only peers (arguments.py:89-92)
+    identity_path: Optional[str] = None  # persisted keypair (arguments.py:118-124)
+    experiment_prefix: str = "dalle-tpu"
+    statistics_expiration: float = 600.0
+
+
+@dataclass(frozen=True)
+class AuxConfig:
+    """Aux (monitor/checkpoint) peer knobs (reference ``arguments.py:140-165``)."""
+
+    refresh_period: float = 10.0       # arguments.py:146
+    checkpoint_dir: Optional[str] = None
+    upload_interval: Optional[float] = None
+    store_checkpoints: bool = True
+    assist_in_averaging: bool = False
+
+
+def tiny_model_config(**overrides: Any) -> ModelConfig:
+    """CPU-smoke configuration (BASELINE.json config 1: 12L d512 full attn)."""
+    base = dict(
+        vocab_text=128, vocab_image=64, text_seq_len=16, image_grid=4,
+        dim=64, depth=4, heads=4, head_dim=16, shared_block_cycle=0,
+        final_conv_block=False, attn_types=(ATTN_FULL,), rotary=True,
+        dtype="float32", remat=False,
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def flagship_model_config(**overrides: Any) -> ModelConfig:
+    """The 1.3B flagship (reference task.py:62-83 shape)."""
+    cfg = ModelConfig()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg

@@ -1,0 +1,54 @@
+"""Synthetic VQGAN-code dataset for offline development and tests.
+
+The reference trains on pre-encoded VQGAN f8 codes streamed from
+``laion/laion_100m_vqgan_f8`` (``data.py:11-47``); this module generates
+batches with the same schema — caption token ids + int image codes — with a
+*learnable* deterministic caption->codes mapping so loss curves are
+meaningful without the real dataset. The real streaming reader (shard files,
+filters, tokenizer) lives in :mod:`dalle_tpu.data.dataset`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from dalle_tpu.config import ModelConfig
+
+
+class SyntheticCodes:
+    """num_samples fixed (caption, codes) pairs; codes derive from caption."""
+
+    def __init__(self, cfg: ModelConfig, num_samples: int = 64,
+                 seed: int = 0):
+        self.cfg = cfg
+        rng = np.random.default_rng(seed)
+        n = num_samples
+        self.text = rng.integers(
+            2, cfg.vocab_text, size=(n, cfg.text_seq_len), dtype=np.int32)
+        # codes = cheap deterministic function of the caption so the mapping
+        # is learnable: code[j] = (a*j + b) % vocab_image with (a, b) from
+        # the first caption tokens.
+        a = self.text[:, 0] % 7 + 1
+        b = self.text[:, 1]
+        j = np.arange(cfg.image_seq_len)
+        self.image = ((a[:, None] * j[None, :] + b[:, None])
+                      % cfg.vocab_image).astype(np.int32)
+
+    def __len__(self) -> int:
+        return self.text.shape[0]
+
+    def batches(self, batch_size: int, seed: int = 0,
+                loop: bool = True) -> Iterator[Dict[str, np.ndarray]]:
+        """Shuffled batches; per-peer `seed` mirrors the reference's
+        per-peer data seeding (hf_trainer.py:30-33)."""
+        rng = np.random.default_rng(seed)
+        n = len(self)
+        while True:
+            order = rng.permutation(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                idx = order[i: i + batch_size]
+                yield {"text": self.text[idx], "image": self.image[idx]}
+            if not loop:
+                return

@@ -1,0 +1,2 @@
+from dalle_tpu.models.dalle import DALLE, init_params, param_count  # noqa: F401
+from dalle_tpu.models.transformer import Transformer, TransformerBlock  # noqa: F401

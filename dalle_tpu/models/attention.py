@@ -1,0 +1,237 @@
+"""The DALL-E attention zoo, TPU-first.
+
+The reference model selects per-layer attention types from dalle-pytorch's
+zoo — ``full``, ``axial_row``, ``axial_col``, ``conv_like`` (configured at
+``task.py:63-64`` of learning-at-home/dalle). Semantics implemented here:
+
+- text tokens attend causally to text tokens only (except ``full``, where the
+  whole sequence is plain-causal — equivalent for text positions anyway);
+- image token (r, c) attends to ALL text tokens plus, depending on the type:
+  * ``full``       — every earlier image token (plain causal),
+  * ``axial_row``  — image tokens in the same row with column <= c,
+  * ``axial_col``  — image tokens in the same column with row <= r,
+  * ``conv_like``  — image tokens inside a k x k window around (r, c) that
+                     precede it in raster order (inclusive).
+
+Two implementations are provided:
+
+1. :func:`dense_zoo_attention` — one dense attention with a static (T, T)
+   boolean mask from :func:`zoo_attention_mask`. Used for ``full`` and
+   ``conv_like`` layers, for autoregressive decoding with a KV cache, and as
+   the correctness oracle in tests.
+2. :func:`axial_attention` — the batched axial fast path: rows (or columns)
+   become a batch axis so the attention score matrix is (C, text+C) instead
+   of (T, T); ~4.5x fewer attention FLOPs at the flagship shape.
+
+All matmuls accumulate in float32 (``preferred_element_type``) and softmax
+runs in float32, with activations in bfloat16 for the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.config import (
+    ATTN_AXIAL_COL,
+    ATTN_AXIAL_ROW,
+    ATTN_CONV_LIKE,
+    ATTN_FULL,
+)
+
+NEG_INF = -1e9  # softmax mask fill; safe in fp32 accumulation
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings (reference: rotary_emb=True, task.py:80)
+# ---------------------------------------------------------------------------
+
+def rotary_cos_sin(positions: jax.Array, head_dim: int,
+                   base: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given absolute positions, shape (..., head_dim)."""
+    half = head_dim // 2
+    freqs = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., half)
+    angles = jnp.concatenate([angles, angles], axis=-1)        # (..., head_dim)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply rotary embedding. x: (..., T, H, d); cos/sin: (T, d) or (..., T, d)."""
+    if cos.ndim < x.ndim:  # insert the heads axis for broadcasting
+        cos = cos[..., :, None, :]
+        sin = sin[..., :, None, :]
+    xf = x.astype(jnp.float32)
+    out = xf * cos + _rotate_half(xf) * sin
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Static masks (oracle + decode path)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def zoo_attention_mask(attn_type: str, text_len: int, grid: int,
+                       conv_kernel: int = 11) -> np.ndarray:
+    """Boolean (T, T) mask, True = may attend. T = text_len + grid*grid.
+
+    Encodes the per-type sparsity patterns described in the module docstring;
+    the dense-mask equivalent of dalle-pytorch's sparse attention classes.
+    """
+    img_len = grid * grid
+    total = text_len + img_len
+    idx = np.arange(total)
+    causal = idx[None, :] <= idx[:, None]
+
+    mask = np.zeros((total, total), dtype=bool)
+    # Text queries: causal over text only (identical to plain causal since
+    # nothing precedes the text block).
+    mask[:text_len, :text_len] = causal[:text_len, :text_len]
+
+    qi = np.arange(img_len)
+    qr, qc = qi // grid, qi % grid
+    ki = np.arange(img_len)
+    kr, kc = ki // grid, ki % grid
+
+    # Image queries attend to all text.
+    mask[text_len:, :text_len] = True
+
+    if attn_type == ATTN_FULL:
+        img_img = ki[None, :] <= qi[:, None]
+    elif attn_type == ATTN_AXIAL_ROW:
+        img_img = (kr[None, :] == qr[:, None]) & (kc[None, :] <= qc[:, None])
+    elif attn_type == ATTN_AXIAL_COL:
+        img_img = (kc[None, :] == qc[:, None]) & (kr[None, :] <= qr[:, None])
+    elif attn_type == ATTN_CONV_LIKE:
+        hw = conv_kernel // 2
+        window = (np.abs(kr[None, :] - qr[:, None]) <= hw) & \
+                 (np.abs(kc[None, :] - qc[:, None]) <= hw)
+        img_img = window & (ki[None, :] <= qi[:, None])
+    else:
+        raise ValueError(f"unknown attention type {attn_type!r}")
+
+    mask[text_len:, text_len:] = img_img
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Dense masked attention
+# ---------------------------------------------------------------------------
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: jax.Array) -> jax.Array:
+    """Masked multi-head attention.
+
+    q: (B, Tq, H, d), k/v: (B, Tk, H, d), mask: broadcastable to (Tq, Tk)
+    or (B, 1, Tq, Tk). Returns (B, Tq, H, d) in q.dtype.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask.ndim == 2:
+        mask = mask[None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def dense_zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        attn_type: str, text_len: int, grid: int,
+                        conv_kernel: int = 11) -> jax.Array:
+    mask = jnp.asarray(zoo_attention_mask(attn_type, text_len, grid,
+                                          conv_kernel))
+    return dense_attention(q, k, v, mask)
+
+
+# ---------------------------------------------------------------------------
+# Batched axial fast path
+# ---------------------------------------------------------------------------
+
+def _text_causal(q_t: jax.Array, k_t: jax.Array, v_t: jax.Array) -> jax.Array:
+    """Causal attention over the text prefix. (B, Tt, H, d) -> same."""
+    text_len = q_t.shape[1]
+    causal = jnp.tril(jnp.ones((text_len, text_len), dtype=bool))
+    return dense_attention(q_t, k_t, v_t, causal)
+
+
+def _axial_lines(q_g: jax.Array, k_g: jax.Array, v_g: jax.Array,
+                 k_t: jax.Array, v_t: jax.Array) -> jax.Array:
+    """Attention of each grid *line* over [all text || causal same-line].
+
+    q_g/k_g/v_g: (B, L, N, H, d) where L = number of lines (rows or cols)
+    and N = tokens per line, causal along N. k_t/v_t: (B, Tt, H, d).
+    Returns (B, L, N, H, d).
+    """
+    scale = q_g.shape[-1] ** -0.5
+    n = q_g.shape[2]
+    # Scores against text: every image token sees all text tokens.
+    s_t = jnp.einsum("blnhd,bshd->blhns", q_g, k_t,
+                     preferred_element_type=jnp.float32) * scale
+    # Scores within the line, causal.
+    s_l = jnp.einsum("blnhd,blmhd->blhnm", q_g, k_g,
+                     preferred_element_type=jnp.float32) * scale
+    line_causal = jnp.tril(jnp.ones((n, n), dtype=bool))
+    s_l = jnp.where(line_causal[None, None, None], s_l, NEG_INF)
+
+    joint = jnp.concatenate([s_t, s_l], axis=-1)
+    probs = jax.nn.softmax(joint, axis=-1)
+    p_t, p_l = probs[..., : s_t.shape[-1]], probs[..., s_t.shape[-1]:]
+    out = jnp.einsum("blhns,bshd->blnhd", p_t.astype(v_t.dtype), v_t,
+                     preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("blhnm,blmhd->blnhd", p_l.astype(v_g.dtype), v_g,
+                           preferred_element_type=jnp.float32)
+    return out.astype(q_g.dtype)
+
+
+def axial_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    attn_type: str, text_len: int, grid: int) -> jax.Array:
+    """Axial row/col attention over [text || image] sequence.
+
+    q/k/v: (B, T, H, d) with T = text_len + grid*grid. The image block is
+    viewed as a (grid, grid) raster; rows (axial_row) or columns (axial_col)
+    become a batch dimension so XLA sees large, regular batched matmuls.
+    """
+    b, t, h, d = q.shape
+    q_t, k_t, v_t = (x[:, :text_len] for x in (q, k, v))
+    out_t = _text_causal(q_t, k_t, v_t)
+
+    def to_grid(x):
+        return x[:, text_len:].reshape(b, grid, grid, h, d)
+
+    q_g, k_g, v_g = to_grid(q), to_grid(k), to_grid(v)
+    if attn_type == ATTN_AXIAL_COL:
+        # Columns become lines: swap the two grid axes; causal index is then
+        # the row index, matching "same column, row <= r".
+        q_g, k_g, v_g = (x.swapaxes(1, 2) for x in (q_g, k_g, v_g))
+
+    out_g = _axial_lines(q_g, k_g, v_g, k_t, v_t)
+
+    if attn_type == ATTN_AXIAL_COL:
+        out_g = out_g.swapaxes(1, 2)
+    out_i = out_g.reshape(b, grid * grid, h, d)
+    return jnp.concatenate([out_t, out_i], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+def zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  attn_type: str, text_len: int, grid: int,
+                  conv_kernel: int = 11) -> jax.Array:
+    """Train-time attention dispatch: fast paths where available."""
+    if attn_type in (ATTN_AXIAL_ROW, ATTN_AXIAL_COL):
+        return axial_attention(q, k, v, attn_type, text_len, grid)
+    return dense_zoo_attention(q, k, v, attn_type, text_len, grid, conv_kernel)

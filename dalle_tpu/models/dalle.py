@@ -1,0 +1,154 @@
+"""The DALL-E text-to-image autoregressive model, TPU-native.
+
+Capability parity with the dalle-pytorch model the reference instantiates at
+``task.py:61-86`` of learning-at-home/dalle: a decoder-only transformer over
+``[text tokens || VQGAN image codes]`` with the attention zoo, weight-shared
+blocks, rotary embeddings, tied input/output embeddings
+(``share_input_output_emb=True``, ``task.py:82``), and the weighted
+text/image cross-entropy loss (dalle-pytorch's ``loss_img_weight``).
+
+Sequence layout. The model scores the unshifted token sequence
+``S = [text_0..text_{Tt-1}, img_0..img_{Ti-1}]``: position ``p`` receives the
+*previous* token's embedding (BOS at p=0) and predicts ``S_p``. Keeping
+positions aligned with token coordinates (rather than physically shifting the
+sequence) lets every attention mask be indexed by the coordinates of the token
+being predicted, which is exactly the causal-validity condition for axial and
+conv-like sparsity.
+
+Vocabulary. One tied table over ``vocab_text + vocab_image (+1 BOS)``; image
+ids are offset by ``vocab_text``. Text positions may only predict text ids and
+image positions only image ids (segment logit masking, as dalle-pytorch does).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.config import ModelConfig
+from dalle_tpu.models.transformer import Transformer
+
+
+class DALLE(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        cfg = self.cfg
+        pdt = jnp.dtype(cfg.param_dtype)
+        emb_init = nn.initializers.normal(stddev=0.02)
+        # +1 row: BOS, input-only (never predicted).
+        self.token_emb = self.param(
+            "token_emb", emb_init, (cfg.vocab_total + 1, cfg.dim), pdt)
+        self.text_pos_emb = self.param(
+            "text_pos_emb", emb_init, (cfg.text_seq_len, cfg.dim), pdt)
+        # Axial (row + col) learned position embedding for the image grid.
+        self.img_row_emb = self.param(
+            "img_row_emb", emb_init, (cfg.image_grid, cfg.dim), pdt)
+        self.img_col_emb = self.param(
+            "img_col_emb", emb_init, (cfg.image_grid, cfg.dim), pdt)
+        self.transformer = Transformer(cfg)
+        if not cfg.tied_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_total, use_bias=False,
+                dtype=jnp.dtype(cfg.dtype), param_dtype=pdt)
+
+    @property
+    def bos_id(self) -> int:
+        return self.cfg.vocab_total
+
+    def combined_ids(self, text_tokens: jax.Array,
+                     image_tokens: jax.Array) -> jax.Array:
+        """[text || image+vocab_text] combined-vocabulary id sequence."""
+        return jnp.concatenate(
+            [text_tokens, image_tokens + self.cfg.vocab_text], axis=1)
+
+    def positional(self) -> jax.Array:
+        """(T, dim) learned positional embedding: text pos + image axial."""
+        cfg = self.cfg
+        img_pos = (self.img_row_emb[:, None, :] +
+                   self.img_col_emb[None, :, :]).reshape(
+                       cfg.image_seq_len, cfg.dim)
+        return jnp.concatenate([self.text_pos_emb, img_pos], axis=0)
+
+    def backbone(self, input_ids: jax.Array) -> jax.Array:
+        """Embed (previous-token) ids, add positions, run the stack.
+
+        input_ids: (B, T) ids in the combined vocabulary (+BOS), already
+        shifted so position p holds the token preceding S_p.
+        """
+        cfg = self.cfg
+        x = jnp.take(self.token_emb, input_ids, axis=0)
+        x = x + self.positional()[None]
+        x = x.astype(jnp.dtype(cfg.dtype))
+        return self.transformer(x)
+
+    def logits_from_hidden(self, h: jax.Array) -> jax.Array:
+        """Tied-embedding head + segment masking, in float32."""
+        cfg = self.cfg
+        if cfg.tied_embeddings:
+            table = self.token_emb[: cfg.vocab_total].astype(h.dtype)
+            logits = jnp.einsum("btd,vd->btv", h, table,
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = self.lm_head(h).astype(jnp.float32)
+        # Text positions predict text ids; image positions image ids.
+        t = h.shape[1]
+        is_text_pos = (jnp.arange(t) < cfg.text_seq_len)[None, :, None]
+        is_text_vocab = (jnp.arange(cfg.vocab_total) < cfg.vocab_text)[
+            None, None, :]
+        valid = jnp.logical_not(jnp.logical_xor(is_text_pos, is_text_vocab))
+        return jnp.where(valid, logits, -1e9)
+
+    def __call__(self, text_tokens: jax.Array, image_tokens: jax.Array,
+                 loss_mask: Optional[jax.Array] = None,
+                 return_logits: bool = False):
+        """Weighted next-token cross-entropy (and optionally logits).
+
+        text_tokens: (B, text_seq_len) int32; image_tokens: (B, image_seq_len)
+        int32 VQGAN codes. loss_mask: optional (B, T) multiplier (e.g. to
+        exclude caption padding).
+        """
+        cfg = self.cfg
+        labels = self.combined_ids(text_tokens, image_tokens)
+        bos = jnp.full((labels.shape[0], 1), self.bos_id, labels.dtype)
+        input_ids = jnp.concatenate([bos, labels[:, :-1]], axis=1)
+
+        h = self.backbone(input_ids)
+        logits = self.logits_from_hidden(h)
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_ll = jnp.take_along_axis(
+            logp, labels[..., None], axis=-1)[..., 0]
+        nll = -token_ll
+        if loss_mask is not None:
+            nll = nll * loss_mask
+            denom_text = jnp.maximum(
+                loss_mask[:, : cfg.text_seq_len].sum(), 1.0)
+            denom_img = jnp.maximum(
+                loss_mask[:, cfg.text_seq_len:].sum(), 1.0)
+        else:
+            denom_text = nll.shape[0] * cfg.text_seq_len
+            denom_img = nll.shape[0] * cfg.image_seq_len
+        loss_text = nll[:, : cfg.text_seq_len].sum() / denom_text
+        loss_img = nll[:, cfg.text_seq_len:].sum() / denom_img
+        w = cfg.loss_img_weight
+        loss = (loss_text + w * loss_img) / (1.0 + w)
+        aux = {"loss": loss, "loss_text": loss_text, "loss_img": loss_img}
+        if return_logits:
+            return loss, aux, logits
+        return loss, aux
+
+
+def init_params(model: DALLE, rng: jax.Array,
+                batch: int = 2) -> "flax.core.FrozenDict":
+    cfg = model.cfg
+    text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+    image = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
+    return model.init(rng, text, image)
+
+
+def param_count(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,133 @@
+"""Transformer stack with the reference's weight-sharing scheme.
+
+The reference flagship (``task.py:62-83`` of learning-at-home/dalle) is depth
+64 but only ~5 unique blocks: ``shared_attn_ids``/``shared_ff_ids`` cycle
+``(0, 1, 2, 3)`` over the first 63 layers and the final layer is a distinct
+``'w_conv'`` conv-like block. Weight sharing is expressed here by calling the
+same Flax submodule instance at every layer that shares its id — Flax reuses
+the parameters, XLA sees 64 layer applications reading 5 parameter sets.
+
+Memory: the reference uses reversible residual layers (``reversible=True``,
+``task.py:81``) to get O(1) activation memory; the XLA-idiomatic equivalent is
+rematerialisation — each block is wrapped in ``jax.checkpoint`` via
+``nn.remat`` so backward recomputes activations block by block.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dalle_tpu.config import ModelConfig
+from dalle_tpu.models.attention import (
+    apply_rotary,
+    rotary_cos_sin,
+    zoo_attention,
+)
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def _param_dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.param_dtype)
+
+
+class ZooAttention(nn.Module):
+    """Multi-head attention with a static zoo type (full/axial/conv_like)."""
+
+    cfg: ModelConfig
+    attn_type: str
+
+    @nn.compact
+    def __call__(self, x: jax.Array, rot=None) -> jax.Array:
+        cfg = self.cfg
+        b, t, _ = x.shape
+        qkv = nn.Dense(3 * cfg.dim, use_bias=False, dtype=_dtype(cfg),
+                       param_dtype=_param_dtype(cfg), name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, cfg.heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rot is not None:
+            cos, sin = rot
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        out = zoo_attention(
+            q, k, v, attn_type=self.attn_type, text_len=cfg.text_seq_len,
+            grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
+        out = out.reshape(b, t, cfg.dim)
+        return nn.Dense(cfg.dim, dtype=_dtype(cfg),
+                        param_dtype=_param_dtype(cfg), name="out")(out)
+
+
+class GEGLUFeedForward(nn.Module):
+    """GEGLU MLP (dalle-pytorch's FeedForward uses a GEGLU gate)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        inner = cfg.ff_mult * cfg.dim
+        h = nn.Dense(2 * inner, dtype=_dtype(cfg),
+                     param_dtype=_param_dtype(cfg), name="wi")(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        h = h * nn.gelu(gate)
+        return nn.Dense(cfg.dim, dtype=_dtype(cfg),
+                        param_dtype=_param_dtype(cfg), name="wo")(h)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm attention + GEGLU FF with residuals."""
+
+    cfg: ModelConfig
+    attn_type: str
+
+    @nn.compact
+    def __call__(self, x: jax.Array, rot=None) -> jax.Array:
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+                         name="attn_norm")(x)
+        x = x + ZooAttention(cfg, self.attn_type, name="attn")(h, rot)
+        h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+                         name="ff_norm")(x)
+        x = x + GEGLUFeedForward(cfg, name="ff")(h)
+        return x
+
+
+class Transformer(nn.Module):
+    """The depth-``cfg.depth`` stack following ``cfg.layer_schedule()``.
+
+    Blocks with the same unique id are the same module instance, so their
+    parameters are shared (reference weight sharing, ``task.py:65,78-79``).
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        sched = cfg.layer_schedule()
+
+        rot = None
+        if cfg.rotary:
+            positions = jnp.arange(cfg.total_seq_len)
+            rot = rotary_cos_sin(positions, cfg.head_dim)
+
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(TransformerBlock)
+
+        blocks = {}
+        for uid, attn_type in sched:
+            if uid not in blocks:
+                name = "block_wconv" if uid == -1 else f"block_{uid}"
+                blocks[uid] = block_cls(cfg, attn_type, name=name)
+            x = blocks[uid](x, rot)
+
+        return nn.LayerNorm(dtype=_dtype(cfg),
+                            param_dtype=_param_dtype(cfg),
+                            name="final_norm")(x)

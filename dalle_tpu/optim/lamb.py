@@ -1,0 +1,130 @@
+"""LAMB with built-in global-norm clipping, optax-style.
+
+Numerics follow the reference's optimizer exactly (its fp32 path):
+``lib/training/clipped_lamb.py:5-14`` (LAMB + global clip fused, so the
+collaborative wrapper can bypass external clipping) and
+``lib/training/lamb_8bit.py:84-88,135-158`` (clip before moments; no bias
+correction / debias=False; trust ratio = clamp(||w||, max=clamp_value) /
+||m/(sqrt(v)+eps) + wd*w||, 1.0 where either norm is zero). Weight-decay
+exclusion of bias/LayerNorm parameters (reference ``task.py:144-151``) is a
+``wd_mask`` predicate over parameter paths.
+
+The 8-bit block-quantized variant with identical math but uint8 moment state
+lives in :mod:`dalle_tpu.optim.lamb8bit`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dalle_tpu.config import OptimizerConfig
+
+ScalarOrSchedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+class LambState(NamedTuple):
+    count: jax.Array
+    mu: Any
+    nu: Any
+
+
+def global_norm(tree) -> jax.Array:
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32)))
+              for x in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def default_wd_mask(params) -> Any:
+    """True where weight decay applies: exclude biases and (layer)norm scales
+    (reference task.py:144-151 excludes ["bias", "LayerNorm.weight"])."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out = []
+    for path, _ in flat:
+        keys = [getattr(p, "key", str(p)).lower() for p in path]
+        joined = "/".join(str(k) for k in keys)
+        decay = not ("bias" in joined or "norm" in joined
+                     or "scale" in joined)
+        out.append(decay)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def lamb(learning_rate: ScalarOrSchedule,
+         b1: float = 0.9,
+         b2: float = 0.96,
+         eps: float = 1e-6,
+         weight_decay: float = 0.045,
+         clamp_value: float = 10000.0,
+         max_grad_norm: Optional[float] = 4.0,
+         wd_mask_fn: Callable[[Any], Any] = default_wd_mask,
+         ) -> optax.GradientTransformation:
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return LambState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params))
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("lamb requires params")
+        updates = jax.tree.map(lambda g: g.astype(jnp.float32), updates)
+
+        if max_grad_norm is not None:
+            gnorm = global_norm(updates)
+            scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-12))
+            updates = jax.tree.map(lambda g: g * scale, updates)
+
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                          state.nu, updates)
+
+        lr = learning_rate(state.count) if callable(learning_rate) \
+            else learning_rate
+        wd_mask = wd_mask_fn(params)
+
+        def leaf_update(p, m, v, decay):
+            p32 = p.astype(jnp.float32)
+            adam_step = m / (jnp.sqrt(v) + eps)
+            if weight_decay:
+                adam_step = adam_step + jnp.where(
+                    decay, weight_decay, 0.0) * p32
+            wnorm = jnp.minimum(
+                jnp.sqrt(jnp.sum(p32 * p32)), clamp_value)
+            anorm = jnp.sqrt(jnp.sum(adam_step * adam_step))
+            trust = jnp.where((wnorm > 0) & (anorm > 0),
+                              wnorm / (anorm + 1e-12), 1.0)
+            return (-lr * trust * adam_step).astype(p.dtype)
+
+        new_updates = jax.tree.map(leaf_update, params, mu, nu, wd_mask)
+        return new_updates, LambState(state.count + 1, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make_lr_schedule(cfg: OptimizerConfig) -> Callable[[jax.Array], jax.Array]:
+    """Linear warmup to peak then linear decay to zero (reference uses
+    transformers' linear schedule: warmup 3125 of 31250, task.py:163-165)."""
+    return optax.join_schedules(
+        schedules=[
+            optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps),
+            optax.linear_schedule(
+                cfg.learning_rate, 0.0,
+                max(cfg.total_steps - cfg.warmup_steps, 1)),
+        ],
+        boundaries=[cfg.warmup_steps])
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    """The reference's fp32 optimizer (clipped LAMB + linear schedule)."""
+    return lamb(
+        learning_rate=make_lr_schedule(cfg),
+        b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+        weight_decay=cfg.weight_decay, clamp_value=cfg.clamp_value,
+        max_grad_norm=cfg.max_grad_norm)

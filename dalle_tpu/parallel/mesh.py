@@ -1,0 +1,51 @@
+"""Device mesh construction.
+
+The reference's intra-peer parallelism is 8-way torch_xla data parallelism
+driven by a child process per core (``lib/training/tpu.py:23-231``). Here the
+whole machine is one SPMD program over a ``jax.sharding.Mesh`` with four
+axes — ``dp`` (data), ``fsdp`` (data + parameter sharding), ``tp`` (tensor),
+``sp`` (sequence/ring attention) — and XLA inserts the ICI collectives that
+``xm.all_reduce`` performed by hand in the reference (``tpu.py:181``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+# Batch is sharded over every data-like axis; dp and fsdp both consume
+# examples, so the global batch must divide dp*fsdp.
+BATCH_SPEC = P(("dp", "fsdp"))
+
+
+def make_mesh(dp: int = -1, fsdp: int = 1, tp: int = 1, sp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (dp, fsdp, tp, sp) mesh over the given (default: all) devices.
+
+    ``dp=-1`` absorbs all devices not claimed by the other axes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    rest = fsdp * tp * sp
+    if dp == -1:
+        if n % rest:
+            raise ValueError(f"{n} devices not divisible by fsdp*tp*sp={rest}")
+        dp = n // rest
+    if dp * rest != n:
+        raise ValueError(
+            f"mesh {dp}x{fsdp}x{tp}x{sp} != device count {n}")
+    arr = np.asarray(devices).reshape(dp, fsdp, tp, sp)
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, BATCH_SPEC)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

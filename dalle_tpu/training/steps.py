@@ -1,0 +1,118 @@
+"""Jitted training steps with the collaborative seam.
+
+Three entry points, mirroring the host-loop seam of the reference's TPU path
+(``run_trainer_tpu.py:78-91``: accumulate on device -> hand grads to the
+swarm -> apply the averaged step):
+
+- :func:`make_train_step`     — fused local step (grad + optimizer update);
+  the single-peer / non-collaborative path.
+- :func:`make_grad_step`      — forward/backward only, returns gradients
+  (optionally pre-scaled by sample count) without touching optimizer state;
+  what a peer runs while the swarm accumulates toward ``target_batch_size``.
+- :func:`make_apply_step`     — applies (averaged) gradients via the
+  optimizer; what runs once per swarm epoch.
+
+Gradient accumulation is a ``lax.scan`` over microbatches (the reference
+loops in Python per core, ``lib/training/tpu.py:119-126``). All steps donate
+their state buffers so XLA updates parameters in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(step=jnp.zeros([], jnp.int32), params=params,
+                   opt_state=tx.init(params))
+
+
+def _loss_fn(model, params, batch):
+    loss, aux = model.apply(params, batch["text"], batch["image"],
+                            loss_mask=batch.get("mask"))
+    return loss, aux
+
+
+def _accumulate_grads(model, params, batch, accum_steps: int):
+    """Mean loss/grads over ``accum_steps`` microbatches via lax.scan."""
+    if accum_steps <= 1:
+        (loss, aux), grads = jax.value_and_grad(
+            functools.partial(_loss_fn, model), has_aux=True)(params, batch)
+        return loss, aux, grads
+
+    def split(x):
+        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
+
+    micro = jax.tree.map(split, batch)
+    grad_fn = jax.value_and_grad(
+        functools.partial(_loss_fn, model), has_aux=True)
+
+    def body(carry, mb):
+        g_acc, loss_acc, aux_acc = carry
+        (loss, aux), g = grad_fn(params, mb)
+        g_acc = jax.tree.map(jnp.add, g_acc, g)
+        aux_acc = jax.tree.map(jnp.add, aux_acc, aux)
+        return (g_acc, loss_acc + loss, aux_acc), None
+
+    g0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    aux0 = {"loss": jnp.zeros([], jnp.float32),
+            "loss_text": jnp.zeros([], jnp.float32),
+            "loss_img": jnp.zeros([], jnp.float32)}
+    (grads, loss, aux), _ = jax.lax.scan(
+        body, (g0, jnp.zeros([], jnp.float32), aux0), micro)
+    inv = 1.0 / accum_steps
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    aux = jax.tree.map(lambda a: a * inv, aux)
+    return loss * inv, aux, grads
+
+
+def make_train_step(model, tx: optax.GradientTransformation,
+                    accum_steps: int = 1) -> Callable:
+    """Fused step: state, batch -> new_state, metrics."""
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        loss, aux, grads = _accumulate_grads(
+            model, state.params, batch, accum_steps)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(aux)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state), metrics
+
+    return train_step
+
+
+def make_grad_step(model, accum_steps: int = 1) -> Callable:
+    """Accumulation-only step: (params, batch) -> (grads, metrics)."""
+
+    def grad_step(params, batch):
+        loss, aux, grads = _accumulate_grads(model, params, batch,
+                                             accum_steps)
+        return grads, dict(aux)
+
+    return grad_step
+
+
+def make_apply_step(tx: optax.GradientTransformation) -> Callable:
+    """(state, averaged_grads) -> new_state. The once-per-swarm-epoch step."""
+
+    def apply_step(state: TrainState, grads):
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state)
+
+    return apply_step

@@ -1,0 +1,20 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip sharding semantics are exercised without TPUs by spoofing the
+host platform device count (the strategy SURVEY.md §4 prescribes; the driver
+separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+Must run before jax initializes its backends, hence the env mutation at
+import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

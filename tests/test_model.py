@@ -1,0 +1,229 @@
+"""Core model tests: mask semantics, axial fast path vs dense oracle,
+causality, weight sharing, loss behavior."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import (
+    ATTN_AXIAL_COL,
+    ATTN_AXIAL_ROW,
+    ATTN_CONV_LIKE,
+    ATTN_FULL,
+    ModelConfig,
+    tiny_model_config,
+)
+from dalle_tpu.models.attention import (
+    axial_attention,
+    dense_zoo_attention,
+    zoo_attention_mask,
+)
+from dalle_tpu.models.dalle import DALLE, init_params, param_count
+
+
+TEXT, GRID = 5, 4
+IMG = GRID * GRID
+T = TEXT + IMG
+
+
+def _qkv(key, b=2, h=2, d=8, t=T):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), jnp.float32) for k in ks)
+
+
+class TestMasks:
+    def test_full_is_plain_causal(self):
+        m = zoo_attention_mask(ATTN_FULL, TEXT, GRID)
+        idx = np.arange(T)
+        np.testing.assert_array_equal(m, idx[None, :] <= idx[:, None])
+
+    def test_text_rows_causal_text_only(self):
+        for at in (ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_CONV_LIKE):
+            m = zoo_attention_mask(at, TEXT, GRID)
+            assert not m[:TEXT, TEXT:].any()  # text never sees image
+            sub = m[:TEXT, :TEXT]
+            idx = np.arange(TEXT)
+            np.testing.assert_array_equal(sub, idx[None, :] <= idx[:, None])
+
+    def test_image_sees_all_text(self):
+        for at in (ATTN_FULL, ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_CONV_LIKE):
+            m = zoo_attention_mask(at, TEXT, GRID)
+            assert m[TEXT:, :TEXT].all()
+
+    def test_axial_row_pattern(self):
+        m = zoo_attention_mask(ATTN_AXIAL_ROW, TEXT, GRID)
+        # token (2, 3) attends to (2, 0..3) and nothing else in the image
+        q = TEXT + 2 * GRID + 3
+        ks = np.where(m[q, TEXT:])[0]
+        np.testing.assert_array_equal(ks, 2 * GRID + np.arange(4))
+
+    def test_axial_col_pattern(self):
+        m = zoo_attention_mask(ATTN_AXIAL_COL, TEXT, GRID)
+        q = TEXT + 2 * GRID + 3  # (r=2, c=3)
+        ks = np.where(m[q, TEXT:])[0]
+        np.testing.assert_array_equal(ks, np.array([0, 1, 2]) * GRID + 3)
+
+    def test_conv_like_window_and_causal(self):
+        m = zoo_attention_mask(ATTN_CONV_LIKE, TEXT, GRID, conv_kernel=3)
+        q = TEXT + 2 * GRID + 2  # (2,2), window 3x3 => (1..3, 1..3) causal
+        ks = set(np.where(m[q, TEXT:])[0])
+        expect = set()
+        for r in (1, 2, 3):
+            for c in (1, 2, 3):
+                if r * GRID + c <= 2 * GRID + 2:
+                    expect.add(r * GRID + c)
+        assert ks == expect
+
+    def test_every_query_attends_to_something(self):
+        for at in (ATTN_FULL, ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_CONV_LIKE):
+            m = zoo_attention_mask(at, TEXT, GRID)
+            assert m.any(axis=1).all()
+            assert np.diag(m).all()  # self-attention always allowed
+
+
+class TestAxialFastPath:
+    @pytest.mark.parametrize("at", [ATTN_AXIAL_ROW, ATTN_AXIAL_COL])
+    def test_matches_dense_oracle(self, at):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        fast = axial_attention(q, k, v, at, TEXT, GRID)
+        dense = dense_zoo_attention(q, k, v, at, TEXT, GRID)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestCausality:
+    """Perturbing future tokens must not change earlier predictions."""
+
+    @pytest.mark.parametrize("at", [ATTN_FULL, ATTN_AXIAL_ROW,
+                                    ATTN_AXIAL_COL, ATTN_CONV_LIKE])
+    def test_future_image_token_does_not_leak(self, at):
+        cfg = tiny_model_config(
+            text_seq_len=TEXT, image_grid=GRID, depth=2,
+            attn_types=(at,), conv_kernel=3)
+        model = DALLE(cfg)
+        rng = jax.random.PRNGKey(1)
+        params = init_params(model, rng, batch=1)
+        text = jax.random.randint(rng, (1, TEXT), 0, cfg.vocab_text)
+        img = jax.random.randint(rng, (1, IMG), 0, cfg.vocab_image)
+
+        def logits_fn(image_tokens):
+            _, _, logits = model.apply(params, text, image_tokens,
+                                       return_logits=True)
+            return logits
+
+        base = logits_fn(img)
+        # Flip the LAST image token; logits at every earlier position must be
+        # identical (position p's input only contains tokens < p).
+        img2 = img.at[0, -1].set((img[0, -1] + 1) % cfg.vocab_image)
+        pert = logits_fn(img2)
+        np.testing.assert_allclose(np.asarray(base[:, :-1]),
+                                   np.asarray(pert[:, :-1]),
+                                   atol=1e-5, rtol=1e-5)
+        # Flip the first text token; EVERY later position may change, and the
+        # position predicting text token 0 must not (it only sees BOS).
+        text2 = text.at[0, 0].set((text[0, 0] + 1) % cfg.vocab_text)
+        pert_t = np.asarray(model.apply(params, text2, img,
+                                        return_logits=True)[2])
+        np.testing.assert_allclose(np.asarray(base)[:, 0], pert_t[:, 0],
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestModel:
+    def test_forward_shapes_and_finite(self):
+        cfg = tiny_model_config()
+        model = DALLE(cfg)
+        params = init_params(model, jax.random.PRNGKey(0))
+        text = jnp.zeros((2, cfg.text_seq_len), jnp.int32)
+        img = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+        loss, aux, logits = model.apply(params, text, img, return_logits=True)
+        assert logits.shape == (2, cfg.total_seq_len, cfg.vocab_total)
+        assert np.isfinite(float(loss))
+        assert float(aux["loss_img"]) > 0
+
+    def test_segment_logit_masking(self):
+        cfg = tiny_model_config()
+        model = DALLE(cfg)
+        params = init_params(model, jax.random.PRNGKey(0))
+        text = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+        img = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+        _, _, logits = model.apply(params, text, img, return_logits=True)
+        logits = np.asarray(logits)
+        # text positions: image-vocab logits are -inf-ish
+        assert (logits[0, : cfg.text_seq_len, cfg.vocab_text:] < -1e8).all()
+        # image positions: text-vocab logits are -inf-ish
+        assert (logits[0, cfg.text_seq_len:, : cfg.vocab_text] < -1e8).all()
+
+    def test_weight_sharing_param_count(self):
+        """Depth 8 sharing 4 blocks + wconv must create exactly 5 blocks'
+        worth of transformer block params (reference task.py:65,78-79)."""
+        shared = tiny_model_config(
+            text_seq_len=TEXT, image_grid=GRID, depth=8,
+            shared_block_cycle=4, final_conv_block=True,
+            attn_types=("axial_row", "axial_col", "axial_row", "axial_row"),
+            conv_kernel=3)
+        unshared = dataclasses.replace(shared, shared_block_cycle=0)
+        n_shared = param_count(
+            init_params(DALLE(shared), jax.random.PRNGKey(0)))
+        n_unshared = param_count(
+            init_params(DALLE(unshared), jax.random.PRNGKey(0)))
+        # shared: 4 unique + wconv = 5 blocks; unshared: 8 blocks (7 + wconv).
+        blocks_params_shared = 5
+        blocks_params_unshared = 8
+        emb = param_count(init_params(
+            DALLE(dataclasses.replace(shared, depth=1, final_conv_block=True,
+                                      shared_block_cycle=1)),
+            jax.random.PRNGKey(0)))
+        per_block = (n_unshared - n_shared) / (
+            blocks_params_unshared - blocks_params_shared)
+        assert per_block > 0
+        # consistency: total = base + n_blocks * per_block for both configs
+        base_s = n_shared - blocks_params_shared * per_block
+        base_u = n_unshared - blocks_params_unshared * per_block
+        assert abs(base_s - base_u) < 1e-6
+
+    def test_loss_decreases_under_overfit_signal(self):
+        """Sanity: loss on an all-constant batch is lower than on random
+        tokens after a few SGD steps (full training-loop test lives in
+        test_train.py)."""
+        cfg = tiny_model_config()
+        model = DALLE(cfg)
+        params = init_params(model, jax.random.PRNGKey(0))
+        rng = jax.random.PRNGKey(2)
+        text = jax.random.randint(rng, (2, cfg.text_seq_len), 0,
+                                  cfg.vocab_text)
+        img = jax.random.randint(rng, (2, cfg.image_seq_len), 0,
+                                 cfg.vocab_image)
+
+        @jax.jit
+        def step(p):
+            def loss_fn(p):
+                loss, _ = model.apply(p, text, img)
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            p = jax.tree.map(lambda a, g: a - 0.05 * g, p, grads)
+            return p, loss
+
+        losses = []
+        for _ in range(8):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.1
+
+    def test_loss_mask_excludes_padding(self):
+        cfg = tiny_model_config()
+        model = DALLE(cfg)
+        params = init_params(model, jax.random.PRNGKey(0))
+        rng = jax.random.PRNGKey(3)
+        text = jax.random.randint(rng, (1, cfg.text_seq_len), 0,
+                                  cfg.vocab_text)
+        img = jax.random.randint(rng, (1, cfg.image_seq_len), 0,
+                                 cfg.vocab_image)
+        mask = jnp.ones((1, cfg.total_seq_len))
+        mask = mask.at[:, 2: cfg.text_seq_len].set(0.0)
+        loss_m, _ = model.apply(params, text, img, loss_mask=mask)
+        loss_f, _ = model.apply(params, text, img)
+        assert np.isfinite(float(loss_m))
+        assert float(loss_m) != pytest.approx(float(loss_f))
