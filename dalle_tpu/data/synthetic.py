@@ -45,6 +45,9 @@ class SyntheticCodes:
         per-peer data seeding (hf_trainer.py:30-33)."""
         rng = np.random.default_rng(seed)
         n = len(self)
+        if batch_size > n:
+            raise ValueError(
+                f"batch_size {batch_size} > dataset size {n}")
         while True:
             order = rng.permutation(n)
             for i in range(0, n - batch_size + 1, batch_size):

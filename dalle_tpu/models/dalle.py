@@ -37,11 +37,15 @@ class DALLE(nn.Module):
 
     def setup(self):
         cfg = self.cfg
+        cfg.validate()
         pdt = jnp.dtype(cfg.param_dtype)
         emb_init = nn.initializers.normal(stddev=0.02)
-        # +1 row: BOS, input-only (never predicted).
+        # +1 row for BOS (input-only, never predicted), then padded up to a
+        # multiple of 128 so the vocab axis tiles TPU lanes and stays
+        # divisible under tp sharding (see parallel/sharding.py rules).
+        rows = -(-(cfg.vocab_total + 1) // 128) * 128
         self.token_emb = self.param(
-            "token_emb", emb_init, (cfg.vocab_total + 1, cfg.dim), pdt)
+            "token_emb", emb_init, (rows, cfg.dim), pdt)
         self.text_pos_emb = self.param(
             "text_pos_emb", emb_init, (cfg.text_seq_len, cfg.dim), pdt)
         # Axial (row + col) learned position embedding for the image grid.

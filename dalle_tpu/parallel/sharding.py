@@ -61,17 +61,10 @@ def param_specs(params) -> Any:
     specs = []
     for path, leaf in flat:
         spec = spec_for_path(_path_str(path))
-        # Drop axis shardings that don't divide the dimension; XLA requires
-        # even sharding and small models shouldn't need padding.
-        kept = []
-        for i, ax in enumerate(spec):
-            if ax is None:
-                kept.append(None)
-                continue
-            if i < leaf.ndim:
-                kept.append(ax)
-            else:
-                kept.append(None)
+        # Trim the spec to the leaf's rank; divisibility against a concrete
+        # mesh is handled in param_shardings.
+        kept = [ax if i < leaf.ndim else None
+                for i, ax in enumerate(spec)]
         specs.append(P(*kept) if kept else P())
     return jax.tree_util.tree_unflatten(treedef, specs)
 
@@ -80,7 +73,8 @@ def param_shardings(mesh: Mesh, params) -> Any:
     specs = param_specs(params)
 
     def _fix(leaf, spec):
-        # Validate divisibility; fall back to replication per-axis otherwise.
+        # Drop shardings whose mesh axis doesn't divide the dimension (XLA
+        # requires even sharding); the remaining axes stay sharded.
         axes = []
         for i, ax in enumerate(spec):
             if ax is None:

@@ -6,9 +6,11 @@ swarm -> apply the averaged step):
 
 - :func:`make_train_step`     — fused local step (grad + optimizer update);
   the single-peer / non-collaborative path.
-- :func:`make_grad_step`      — forward/backward only, returns gradients
-  (optionally pre-scaled by sample count) without touching optimizer state;
-  what a peer runs while the swarm accumulates toward ``target_batch_size``.
+- :func:`make_grad_step`      — forward/backward only, returns the local
+  mean gradient without touching optimizer state; what a peer runs while the
+  swarm accumulates toward ``target_batch_size``. Sample-count weighting
+  across peers is the averager's job (it weights each peer's contribution
+  by its accumulated samples, as hivemind's GradientAverager does).
 - :func:`make_apply_step`     — applies (averaged) gradients via the
   optimizer; what runs once per swarm epoch.
 

@@ -172,10 +172,6 @@ class TestModel:
         # shared: 4 unique + wconv = 5 blocks; unshared: 8 blocks (7 + wconv).
         blocks_params_shared = 5
         blocks_params_unshared = 8
-        emb = param_count(init_params(
-            DALLE(dataclasses.replace(shared, depth=1, final_conv_block=True,
-                                      shared_block_cycle=1)),
-            jax.random.PRNGKey(0)))
         per_block = (n_unshared - n_shared) / (
             blocks_params_unshared - blocks_params_shared)
         assert per_block > 0
