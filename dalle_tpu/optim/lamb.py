@@ -53,6 +53,25 @@ def default_wd_mask(params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def lamb_leaf_update(p: jax.Array, m: jax.Array, v: jax.Array,
+                     decay, lr, *, eps: float, weight_decay: float,
+                     clamp_value: float) -> jax.Array:
+    """The shared per-tensor LAMB update (used by both the fp32 and 8-bit
+    optimizers so their trajectories agree up to moment quantization):
+    adam_step = m/(sqrt(v)+eps) + wd*p; trust = clamp(||p||, clamp_value) /
+    ||adam_step|| (1.0 where either norm is 0); update = -lr*trust*adam_step.
+    Matches reference lamb_8bit.py:135-158 (debias=False)."""
+    p32 = p.astype(jnp.float32)
+    adam_step = m / (jnp.sqrt(v) + eps)
+    if weight_decay:
+        adam_step = adam_step + jnp.where(decay, weight_decay, 0.0) * p32
+    wnorm = jnp.minimum(jnp.sqrt(jnp.sum(p32 * p32)), clamp_value)
+    anorm = jnp.sqrt(jnp.sum(adam_step * adam_step))
+    trust = jnp.where((wnorm > 0) & (anorm > 0),
+                      wnorm / (anorm + 1e-12), 1.0)
+    return (-lr * trust * adam_step).astype(p.dtype)
+
+
 def lamb(learning_rate: ScalarOrSchedule,
          b1: float = 0.9,
          b2: float = 0.96,
@@ -90,17 +109,9 @@ def lamb(learning_rate: ScalarOrSchedule,
         wd_mask = wd_mask_fn(params)
 
         def leaf_update(p, m, v, decay):
-            p32 = p.astype(jnp.float32)
-            adam_step = m / (jnp.sqrt(v) + eps)
-            if weight_decay:
-                adam_step = adam_step + jnp.where(
-                    decay, weight_decay, 0.0) * p32
-            wnorm = jnp.minimum(
-                jnp.sqrt(jnp.sum(p32 * p32)), clamp_value)
-            anorm = jnp.sqrt(jnp.sum(adam_step * adam_step))
-            trust = jnp.where((wnorm > 0) & (anorm > 0),
-                              wnorm / (anorm + 1e-12), 1.0)
-            return (-lr * trust * adam_step).astype(p.dtype)
+            return lamb_leaf_update(
+                p, m, v, decay, lr, eps=eps, weight_decay=weight_decay,
+                clamp_value=clamp_value)
 
         new_updates = jax.tree.map(leaf_update, params, mu, nu, wd_mask)
         return new_updates, LambState(state.count + 1, mu, nu)
@@ -121,8 +132,10 @@ def make_lr_schedule(cfg: OptimizerConfig) -> Callable[[jax.Array], jax.Array]:
         boundaries=[cfg.warmup_steps])
 
 
-def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
-    """The reference's fp32 optimizer (clipped LAMB + linear schedule)."""
+def make_optimizer_fp32(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    """The reference's fp32 optimizer variant (clipped LAMB + linear
+    schedule, parity with clipped_lamb.py). The config-driven entry point
+    dalle_tpu.optim.make_optimizer dispatches on cfg.state_bits."""
     return lamb(
         learning_rate=make_lr_schedule(cfg),
         b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,

@@ -10,7 +10,7 @@ import pytest
 from dalle_tpu.config import OptimizerConfig, tiny_model_config
 from dalle_tpu.data.synthetic import SyntheticCodes
 from dalle_tpu.models.dalle import DALLE, init_params
-from dalle_tpu.optim.lamb import global_norm, lamb, make_lr_schedule, make_optimizer
+from dalle_tpu.optim import global_norm, lamb, make_lr_schedule, make_optimizer
 from dalle_tpu.parallel.mesh import batch_sharding, make_mesh
 from dalle_tpu.parallel.sharding import param_shardings
 from dalle_tpu.training.steps import (
