@@ -1,0 +1,109 @@
+"""Benchmark harness: flagship DALL-E train-step throughput, images/sec/chip.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+The reference (learning-at-home/dalle) publishes no numbers (README.md:1-17;
+BASELINE.json "published": {}), so the baseline is the north-star target from
+BASELINE.json: >=30 images/sec/chip for DALL-E-1.3B. ``vs_baseline`` is
+value / 30.
+
+On TPU this times the full jitted train step (forward + backward + LAMB
+update, remat on, bf16 activations, fp32 params — the training-parity
+configuration) on the flagship 1.3B shape (reference task.py:62-83). On CPU
+(no TPU attached) it falls back to the tiny smoke config and reports against
+the same unit so the harness always emits a line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 30.0
+
+
+def _bench(model_cfg, per_chip_batch: int, warmup: int, iters: int) -> float:
+    """Images/sec/chip for the jitted, mesh-sharded train step over ALL
+    local devices (dp over chips, like __graft_entry__.dryrun_multichip)."""
+    import jax
+
+    from dalle_tpu.config import OptimizerConfig
+    from dalle_tpu.data.synthetic import SyntheticCodes
+    from dalle_tpu.models.dalle import DALLE, init_params
+    from dalle_tpu.optim import make_optimizer
+    from dalle_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+    from dalle_tpu.parallel.sharding import param_shardings
+    from dalle_tpu.training.steps import TrainState, make_train_step
+
+    n_chips = jax.local_device_count()
+    mesh = make_mesh(dp=-1)
+    batch_size = per_chip_batch * n_chips
+
+    model = DALLE(model_cfg)
+    params = init_params(model, jax.random.PRNGKey(0))
+    tx = make_optimizer(OptimizerConfig(warmup_steps=10, total_steps=1000))
+    state = TrainState.create(params, tx)
+    rep = replicated(mesh)
+    state = TrainState(
+        step=jax.device_put(state.step, rep),
+        params=jax.device_put(state.params, param_shardings(mesh, params)),
+        opt_state=jax.tree.map(
+            lambda x: jax.device_put(x, rep), state.opt_state))
+
+    data = SyntheticCodes(model_cfg, num_samples=batch_size, seed=0)
+    batch = next(data.batches(batch_size, seed=0))
+    batch = jax.device_put(batch, batch_sharding(mesh))
+
+    step = jax.jit(make_train_step(model, tx), donate_argnums=0)
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return (batch_size * iters) / dt / n_chips
+
+
+def main() -> None:
+    import jax
+
+    from dalle_tpu.config import flagship_model_config, tiny_model_config
+
+    backend = jax.default_backend()
+    result = None
+    if backend == "tpu":
+        cfg = flagship_model_config()
+        # Walk per-chip batch down on OOM so the harness always emits a line.
+        for bs in (32, 16, 8, 4, 2, 1):
+            try:
+                ips = _bench(cfg, bs, warmup=2, iters=5)
+                result = ("dalle-1.3b train images/sec/chip (tpu)", ips,
+                          ips / BASELINE_IMAGES_PER_SEC_PER_CHIP)
+                break
+            except Exception as e:  # noqa: BLE001 - OOM/resource errors vary
+                print(f"# batch {bs} failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+    if result is None:
+        # Tiny-model numbers are not comparable to the 1.3B baseline:
+        # report them honestly with vs_baseline 0.
+        cfg = tiny_model_config()
+        ips = _bench(cfg, per_chip_batch=8, warmup=1, iters=3)
+        result = (f"dalle-tiny train images/sec/chip ({backend} fallback)",
+                  ips, 0.0)
+
+    metric, value, vs = result
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dalle_tpu.ops.quant import codebook_midpoints
+from dalle_tpu.ops.quant import codebook_midpoints, to_blocks
 
 ROWS_PER_TILE = 8
 
@@ -48,13 +48,13 @@ def _quant_kernel(x_ref, thr_ref, codes_ref, absmax_ref):
     absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
     scale = jnp.where(absmax > 0, absmax, 1.0)
     normed = x / scale
-    # code = number of thresholds strictly below the value
+    # code = number of thresholds strictly below the value. Thresholds live
+    # in SMEM; thr_ref[k] is a scalar load with a dynamic index, which
+    # Mosaic supports (vector dynamic_slice is not lowerable on TPU).
     code = jnp.zeros(x.shape, jnp.int32)
-    thr = thr_ref[:]                           # (1, 256)
 
     def body(k, code):
-        t = jax.lax.dynamic_slice(thr, (0, k), (1, 1))  # scalar threshold
-        return code + (normed > t).astype(jnp.int32)
+        return code + (normed > thr_ref[k]).astype(jnp.int32)
 
     code = jax.lax.fori_loop(0, 255, body, code)
     codes_ref[:] = code.astype(jnp.uint8)
@@ -71,16 +71,13 @@ def quantize_blockwise_pallas(x: jax.Array, block_size: int = 4096,
     """
     if block_size % 128:
         raise ValueError("block_size must be a multiple of 128")
-    flat = x.reshape(-1).astype(jnp.float32)
-    n = flat.shape[0]
-    n_blocks = -(-n // block_size)
-    # pad the block dimension, then pad rows up to a tile multiple
-    flat = jnp.pad(flat, (0, n_blocks * block_size - n))
+    tail = to_blocks(x, block_size)                # shared prologue
+    n_blocks = tail.shape[0]
+    # pad rows up to a tile multiple
     rows = -(-n_blocks // ROWS_PER_TILE) * ROWS_PER_TILE
-    blocks = jnp.zeros((rows, block_size), jnp.float32)
-    blocks = blocks.at[:n_blocks].set(flat.reshape(n_blocks, block_size))
+    blocks = jnp.zeros((rows, block_size), jnp.float32).at[:n_blocks].set(tail)
 
-    thr = jnp.asarray(_thresholds(signed)).reshape(1, 256)
+    thr = jnp.asarray(_thresholds(signed))
     grid = (rows // ROWS_PER_TILE,)
     codes, absmax = pl.pallas_call(
         _quant_kernel,
@@ -91,7 +88,7 @@ def quantize_blockwise_pallas(x: jax.Array, block_size: int = 4096,
         grid=grid,
         in_specs=[
             pl.BlockSpec((ROWS_PER_TILE, block_size), lambda i: (i, 0)),
-            pl.BlockSpec((1, 256), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(
             pl.BlockSpec((ROWS_PER_TILE, block_size), lambda i: (i, 0)),

@@ -105,6 +105,16 @@ class Quantized(flax.struct.PyTreeNode):
         return int(np.prod(self.shape))
 
 
+def to_blocks(x: jax.Array, block_size: int) -> jax.Array:
+    """(n_blocks, block_size) float32 blocking of ``x``, zero-padded at the
+    tail. Shared by the XLA path and the Pallas wrapper so the two prologues
+    cannot drift (their byte-parity contract depends on it)."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n_blocks = -(-flat.shape[0] // block_size)
+    flat = jnp.pad(flat, (0, n_blocks * block_size - flat.shape[0]))
+    return flat.reshape(n_blocks, block_size)
+
+
 def _nearest_code(normed: jax.Array, signed: bool) -> jax.Array:
     """Nearest codebook index = count of midpoints strictly below the value
     (searchsorted-left over the shared float32 midpoints)."""
@@ -127,12 +137,7 @@ def quantize_blockwise(x: jax.Array, block_size: int = DEFAULT_BLOCK,
             x, block_size, signed=signed)
         return Quantized(codes=codes, absmax=absmax, shape=shape,
                          signed=signed)
-    flat = x.reshape(-1).astype(jnp.float32)
-    n = flat.shape[0]
-    n_blocks = -(-n // block_size)
-    pad = n_blocks * block_size - n
-    flat = jnp.pad(flat, (0, pad))
-    blocks = flat.reshape(n_blocks, block_size)
+    blocks = to_blocks(x, block_size)
     absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
     scale = jnp.where(absmax > 0, absmax, 1.0)
     normed = blocks / scale

@@ -3,13 +3,16 @@
 Multi-chip sharding semantics are exercised without TPUs by spoofing the
 host platform device count (the strategy SURVEY.md §4 prescribes; the driver
 separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
-Must run before jax initializes its backends, hence the env mutation at
-import time.
+
+The XLA flag must be set before jax initializes its backends, hence the env
+mutation at import time. The platform pin must happen AFTER the jax import:
+this environment's TPU shim force-rewrites the ``jax_platforms`` config (and
+the JAX_PLATFORMS env var) during import, so only a post-import
+``config.update`` sticks.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,4 +20,6 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+assert jax.default_backend() == "cpu" and jax.device_count() >= 8
