@@ -33,8 +33,8 @@ def _bench(model_cfg, per_chip_batch: int, warmup: int, iters: int) -> float:
     from dalle_tpu.data.synthetic import SyntheticCodes
     from dalle_tpu.models.dalle import DALLE, init_params
     from dalle_tpu.optim import make_optimizer
-    from dalle_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
-    from dalle_tpu.parallel.sharding import param_shardings
+    from dalle_tpu.parallel.mesh import batch_sharding, make_mesh
+    from dalle_tpu.parallel.sharding import shard_train_state
     from dalle_tpu.training.steps import TrainState, make_train_step
 
     n_chips = jax.local_device_count()
@@ -44,28 +44,30 @@ def _bench(model_cfg, per_chip_batch: int, warmup: int, iters: int) -> float:
     model = DALLE(model_cfg)
     params = init_params(model, jax.random.PRNGKey(0))
     tx = make_optimizer(OptimizerConfig(warmup_steps=10, total_steps=1000))
-    state = TrainState.create(params, tx)
-    rep = replicated(mesh)
-    state = TrainState(
-        step=jax.device_put(state.step, rep),
-        params=jax.device_put(state.params, param_shardings(mesh, params)),
-        opt_state=jax.tree.map(
-            lambda x: jax.device_put(x, rep), state.opt_state))
+    state = shard_train_state(mesh, TrainState.create(params, tx))
 
     data = SyntheticCodes(model_cfg, num_samples=batch_size, seed=0)
     batch = next(data.batches(batch_size, seed=0))
     batch = jax.device_put(batch, batch_sharding(mesh))
 
     step = jax.jit(make_train_step(model, tx), donate_argnums=0)
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
 
+    def run(n: int) -> float:
+        """n chained steps; returns the final loss. The device_get of the
+        scalar forces completion of the whole chain — block_until_ready
+        alone proved unreliable through remote-TPU tunnels (it returned
+        before execution, yielding physically impossible throughput)."""
+        nonlocal state
+        metrics = None
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        return float(jax.device_get(metrics["loss"]))
+
+    run(warmup)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(state.params)
+    final_loss = run(iters)
     dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss in benchmark"
     return (batch_size * iters) / dt / n_chips
 
 

@@ -88,3 +88,16 @@ def param_shardings(mesh: Mesh, params) -> Any:
         return NamedSharding(mesh, P(*axes))
 
     return jax.tree.map(_fix, params, specs)
+
+
+def shard_train_state(mesh: Mesh, state):
+    """Place a TrainState on the mesh: params per PARAM_RULES, step and
+    optimizer state replicated. The single canonical placement used by the
+    driver dry-run, the benchmark, and the trainer CLI."""
+    rep = NamedSharding(mesh, P())
+    return type(state)(
+        step=jax.device_put(state.step, rep),
+        params=jax.device_put(state.params, param_shardings(mesh,
+                                                            state.params)),
+        opt_state=jax.tree.map(lambda x: jax.device_put(x, rep),
+                               state.opt_state))

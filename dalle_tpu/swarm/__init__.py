@@ -1,0 +1,19 @@
+"""Swarm substrate: identity, DHT, and the collaborative data plane.
+
+The TPU-native replacement for the reference's hivemind.DHT + go-libp2p
+stack (learning-at-home/dalle task.py:101-119): a C++ peer daemon
+(native/swarm/) owns transport, Kademlia routing and record storage; this
+package owns identity, signatures, schemas and the averaging protocol.
+"""
+
+from dalle_tpu.swarm.dht import (DHT, RecordValidatorBase, SchemaValidator,
+                                 SignatureValidator, ValueWithExpiration,
+                                 get_dht_time, key_hash, owner_public_key,
+                                 strip_owner)
+from dalle_tpu.swarm.identity import Identity
+
+__all__ = [
+    "DHT", "Identity", "RecordValidatorBase", "SchemaValidator",
+    "SignatureValidator", "ValueWithExpiration", "get_dht_time", "key_hash",
+    "owner_public_key", "strip_owner",
+]

@@ -1,0 +1,92 @@
+"""ctypes binding to the C++ swarm daemon (native/swarm/swarm.cc).
+
+Builds the shared library on demand with the checked-in Makefile (the .so is
+a build product, not a repo artifact) and exposes typed wrappers. The C++
+daemon is the TPU-native stand-in for the reference's go-libp2p-daemon
+transport (learning-at-home/dalle arguments.py:93-124, .gitignore:84-85).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libdalle_swarm.so"
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    res = subprocess.run(["make", "-C", str(_NATIVE_DIR)],
+                         capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"native swarm build failed:\n{res.stdout}\n{res.stderr}")
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the swarm library; idempotent."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = _NATIVE_DIR / "swarm" / "swarm.cc"
+        if (not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < src.stat().st_mtime):
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+
+        lib.swarm_node_create.restype = ctypes.c_void_p
+        lib.swarm_node_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.swarm_node_port.restype = ctypes.c_int
+        lib.swarm_node_port.argtypes = [ctypes.c_void_p]
+        lib.swarm_node_bootstrap.restype = ctypes.c_int
+        lib.swarm_node_bootstrap.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.swarm_node_store.restype = ctypes.c_int
+        lib.swarm_node_store.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_double]
+        lib.swarm_node_get.restype = ctypes.c_void_p
+        lib.swarm_node_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_send.restype = ctypes.c_int
+        lib.swarm_node_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+        lib.swarm_node_recv.restype = ctypes.c_void_p
+        lib.swarm_node_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_peers.restype = ctypes.c_void_p
+        lib.swarm_node_peers.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_set_timeout.restype = None
+        lib.swarm_node_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.swarm_node_destroy.restype = None
+        lib.swarm_node_destroy.argtypes = [ctypes.c_void_p]
+        lib.swarm_free.restype = None
+        lib.swarm_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def take_buffer(ptr: int, length: int) -> bytes:
+    """Copy a malloc'd native buffer into bytes and free it."""
+    lib = load()
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.swarm_free(ptr)

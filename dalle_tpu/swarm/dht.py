@@ -1,0 +1,332 @@
+"""The swarm DHT: Python identity/validation over the C++ daemon.
+
+Capability parity with the reference's ``hivemind.DHT`` surface
+(learning-at-home/dalle task.py:104-119): construction with initial peers /
+client mode / persisted identity, ``store(key, subkey, value,
+expiration_time)`` (callback.py:81-86), ``get(key, latest=True)``
+(run_aux_peer.py:107), ``peer_id`` (task.py:116), visible addresses
+(task.py:118), and ``get_dht_time`` (callback.py:84).
+
+Record validation follows hivemind's validator design (utils.py:27-30 wires
+an RSASignatureValidator + pydantic SchemaValidator): signatures bind a
+record to the writing peer's public key, schemas reject malformed metrics.
+One deliberate difference: hivemind's Python DHT node validates inbound
+STOREs server-side; here the store/routing plane is native C++, so
+validation runs on the *read* path (every consumer drops forged or
+malformed entries) — same end-to-end guarantee, no Python in the daemon.
+
+Values are msgpack-serialized (hivemind's MSGPackSerializer equivalent).
+Addresses are ``host:port`` strings (multiaddr-lite).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import struct
+import time
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple, Union
+
+import msgpack
+
+from dalle_tpu.swarm import _native
+from dalle_tpu.swarm.identity import Identity
+
+
+def get_dht_time() -> float:
+    """Swarm-wide clock (hivemind.get_dht_time parity; callback.py:84)."""
+    return time.time()
+
+
+def key_hash(key: Union[str, bytes]) -> bytes:
+    if isinstance(key, str):
+        key = key.encode()
+    return hashlib.sha256(key).digest()
+
+
+class ValueWithExpiration(NamedTuple):
+    value: Any
+    expiration_time: float
+
+
+_OWNER_OPEN = b"[owner:"
+_OWNER_CLOSE = b"]"
+
+
+def _signing_message(khash: bytes, wire_subkey: bytes, value: bytes,
+                     expiration: float) -> bytes:
+    return khash + wire_subkey + value + struct.pack(">d", expiration)
+
+
+class RecordValidatorBase:
+    """Transforms records on write and checks them on read."""
+
+    def on_store(self, khash: bytes, subkey: bytes, value: bytes,
+                 expiration: float) -> Tuple[bytes, bytes]:
+        return subkey, value
+
+    def on_read(self, khash: bytes, subkey: bytes, value: bytes,
+                expiration: float) -> Optional[Tuple[bytes, bytes]]:
+        """(clean_subkey, clean_value) or None to reject the entry."""
+        return subkey, value
+
+
+def owner_public_key(subkey: bytes) -> Optional[bytes]:
+    """Public key from an ``[owner:...]``-marked wire subkey, or None."""
+    open_at = subkey.rfind(_OWNER_OPEN)
+    if open_at < 0 or not subkey.endswith(_OWNER_CLOSE):
+        return None
+    try:
+        return bytes.fromhex(
+            subkey[open_at + len(_OWNER_OPEN):-len(_OWNER_CLOSE)].decode())
+    except ValueError:
+        return None
+
+
+def strip_owner(subkey: bytes) -> bytes:
+    """Wire subkey without its ownership marker (for display/grouping)."""
+    open_at = subkey.rfind(_OWNER_OPEN)
+    if open_at < 0 or not subkey.endswith(_OWNER_CLOSE):
+        return subkey
+    return subkey[:open_at]
+
+
+class SignatureValidator(RecordValidatorBase):
+    """Peer-signed subkeys: the public key IS the peer identity.
+
+    Ed25519 stand-in for hivemind's RSASignatureValidator (reference
+    utils.py:27-30). Outbound: the wire subkey gains an ``[owner:<pubkey>]``
+    suffix and the value a 64-byte signature over (key, subkey, value,
+    expiration). Inbound: any owner-marked record with a bad signature is
+    dropped. The marker stays in the returned subkey — stripping it would
+    let an *unsigned* record with the bare subkey shadow a signed one in
+    the freshest-expiration merge. For keys listed in ``protected_keys``,
+    unmarked (unsigned) records are rejected outright, so consumers of
+    e.g. the metrics key only ever see authenticated entries.
+    """
+
+    def __init__(self, identity: Identity,
+                 protected_keys: Sequence[Union[str, bytes]] = ()):
+        self.identity = identity
+        self.ownership_marker = (
+            _OWNER_OPEN + identity.public_bytes.hex().encode() + _OWNER_CLOSE)
+        self._protected = {key_hash(k) for k in protected_keys}
+
+    def on_store(self, khash, subkey, value, expiration):
+        wire_subkey = subkey + self.ownership_marker
+        sig = self.identity.sign(
+            _signing_message(khash, wire_subkey, value, expiration))
+        return wire_subkey, value + sig
+
+    def on_read(self, khash, subkey, value, expiration):
+        public_bytes = owner_public_key(subkey)
+        if public_bytes is None:
+            if khash in self._protected:
+                return None  # protected keys accept only signed records
+            return subkey, value  # unsigned record on an open key
+        if len(value) < 64:
+            return None
+        payload, sig = value[:-64], value[-64:]
+        if not Identity.verify(
+                public_bytes, sig,
+                _signing_message(khash, subkey, payload, expiration)):
+            return None
+        return subkey, payload
+
+
+class SchemaValidator(RecordValidatorBase):
+    """Reject records whose decoded value fails a pydantic schema.
+
+    Parity with the reference's ``SchemaValidator(MetricSchema)``
+    (utils.py:15-30): ``schemas`` maps the exact DHT key (pre-hash) to a
+    pydantic model validated against the msgpack-decoded value.
+    """
+
+    def __init__(self, schemas: Dict[str, Any]):
+        self._by_hash = {key_hash(k): v for k, v in schemas.items()}
+
+    def on_read(self, khash, subkey, value, expiration):
+        model = self._by_hash.get(khash)
+        if model is None:
+            return subkey, value
+        try:
+            model.model_validate(msgpack.unpackb(value, raw=False))
+        except Exception:  # noqa: BLE001 - any parse/validation error
+            return None
+        return subkey, value
+
+
+class DHT:
+    """A peer in the swarm: DHT records + tagged data plane.
+
+    Mirrors ``hivemind.DHT(start=True, initial_peers=..., client_mode=...,
+    identity_path=..., record_validators=...)`` (reference task.py:104-114).
+    """
+
+    def __init__(self,
+                 host: str = "127.0.0.1",
+                 port: int = 0,
+                 initial_peers: Sequence[str] = (),
+                 client_mode: bool = False,
+                 identity: Optional[Identity] = None,
+                 identity_path: Optional[str] = None,
+                 record_validators: Sequence[RecordValidatorBase] = (),
+                 rpc_timeout: float = 5.0):
+        self.identity = identity or Identity.load_or_create(identity_path)
+        self.client_mode = client_mode
+        self.validators = list(record_validators)
+        self._lib = _native.load()
+        self._node = self._lib.swarm_node_create(
+            host.encode(), port, self.identity.node_id, int(client_mode))
+        if not self._node:
+            raise RuntimeError(f"failed to start swarm node on {host}:{port}")
+        self._lib.swarm_node_set_timeout(self._node, int(rpc_timeout * 1000))
+        self.host = host
+        self.port = self._lib.swarm_node_port(self._node)
+        for addr in initial_peers:
+            self.bootstrap(addr)
+
+    # -- identity / addressing ------------------------------------------
+
+    @property
+    def peer_id(self) -> str:
+        return self.identity.node_id.hex()
+
+    @property
+    def visible_address(self) -> str:
+        """Copyable --initial_peers entry (reference utils.py:39-56)."""
+        return f"{self.host}:{self.port}"
+
+    def bootstrap(self, addr: str) -> bool:
+        host, _, port = addr.rpartition(":")
+        rc = self._lib.swarm_node_bootstrap(
+            self._node, host.encode(), int(port))
+        return rc == 0
+
+    # -- records ----------------------------------------------------------
+
+    def store(self, key: Union[str, bytes], subkey: Union[str, bytes, None],
+              value: Any, expiration_time: float) -> bool:
+        """Signed, replicated store (reference callback.py:81-86)."""
+        khash = key_hash(key)
+        skey = (subkey.encode() if isinstance(subkey, str)
+                else (subkey or b""))
+        val = msgpack.packb(value, use_bin_type=True)
+        for v in self.validators:
+            skey, val = v.on_store(khash, skey, val, expiration_time)
+        rc = self._lib.swarm_node_store(
+            self._node, khash, skey, len(skey), val, len(val),
+            float(expiration_time))
+        return rc >= 0
+
+    def get(self, key: Union[str, bytes], latest: bool = True
+            ) -> Optional[Dict[bytes, ValueWithExpiration]]:
+        """Merged subkey map or None (reference run_aux_peer.py:107).
+
+        ``latest`` is accepted for interface parity; the lookup always
+        merges all live replicas keeping the freshest expiration per subkey.
+        """
+        del latest
+        khash = key_hash(key)
+        out_len = ctypes.c_size_t()
+        ptr = self._lib.swarm_node_get(self._node, khash,
+                                       ctypes.byref(out_len))
+        if not ptr:
+            return None
+        buf = _native.take_buffer(ptr, out_len.value)
+        entries = _parse_entries(buf)
+        result: Dict[bytes, ValueWithExpiration] = {}
+        for skey, val, exp in entries:
+            clean = (skey, val)
+            # peel write-side transformations in reverse order
+            for v in reversed(self.validators):
+                clean = v.on_read(khash, clean[0], clean[1], exp)
+                if clean is None:
+                    break
+            if clean is None:
+                continue
+            skey, val = clean
+            try:
+                decoded = msgpack.unpackb(val, raw=False)
+            except Exception:  # noqa: BLE001
+                continue
+            if skey not in result or exp >= result[skey].expiration_time:
+                result[skey] = ValueWithExpiration(decoded, exp)
+        return result or None
+
+    # -- data plane (tensor parts for averaging) --------------------------
+
+    def send(self, addr: str, tag: int, payload: bytes,
+             timeout: Optional[float] = None) -> bool:
+        """One-shot timeouts apply to this send only (the node-wide RPC
+        timeout is untouched)."""
+        host, _, port = addr.rpartition(":")
+        timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
+        rc = self._lib.swarm_node_send(
+            self._node, host.encode(), int(port), tag, payload, len(payload),
+            timeout_ms)
+        return rc == 0
+
+    def recv(self, tag: int, timeout: float) -> Optional[bytes]:
+        out_len = ctypes.c_size_t()
+        ptr = self._lib.swarm_node_recv(
+            self._node, tag, int(timeout * 1000), ctypes.byref(out_len))
+        if not ptr:
+            return None
+        return _native.take_buffer(ptr, out_len.value)
+
+    # -- introspection -----------------------------------------------------
+
+    def peers(self) -> Dict[str, str]:
+        """{peer_id_hex: "host:port"} routing table dump."""
+        out_len = ctypes.c_size_t()
+        ptr = self._lib.swarm_node_peers(self._node, ctypes.byref(out_len))
+        if not ptr:
+            return {}
+        buf = _native.take_buffer(ptr, out_len.value)
+        off = 4
+        count = int.from_bytes(buf[0:4], "big")
+        peers = {}
+        for _ in range(count):
+            pid = buf[off:off + 32].hex()
+            off += 32
+            hlen = int.from_bytes(buf[off:off + 4], "big")
+            off += 4
+            host = buf[off:off + hlen].decode()
+            off += hlen
+            port = int.from_bytes(buf[off:off + 2], "big")
+            off += 2
+            peers[pid] = f"{host}:{port}"
+        return peers
+
+    def shutdown(self) -> None:
+        if self._node:
+            self._lib.swarm_node_destroy(self._node)
+            self._node = None
+
+    def __enter__(self) -> "DHT":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+def _parse_entries(buf: bytes):
+    """Decode the native get() buffer: u32 count, then
+    (u32 len subkey, u32 len value, f64 expiration) entries."""
+    off = 4
+    count = int.from_bytes(buf[0:4], "big")
+    out = []
+    for _ in range(count):
+        slen = int.from_bytes(buf[off:off + 4], "big")
+        off += 4
+        skey = buf[off:off + slen]
+        off += slen
+        vlen = int.from_bytes(buf[off:off + 4], "big")
+        off += 4
+        val = buf[off:off + vlen]
+        off += vlen
+        (exp,) = struct.unpack(">d", buf[off:off + 8])
+        off += 8
+        out.append((skey, val, exp))
+    return out

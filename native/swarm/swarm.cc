@@ -1,0 +1,745 @@
+/* dalle_tpu swarm peer daemon: Kademlia-style DHT + tagged message data
+ * plane over TCP. See swarm.h for the capability contract and the mapping
+ * onto the reference's go-libp2p-daemon substrate. */
+
+#include "swarm.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using NodeId = std::array<uint8_t, 32>;
+
+constexpr int kBucketSize = 16;   // Kademlia k
+constexpr int kAlpha = 3;         // lookup parallelism (serialized batches)
+constexpr uint8_t kPing = 1, kPong = 2, kStore = 3, kStoreOk = 4,
+                  kFindNode = 5, kNodes = 6, kFindValue = 7, kValue = 8,
+                  kMsg = 9, kMsgOk = 10;
+
+double now_unix() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+/* ---------- byte buffer helpers (big-endian wire format) ---------- */
+
+void put_u16(std::string &b, uint16_t v) {
+  b.push_back(char(v >> 8));
+  b.push_back(char(v & 0xff));
+}
+void put_u32(std::string &b, uint32_t v) {
+  for (int i = 3; i >= 0; --i) b.push_back(char((v >> (8 * i)) & 0xff));
+}
+void put_u64(std::string &b, uint64_t v) {
+  for (int i = 7; i >= 0; --i) b.push_back(char((v >> (8 * i)) & 0xff));
+}
+void put_f64(std::string &b, double v) {
+  uint64_t bits;
+  memcpy(&bits, &v, 8);
+  put_u64(b, bits);
+}
+void put_bytes(std::string &b, const uint8_t *p, size_t n) {
+  put_u32(b, uint32_t(n));
+  b.append(reinterpret_cast<const char *>(p), n);
+}
+
+struct Reader {
+  const uint8_t *p;
+  size_t n, off = 0;
+  bool ok = true;
+  Reader(const std::string &s)
+      : p(reinterpret_cast<const uint8_t *>(s.data())), n(s.size()) {}
+  bool need(size_t k) {
+    if (off + k > n) ok = false;
+    return ok;
+  }
+  uint16_t u16() {
+    if (!need(2)) return 0;
+    uint16_t v = (uint16_t(p[off]) << 8) | p[off + 1];
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v = (v << 8) | p[off + i];
+    off += 4;
+    return v;
+  }
+  uint64_t u64() {
+    if (!need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | p[off + i];
+    off += 8;
+    return v;
+  }
+  double f64() {
+    uint64_t bits = u64();
+    double v;
+    memcpy(&v, &bits, 8);
+    return v;
+  }
+  std::string bytes() {
+    uint32_t k = u32();
+    if (!need(k)) return {};
+    std::string s(reinterpret_cast<const char *>(p + off), k);
+    off += k;
+    return s;
+  }
+  NodeId id() {
+    NodeId v{};
+    if (!need(32)) return v;
+    memcpy(v.data(), p + off, 32);
+    off += 32;
+    return v;
+  }
+};
+
+/* ---------- sockets ---------- */
+
+void set_timeouts(int fd, int ms) {
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+bool write_all(int fd, const char *p, size_t n) {
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= size_t(k);
+  }
+  return true;
+}
+
+bool read_all(int fd, char *p, size_t n) {
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= size_t(k);
+  }
+  return true;
+}
+
+/* Largest acceptable inbound frame. Tensor parts on the data plane are a
+ * few MiB (the averager chunks them); anything bigger is a malformed or
+ * hostile frame and must not drive a multi-GiB allocation in a handler. */
+constexpr size_t kMaxFrame = 64u << 20;
+
+/* frame = u32 length || payload */
+bool write_frame(int fd, const std::string &payload) {
+  if (payload.size() > kMaxFrame) return false;
+  std::string hdr;
+  put_u32(hdr, uint32_t(payload.size()));
+  return write_all(fd, hdr.data(), 4) &&
+         write_all(fd, payload.data(), payload.size());
+}
+
+bool read_frame(int fd, std::string *out, size_t max_len = kMaxFrame) {
+  char hdr[4];
+  if (!read_all(fd, hdr, 4)) return false;
+  uint32_t len = 0;
+  for (int i = 0; i < 4; ++i) len = (len << 8) | uint8_t(hdr[i]);
+  if (len > max_len) return false;
+  out->resize(len);
+  return read_all(fd, out->data(), len);
+}
+
+int connect_to(const char *host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return -1;
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd >= 0) {
+    set_timeouts(fd, timeout_ms);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      close(fd);
+      fd = -1;
+    }
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+/* ---------- Kademlia routing ---------- */
+
+struct PeerInfo {
+  NodeId id{};
+  std::string host;
+  uint16_t port = 0;  // 0 = client-mode peer, not routable
+};
+
+NodeId xor_dist(const NodeId &a, const NodeId &b) {
+  NodeId d;
+  for (int i = 0; i < 32; ++i) d[i] = a[i] ^ b[i];
+  return d;
+}
+
+/* index of the first set bit (0 = most significant); 256 if equal */
+int bucket_index(const NodeId &d) {
+  for (int i = 0; i < 32; ++i)
+    if (d[i])
+      for (int b = 7; b >= 0; --b)
+        if (d[i] & (1 << b)) return i * 8 + (7 - b);
+  return 256;
+}
+
+class RoutingTable {
+ public:
+  explicit RoutingTable(const NodeId &self) : self_(self) {}
+
+  void update(const PeerInfo &peer) {
+    if (peer.port == 0 || peer.id == self_) return;  // unroutable / self
+    int idx = bucket_index(xor_dist(self_, peer.id));
+    if (idx >= 256) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto &bucket = buckets_[idx];
+    for (auto it = bucket.begin(); it != bucket.end(); ++it)
+      if (it->id == peer.id) {
+        bucket.erase(it);
+        break;
+      }
+    bucket.push_front(peer);               // most-recently-seen first
+    if (bucket.size() > kBucketSize) bucket.pop_back();
+  }
+
+  void remove(const NodeId &id) {
+    int idx = bucket_index(xor_dist(self_, id));
+    if (idx >= 256) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto &bucket = buckets_[idx];
+    for (auto it = bucket.begin(); it != bucket.end(); ++it)
+      if (it->id == id) {
+        bucket.erase(it);
+        return;
+      }
+  }
+
+  std::vector<PeerInfo> closest(const NodeId &target, size_t k) const {
+    std::vector<PeerInfo> all;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (const auto &b : buckets_) all.insert(all.end(), b.begin(), b.end());
+    }
+    std::sort(all.begin(), all.end(),
+              [&](const PeerInfo &x, const PeerInfo &y) {
+                return xor_dist(x.id, target) < xor_dist(y.id, target);
+              });
+    if (all.size() > k) all.resize(k);
+    return all;
+  }
+
+  std::vector<PeerInfo> dump() const {
+    std::vector<PeerInfo> all;
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto &b : buckets_) all.insert(all.end(), b.begin(), b.end());
+    return all;
+  }
+
+ private:
+  NodeId self_;
+  mutable std::mutex mu_;
+  std::deque<PeerInfo> buckets_[256];
+};
+
+/* ---------- record store ---------- */
+
+struct Record {
+  std::string value;
+  double expiration;
+};
+
+class RecordStore {
+ public:
+  /* Newest expiration wins per (key, subkey) — hivemind's freshness rule. */
+  void put(const NodeId &key, const std::string &subkey,
+           const std::string &value, double expiration) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto &slot = data_[key][subkey];
+    if (expiration >= slot.expiration) slot = {value, expiration};
+  }
+
+  std::map<std::string, Record> get(const NodeId &key) {
+    std::lock_guard<std::mutex> g(mu_);
+    gc_locked();
+    auto it = data_.find(key);
+    if (it == data_.end()) return {};
+    return it->second;
+  }
+
+ private:
+  void gc_locked() {
+    double t = now_unix();
+    for (auto it = data_.begin(); it != data_.end();) {
+      auto &subs = it->second;
+      for (auto s = subs.begin(); s != subs.end();)
+        s = (s->second.expiration < t) ? subs.erase(s) : std::next(s);
+      it = subs.empty() ? data_.erase(it) : std::next(it);
+    }
+  }
+  std::mutex mu_;
+  std::map<NodeId, std::map<std::string, Record>> data_;
+};
+
+}  // namespace
+
+/* ---------- the node ---------- */
+
+struct SwarmNode {
+  NodeId id{};
+  std::string host;
+  int listen_port = 0;
+  bool client_mode = false;
+  int listen_fd = -1;
+  std::atomic<bool> running{true};
+  std::atomic<int> timeout_ms{5000};
+  std::thread acceptor;
+  std::atomic<int> live_handlers{0};
+
+  RoutingTable rt;
+  RecordStore store;
+
+  /* data plane: per-tag FIFO queues */
+  std::mutex msg_mu;
+  std::condition_variable msg_cv;
+  std::map<uint64_t, std::deque<std::string>> msgs;
+
+  explicit SwarmNode(const NodeId &id_) : id(id_), rt(id_) {}
+
+  std::string header() const {
+    std::string h;
+    h.append(reinterpret_cast<const char *>(id.data()), 32);
+    put_u16(h, client_mode ? 0 : uint16_t(listen_port));
+    return h;
+  }
+
+  /* Build request = type || header || body, exchange over one connection.
+   * timeout_override_ms > 0 applies to this call only. */
+  bool rpc(const std::string &host_, int port_, uint8_t type,
+           const std::string &body, std::string *reply,
+           int timeout_override_ms = 0) {
+    int fd = connect_to(host_.c_str(), port_,
+                        timeout_override_ms > 0 ? timeout_override_ms
+                                                : timeout_ms.load());
+    if (fd < 0) return false;
+    std::string req;
+    req.push_back(char(type));
+    req += header();
+    req += body;
+    bool ok = write_frame(fd, req) && read_frame(fd, reply);
+    close(fd);
+    return ok && !reply->empty();
+  }
+
+  void note_peer(const PeerInfo &p) { rt.update(p); }
+
+  /* Handle one inbound request; returns the reply frame payload. */
+  std::string handle(const std::string &req, const std::string &peer_host) {
+    Reader r(req);
+    if (!r.need(1)) return {};
+    uint8_t type = r.p[r.off];
+    r.off += 1;
+    PeerInfo sender{r.id(), peer_host, r.u16()};
+    if (!r.ok) return {};
+    note_peer(sender);
+
+    std::string rep;
+    switch (type) {
+      case kPing: {
+        rep.push_back(char(kPong));
+        rep += header();
+        break;
+      }
+      case kStore: {
+        NodeId key = r.id();
+        std::string subkey = r.bytes(), value = r.bytes();
+        double exp = r.f64();
+        if (!r.ok) return {};
+        store.put(key, subkey, value, exp);
+        rep.push_back(char(kStoreOk));
+        break;
+      }
+      case kFindNode: {
+        NodeId target = r.id();
+        if (!r.ok) return {};
+        rep.push_back(char(kNodes));
+        append_nodes(rep, rt.closest(target, kBucketSize));
+        break;
+      }
+      case kFindValue: {
+        NodeId key = r.id();
+        if (!r.ok) return {};
+        auto found = store.get(key);
+        if (!found.empty()) {
+          rep.push_back(char(kValue));
+          put_u32(rep, uint32_t(found.size()));
+          for (auto &kv : found) {
+            put_bytes(rep, reinterpret_cast<const uint8_t *>(kv.first.data()),
+                      kv.first.size());
+            put_bytes(rep,
+                      reinterpret_cast<const uint8_t *>(kv.second.value.data()),
+                      kv.second.value.size());
+            put_f64(rep, kv.second.expiration);
+          }
+        } else {
+          rep.push_back(char(kNodes));
+          append_nodes(rep, rt.closest(key, kBucketSize));
+        }
+        break;
+      }
+      case kMsg: {
+        uint64_t tag = r.u64();
+        std::string payload = r.bytes();
+        if (!r.ok) return {};
+        {
+          std::lock_guard<std::mutex> g(msg_mu);
+          msgs[tag].push_back(std::move(payload));
+        }
+        msg_cv.notify_all();
+        rep.push_back(char(kMsgOk));
+        break;
+      }
+      default:
+        return {};
+    }
+    return rep;
+  }
+
+  static void append_nodes(std::string &rep,
+                           const std::vector<PeerInfo> &nodes) {
+    put_u32(rep, uint32_t(nodes.size()));
+    for (const auto &n : nodes) {
+      rep.append(reinterpret_cast<const char *>(n.id.data()), 32);
+      put_bytes(rep, reinterpret_cast<const uint8_t *>(n.host.data()),
+                n.host.size());
+      put_u16(rep, n.port);
+    }
+  }
+
+  static std::vector<PeerInfo> parse_nodes(Reader &r) {
+    std::vector<PeerInfo> out;
+    uint32_t n = r.u32();
+    for (uint32_t i = 0; i < n && r.ok; ++i) {
+      PeerInfo p;
+      p.id = r.id();
+      p.host = r.bytes();
+      p.port = r.u16();
+      if (r.ok) out.push_back(std::move(p));
+    }
+    return out;
+  }
+
+  /* Iterative node lookup (Kademlia): returns up to k closest live peers.
+   * When collect_values != nullptr, FIND_VALUE is used and every VALUE
+   * reply is merged into *collect_values (latest expiration wins). */
+  std::vector<PeerInfo> lookup(const NodeId &target,
+                               std::map<std::string, Record> *collect_values) {
+    auto cmp = [&](const PeerInfo &x, const PeerInfo &y) {
+      return xor_dist(x.id, target) < xor_dist(y.id, target);
+    };
+    std::vector<PeerInfo> shortlist = rt.closest(target, kBucketSize);
+    std::set<NodeId> queried, known;
+    for (auto &p : shortlist) known.insert(p.id);
+
+    while (running.load()) {
+      /* pick up to alpha unqueried peers nearest the target */
+      std::sort(shortlist.begin(), shortlist.end(), cmp);
+      std::vector<PeerInfo> batch;
+      for (const auto &p : shortlist) {
+        if (queried.count(p.id)) continue;
+        batch.push_back(p);
+        if (batch.size() >= kAlpha) break;
+      }
+      if (batch.empty()) break;
+
+      bool learned = false;
+      for (const auto &p : batch) {
+        queried.insert(p.id);
+        std::string body(reinterpret_cast<const char *>(target.data()), 32);
+        std::string reply;
+        uint8_t q = collect_values ? kFindValue : kFindNode;
+        if (!rpc(p.host, p.port, q, body, &reply)) {
+          rt.remove(p.id);  // unresponsive peers drop out (elasticity)
+          continue;
+        }
+        Reader r(reply);
+        if (!r.need(1)) continue;
+        uint8_t rtype = r.p[r.off];
+        r.off += 1;
+        if (rtype == kValue && collect_values) {
+          uint32_t cnt = r.u32();
+          for (uint32_t i = 0; i < cnt && r.ok; ++i) {
+            std::string sk = r.bytes(), val = r.bytes();
+            double exp = r.f64();
+            if (!r.ok) break;
+            auto it = collect_values->find(sk);
+            if (it == collect_values->end() || exp >= it->second.expiration)
+              (*collect_values)[sk] = {val, exp};
+          }
+        } else if (rtype == kNodes) {
+          for (auto &n : parse_nodes(r)) {
+            note_peer(n);
+            if (known.insert(n.id).second) {
+              shortlist.push_back(n);
+              learned = true;
+            }
+          }
+        }
+      }
+      if (!learned && queried.size() >= std::min(shortlist.size(),
+                                                 size_t(kBucketSize)))
+        break;
+    }
+    std::sort(shortlist.begin(), shortlist.end(), cmp);
+    std::vector<PeerInfo> live;
+    for (const auto &p : shortlist) {
+      if (queried.count(p.id) && live.size() < kBucketSize) live.push_back(p);
+      /* peers that failed rpc were removed from rt but may linger in
+       * shortlist; they were never re-added, so keep only queried ones */
+    }
+    if (live.empty()) live = shortlist;  // nothing queried: fall back
+    if (live.size() > kBucketSize) live.resize(kBucketSize);
+    return live;
+  }
+
+  void serve() {
+    while (running.load()) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof peer;
+      int cfd = accept(listen_fd, reinterpret_cast<sockaddr *>(&peer), &plen);
+      if (cfd < 0) {
+        if (!running.load()) break;
+        continue;
+      }
+      char ip[INET_ADDRSTRLEN] = "127.0.0.1";
+      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof ip);
+      set_timeouts(cfd, timeout_ms.load());
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      live_handlers.fetch_add(1);
+      std::thread([this, cfd, host = std::string(ip)] {
+        try {
+          std::string req;
+          if (read_frame(cfd, &req)) {
+            std::string rep = handle(req, host);
+            if (!rep.empty()) write_frame(cfd, rep);
+          }
+        } catch (...) {
+          /* bad_alloc on a hostile frame etc. must not terminate() */
+        }
+        close(cfd);
+        live_handlers.fetch_sub(1);
+      }).detach();
+    }
+  }
+};
+
+/* ---------- C API ---------- */
+
+extern "C" {
+
+SwarmNode *swarm_node_create(const char *host, int port, const uint8_t id[32],
+                             int client_mode) {
+  NodeId nid{};
+  memcpy(nid.data(), id, 32);
+  auto *node = new SwarmNode(nid);
+  node->host = host ? host : "127.0.0.1";
+  node->client_mode = client_mode != 0;
+  if (node->client_mode) return node;
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    delete node;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  inet_pton(AF_INET, node->host.c_str(), &addr.sin_addr);
+  if (bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    delete node;
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  node->listen_port = ntohs(addr.sin_port);
+  node->listen_fd = fd;
+  node->acceptor = std::thread([node] { node->serve(); });
+  return node;
+}
+
+int swarm_node_port(const SwarmNode *node) { return node->listen_port; }
+
+void swarm_node_set_timeout(SwarmNode *node, int timeout_ms) {
+  node->timeout_ms.store(timeout_ms);
+}
+
+int swarm_node_bootstrap(SwarmNode *node, const char *host, int port) {
+  std::string reply;
+  if (!node->rpc(host, port, kPing, "", &reply)) return -1;
+  Reader r(reply);
+  if (!r.need(1) || r.p[0] != kPong) return -1;
+  r.off = 1;
+  PeerInfo boot{r.id(), host, r.u16()};
+  if (!r.ok) return -1;
+  node->note_peer(boot);
+  node->lookup(node->id, nullptr);  // iterative self-lookup fills buckets
+  return 0;
+}
+
+int swarm_node_store(SwarmNode *node, const uint8_t key[32],
+                     const uint8_t *subkey, size_t subkey_len,
+                     const uint8_t *value, size_t value_len,
+                     double expiration) {
+  NodeId k{};
+  memcpy(k.data(), key, 32);
+  std::string sk(reinterpret_cast<const char *>(subkey), subkey_len);
+  std::string val(reinterpret_cast<const char *>(value), value_len);
+  node->store.put(k, sk, val, expiration);  // local replica
+
+  auto targets = node->lookup(k, nullptr);
+  int ok = 0;
+  std::string body(reinterpret_cast<const char *>(k.data()), 32);
+  put_bytes(body, subkey, subkey_len);
+  put_bytes(body, value, value_len);
+  put_f64(body, expiration);
+  for (const auto &p : targets) {
+    std::string reply;
+    if (node->rpc(p.host, p.port, kStore, body, &reply) &&
+        !reply.empty() && uint8_t(reply[0]) == kStoreOk)
+      ++ok;
+  }
+  return ok;
+}
+
+uint8_t *swarm_node_get(SwarmNode *node, const uint8_t key[32],
+                        size_t *out_len) {
+  NodeId k{};
+  memcpy(k.data(), key, 32);
+  std::map<std::string, Record> merged;
+  double t = now_unix();
+  for (auto &kv : node->store.get(k))
+    if (kv.second.expiration >= t) merged[kv.first] = kv.second;
+  node->lookup(k, &merged);
+
+  std::string out;
+  uint32_t cnt = 0;
+  std::string entries;
+  for (auto &kv : merged) {
+    if (kv.second.expiration < t) continue;
+    put_bytes(entries, reinterpret_cast<const uint8_t *>(kv.first.data()),
+              kv.first.size());
+    put_bytes(entries,
+              reinterpret_cast<const uint8_t *>(kv.second.value.data()),
+              kv.second.value.size());
+    put_f64(entries, kv.second.expiration);
+    ++cnt;
+  }
+  if (cnt == 0) return nullptr;
+  put_u32(out, cnt);
+  out += entries;
+  auto *buf = static_cast<uint8_t *>(malloc(out.size()));
+  memcpy(buf, out.data(), out.size());
+  *out_len = out.size();
+  return buf;
+}
+
+int swarm_node_send(SwarmNode *node, const char *host, int port, uint64_t tag,
+                    const uint8_t *payload, size_t len, int timeout_ms) {
+  std::string body;
+  put_u64(body, tag);
+  put_bytes(body, payload, len);
+  std::string reply;
+  if (!node->rpc(host, port, kMsg, body, &reply, timeout_ms)) return -1;
+  return (!reply.empty() && uint8_t(reply[0]) == kMsgOk) ? 0 : -1;
+}
+
+uint8_t *swarm_node_recv(SwarmNode *node, uint64_t tag, int timeout_ms,
+                         size_t *out_len) {
+  std::unique_lock<std::mutex> lk(node->msg_mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    auto it = node->msgs.find(tag);
+    if (it != node->msgs.end() && !it->second.empty()) {
+      std::string payload = std::move(it->second.front());
+      it->second.pop_front();
+      if (it->second.empty()) node->msgs.erase(it);
+      lk.unlock();
+      auto *buf = static_cast<uint8_t *>(malloc(payload.size()));
+      memcpy(buf, payload.data(), payload.size());
+      *out_len = payload.size();
+      return buf;
+    }
+    if (node->msg_cv.wait_until(lk, deadline) == std::cv_status::timeout &&
+        std::chrono::steady_clock::now() >= deadline)
+      return nullptr;
+  }
+}
+
+uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len) {
+  auto peers = node->rt.dump();
+  std::string out;
+  put_u32(out, uint32_t(peers.size()));
+  for (const auto &p : peers) {
+    out.append(reinterpret_cast<const char *>(p.id.data()), 32);
+    put_bytes(out, reinterpret_cast<const uint8_t *>(p.host.data()),
+              p.host.size());
+    put_u16(out, p.port);
+  }
+  auto *buf = static_cast<uint8_t *>(malloc(out.size()));
+  memcpy(buf, out.data(), out.size());
+  *out_len = out.size();
+  return buf;
+}
+
+void swarm_node_destroy(SwarmNode *node) {
+  node->running.store(false);
+  if (node->listen_fd >= 0) {
+    shutdown(node->listen_fd, SHUT_RDWR);
+    close(node->listen_fd);
+  }
+  if (node->acceptor.joinable()) node->acceptor.join();
+  /* Wait for in-flight handler threads: they hold `node`, so deleting
+   * early is a use-after-free. The wait is bounded by the socket
+   * timeouts the handlers run under (SO_RCVTIMEO/SO_SNDTIMEO). */
+  while (node->live_handlers.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  delete node;
+}
+
+void swarm_free(uint8_t *buf) { free(buf); }
+
+}  /* extern "C" */

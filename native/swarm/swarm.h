@@ -1,0 +1,88 @@
+/* C API of the dalle_tpu swarm peer daemon.
+ *
+ * TPU-native equivalent of the reference's p2p substrate: the reference
+ * (learning-at-home/dalle) drives a go-libp2p-daemon ("p2pd", Go) through
+ * hivemind.DHT (task.py:104-114, arguments.py:93-124) for Kademlia routing,
+ * TTL'd record storage with subkeys, and peer-to-peer tensor part streams.
+ * This library provides the same substrate as an in-process C++ daemon:
+ * every node runs a TCP listener plus a Kademlia-style routing table and
+ * record store, and exposes a tagged message data plane for the butterfly
+ * all-reduce. Signing/validation of records is the Python layer's job
+ * (parity with hivemind, whose RecordValidators are Python classes around
+ * the Go transport — reference utils.py:27-30).
+ *
+ * Thread-safety: all functions are safe to call from any thread. Multiple
+ * nodes may live in one process (the localhost many-peer test strategy of
+ * SURVEY.md section 4).
+ */
+#ifndef DALLE_TPU_SWARM_H_
+#define DALLE_TPU_SWARM_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct SwarmNode SwarmNode;
+
+/* Create a node listening on host:port (port 0 = ephemeral). id must point
+ * at 32 bytes (sha256 of the peer's public key; the Python layer owns keys).
+ * client_mode != 0 => no listener: outbound-only peer (reference
+ * arguments.py:89-92). Returns NULL on failure. */
+SwarmNode *swarm_node_create(const char *host, int port,
+                             const uint8_t id[32], int client_mode);
+
+/* Bound listen port (network byte order resolved), or 0 in client mode. */
+int swarm_node_port(const SwarmNode *node);
+
+/* Ping a bootstrap address and run an iterative self-lookup to populate the
+ * routing table (reference initial_peers, arguments.py:100-106).
+ * Returns 0 on success. */
+int swarm_node_bootstrap(SwarmNode *node, const char *host, int port);
+
+/* Store key/subkey=value with absolute unix expiration time onto the k
+ * closest nodes (and locally). Returns number of remote replicas written
+ * (>=0), or -1 on total failure. */
+int swarm_node_store(SwarmNode *node, const uint8_t key[32],
+                     const uint8_t *subkey, size_t subkey_len,
+                     const uint8_t *value, size_t value_len,
+                     double expiration);
+
+/* Iterative FIND_VALUE. On success returns a malloc'd buffer (caller frees
+ * with swarm_free) holding the merged subkey map:
+ *   u32 count, then per entry: u32 subkey_len, subkey, u32 value_len,
+ *   value, f64 expiration (bits, big-endian).
+ * Expired entries are dropped; duplicate subkeys keep the latest
+ * expiration. Returns NULL if nothing found. */
+uint8_t *swarm_node_get(SwarmNode *node, const uint8_t key[32],
+                        size_t *out_len);
+
+/* Data plane: send a tagged message to a peer's listener. Blocks until
+ * acked or the timeout elapses (timeout_ms <= 0 uses the node-wide RPC
+ * timeout). Returns 0 on success. */
+int swarm_node_send(SwarmNode *node, const char *host, int port,
+                    uint64_t tag, const uint8_t *payload, size_t len,
+                    int timeout_ms);
+
+/* Pop the next message with this tag (FIFO per tag), waiting up to
+ * timeout_ms. Returns malloc'd payload (swarm_free) or NULL on timeout. */
+uint8_t *swarm_node_recv(SwarmNode *node, uint64_t tag, int timeout_ms,
+                         size_t *out_len);
+
+/* Routing table dump: malloc'd buffer of u32 count entries:
+ * 32B id, u32 host_len, host, u16 port (BE). */
+uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len);
+
+/* Set RPC timeout (connect+roundtrip) in ms. Default 5000. */
+void swarm_node_set_timeout(SwarmNode *node, int timeout_ms);
+
+void swarm_node_destroy(SwarmNode *node);
+void swarm_free(uint8_t *buf);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DALLE_TPU_SWARM_H_ */

@@ -1,0 +1,255 @@
+"""Swarm substrate tests: many real peers on loopback sockets.
+
+The strategy SURVEY.md §4 prescribes (and hivemind upstream uses): launch N
+DHT nodes in one process on 127.0.0.1, form a real swarm through real
+sockets, and produce fault cases by killing peers mid-protocol.
+"""
+
+import time
+
+import pytest
+from pydantic import BaseModel, StrictFloat, StrictInt, conint
+
+from dalle_tpu.swarm import (DHT, Identity, SchemaValidator,
+                             SignatureValidator, get_dht_time, strip_owner)
+
+
+def make_swarm(n, validators=lambda ident: [], **kwargs):
+    """n bootstrapped peers; caller must shutdown (or use fixture)."""
+    nodes = []
+    for _ in range(n):
+        ident = Identity.generate()
+        peers = [nodes[0].visible_address] if nodes else []
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         record_validators=validators(ident),
+                         rpc_timeout=2.0, **kwargs))
+    return nodes
+
+
+@pytest.fixture
+def swarm5():
+    nodes = make_swarm(5)
+    yield nodes
+    for n in nodes:
+        n.shutdown()
+
+
+class TestDHT:
+    def test_store_get_across_peers(self, swarm5):
+        exp = get_dht_time() + 60
+        assert swarm5[1].store("progress", "peerA", {"samples": 17}, exp)
+        got = swarm5[4].get("progress")
+        assert got is not None
+        assert got[b"peerA"].value == {"samples": 17}
+        assert got[b"peerA"].expiration_time == pytest.approx(exp)
+
+    def test_subkeys_merge_from_different_writers(self, swarm5):
+        exp = get_dht_time() + 60
+        swarm5[0].store("metrics", "a", 1, exp)
+        swarm5[2].store("metrics", "b", 2, exp)
+        got = swarm5[3].get("metrics")
+        assert got is not None and set(got) == {b"a", b"b"}
+
+    def test_latest_expiration_wins(self, swarm5):
+        t = get_dht_time()
+        swarm5[0].store("k", "s", "old", t + 30)
+        swarm5[1].store("k", "s", "new", t + 60)
+        got = swarm5[2].get("k")
+        assert got[b"s"].value == "new"
+
+    def test_expired_records_vanish(self, swarm5):
+        swarm5[0].store("ephemeral", "s", 1, get_dht_time() + 0.5)
+        assert swarm5[1].get("ephemeral") is not None
+        time.sleep(0.8)
+        assert swarm5[2].get("ephemeral") is None
+
+    def test_missing_key_returns_none(self, swarm5):
+        assert swarm5[0].get("no-such-key") is None
+
+    def test_peer_death_does_not_break_lookup(self):
+        nodes = make_swarm(6)
+        try:
+            exp = get_dht_time() + 60
+            nodes[1].store("sturdy", "s", "v", exp)
+            nodes[2].shutdown()  # a volunteer leaves ungracefully
+            got = nodes[5].get("sturdy")
+            assert got is not None and got[b"s"].value == "v"
+        finally:
+            for i, n in enumerate(nodes):
+                if i != 2:
+                    n.shutdown()
+
+    def test_client_mode_can_read_and_write(self):
+        nodes = make_swarm(3)
+        client = DHT(initial_peers=[nodes[0].visible_address],
+                     client_mode=True, rpc_timeout=2.0)
+        try:
+            assert client.port == 0
+            exp = get_dht_time() + 60
+            assert client.store("from-client", "c", 42, exp)
+            assert nodes[2].get("from-client")[b"c"].value == 42
+            # and other peers never route to the client
+            for n in nodes:
+                assert client.peer_id not in n.peers()
+        finally:
+            client.shutdown()
+            for n in nodes:
+                n.shutdown()
+
+
+class TestSignatures:
+    @staticmethod
+    def _mk(ident):
+        return [SignatureValidator(ident)]
+
+    @staticmethod
+    def _by_clean_subkey(got):
+        return {strip_owner(k): v for k, v in (got or {}).items()}
+
+    def test_signed_roundtrip(self):
+        nodes = make_swarm(3, validators=self._mk)
+        try:
+            exp = get_dht_time() + 60
+            nodes[0].store("signed", "me", {"loss": 1.5}, exp)
+            got = self._by_clean_subkey(nodes[2].get("signed"))
+            assert got[b"me"].value == {"loss": 1.5}
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_forged_record_rejected(self):
+        """A peer storing under another's owner marker gets dropped on read
+        (the reference's RSA validator guarantee, utils.py:27-30)."""
+        honest, reader = Identity.generate(), Identity.generate()
+        nodes = []
+        nodes.append(DHT(identity=honest,
+                         record_validators=[SignatureValidator(honest)],
+                         rpc_timeout=2.0))
+        forger_ident = Identity.generate()
+        forger = DHT(initial_peers=[nodes[0].visible_address],
+                     identity=forger_ident, rpc_timeout=2.0)  # no validator
+        nodes.append(forger)
+        nodes.append(DHT(initial_peers=[nodes[0].visible_address],
+                         identity=reader,
+                         record_validators=[SignatureValidator(reader)],
+                         rpc_timeout=2.0))
+        try:
+            exp = get_dht_time() + 60
+            # forge: subkey claims honest's identity, signature is garbage
+            marker = SignatureValidator(honest).ownership_marker
+            import msgpack as _mp
+            forged_val = _mp.packb("forged") + b"\x00" * 64
+            forger._lib.swarm_node_store(
+                forger._node, __import__("hashlib").sha256(b"sig-k").digest(),
+                b"victim" + marker, len(b"victim" + marker),
+                forged_val, len(forged_val), exp)
+            got = self._by_clean_subkey(nodes[2].get("sig-k"))
+            assert b"victim" not in got
+            # while a genuinely signed record passes
+            nodes[0].store("sig-k", "victim", "real", exp)
+            got = self._by_clean_subkey(nodes[2].get("sig-k"))
+            assert got[b"victim"].value == "real"
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_unsigned_cannot_shadow_signed(self):
+        """An unsigned record with the bare subkey must not displace a
+        signed one, and protected keys reject unsigned records entirely."""
+        honest = Identity.generate()
+        reader_v = [SignatureValidator(Identity.generate(),
+                                       protected_keys=["guarded"])]
+        bootstrap = DHT(identity=honest,
+                        record_validators=[SignatureValidator(
+                            honest, protected_keys=["guarded"])],
+                        rpc_timeout=2.0)
+        attacker = DHT(initial_peers=[bootstrap.visible_address],
+                       rpc_timeout=2.0)  # writes unsigned records
+        reader = DHT(initial_peers=[bootstrap.visible_address],
+                     record_validators=reader_v, rpc_timeout=2.0)
+        try:
+            t = get_dht_time()
+            bootstrap.store("guarded", "victim", "signed-truth", t + 30)
+            attacker.store("guarded", "victim", "poison", t + 3000)
+            got = reader.get("guarded")
+            values = [v.value for v in got.values()]
+            assert values == ["signed-truth"]
+        finally:
+            for n in (bootstrap, attacker, reader):
+                n.shutdown()
+
+
+class LocalMetrics(BaseModel):
+    """Reference utils.py:15-21 schema."""
+    step: conint(ge=0, strict=True)
+    samples_per_second: StrictFloat
+    samples_accumulated: StrictInt
+    loss: StrictFloat
+    mini_steps: StrictInt
+
+
+class TestSchema:
+    def test_schema_rejects_malformed(self):
+        schemas = {"m_metrics": LocalMetrics}
+
+        def mk(ident):
+            return [SchemaValidator(schemas)]
+
+        nodes = make_swarm(3, validators=mk)
+        try:
+            exp = get_dht_time() + 60
+            good = {"step": 1, "samples_per_second": 8.0,
+                    "samples_accumulated": 64, "loss": 2.5, "mini_steps": 4}
+            nodes[0].store("m_metrics", "p0", good, exp)
+            nodes[1].store("m_metrics", "p1", {"step": "NaN-garbage"}, exp)
+            got = nodes[2].get("m_metrics")
+            assert b"p0" in got and b"p1" not in got
+            # non-schema'd keys unaffected
+            nodes[0].store("other", "x", "anything", exp)
+            assert nodes[2].get("other")[b"x"].value == "anything"
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+
+class TestDataPlane:
+    def test_send_recv_tagged_fifo(self, swarm5):
+        addr = swarm5[3].visible_address
+        assert swarm5[0].send(addr, tag=7, payload=b"part-0")
+        assert swarm5[1].send(addr, tag=7, payload=b"part-1")
+        assert swarm5[2].send(addr, tag=9, payload=b"other-channel")
+        assert swarm5[3].recv(9, timeout=2.0) == b"other-channel"
+        first = swarm5[3].recv(7, timeout=2.0)
+        second = swarm5[3].recv(7, timeout=2.0)
+        assert {first, second} == {b"part-0", b"part-1"}
+
+    def test_recv_timeout_returns_none(self, swarm5):
+        t0 = time.monotonic()
+        assert swarm5[0].recv(12345, timeout=0.3) is None
+        assert 0.2 < time.monotonic() - t0 < 2.0
+
+    def test_large_payload(self, swarm5):
+        blob = bytes(range(256)) * 4096 * 4  # 4 MiB tensor part
+        assert swarm5[0].send(swarm5[1].visible_address, 1, blob)
+        assert swarm5[1].recv(1, timeout=5.0) == blob
+
+    def test_send_to_dead_peer_fails_fast(self, swarm5):
+        t0 = time.monotonic()
+        ok = swarm5[0].send("127.0.0.1:1", tag=1, payload=b"x")
+        assert not ok
+        assert time.monotonic() - t0 < 3.0
+
+
+class TestIdentity:
+    def test_persisted_identity_roundtrip(self, tmp_path):
+        p = str(tmp_path / "id.pem")
+        a = Identity.load_or_create(p)
+        b = Identity.load_or_create(p)
+        assert a.node_id == b.node_id
+        assert Identity.generate().node_id != a.node_id
+
+    def test_sign_verify(self):
+        ident = Identity.generate()
+        sig = ident.sign(b"msg")
+        assert Identity.verify(ident.public_bytes, sig, b"msg")
+        assert not Identity.verify(ident.public_bytes, sig, b"tampered")
