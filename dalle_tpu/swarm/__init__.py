@@ -12,8 +12,22 @@ from dalle_tpu.swarm.dht import (DHT, RecordValidatorBase, SchemaValidator,
                                  strip_owner)
 from dalle_tpu.swarm.identity import Identity
 
+
+def __getattr__(name):
+    # Heavier layers (jax-dependent optimizer, averaging protocol) load on
+    # first use so `import dalle_tpu.swarm` stays cheap for CLI tools.
+    if name == "CollaborativeOptimizer":
+        from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+        return CollaborativeOptimizer
+    if name == "ProgressTracker":
+        from dalle_tpu.swarm.progress import ProgressTracker
+        return ProgressTracker
+    raise AttributeError(name)
+
+
 __all__ = [
     "DHT", "Identity", "RecordValidatorBase", "SchemaValidator",
     "SignatureValidator", "ValueWithExpiration", "get_dht_time", "key_hash",
-    "owner_public_key", "strip_owner",
+    "owner_public_key", "strip_owner", "CollaborativeOptimizer",
+    "ProgressTracker",
 ]

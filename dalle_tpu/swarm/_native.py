@@ -39,10 +39,18 @@ def load() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
+        # content-hash staleness check: mtimes are unreliable after git
+        # checkouts (source and binary both get checkout-time stamps)
+        import hashlib
         src = _NATIVE_DIR / "swarm" / "swarm.cc"
-        if (not _LIB_PATH.exists()
-                or _LIB_PATH.stat().st_mtime < src.stat().st_mtime):
+        hdr = _NATIVE_DIR / "swarm" / "swarm.h"
+        digest = hashlib.sha256(
+            src.read_bytes() + hdr.read_bytes()).hexdigest()
+        stamp = _LIB_PATH.with_suffix(".sha256")
+        if (not _LIB_PATH.exists() or not stamp.exists()
+                or stamp.read_text().strip() != digest):
             _build()
+            stamp.write_text(digest)
         lib = ctypes.CDLL(str(_LIB_PATH))
 
         lib.swarm_node_create.restype = ctypes.c_void_p
@@ -70,6 +78,14 @@ def load() -> ctypes.CDLL:
         lib.swarm_node_recv.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
             ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_post.restype = ctypes.c_int
+        lib.swarm_node_post.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_double]
+        lib.swarm_node_fetch.restype = ctypes.c_void_p
+        lib.swarm_node_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
         lib.swarm_node_peers.restype = ctypes.c_void_p
         lib.swarm_node_peers.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]

@@ -1,0 +1,262 @@
+"""Butterfly all-reduce over the swarm data plane.
+
+Capability parity with hivemind's ``AllReduceRunner`` (the averaging hot
+path behind ``hivemind.Optimizer`` — reference SURVEY §2 #14: "each tensor
+is flattened, concatenated, chunked into parts; each group member
+reduce-scatters its part, averages, then all-gathers", with per-part
+compression chosen at task.py:125-126 and timeout/ban elasticity at
+arguments.py:69-74).
+
+Shape of one round, group of N members (sorted by peer id), member i:
+
+  scatter  — split the flattened concat vector into N contiguous parts;
+             send my local data for part j to member j (compressed).
+  reduce   — collect the other N-1 members' chunks of part i until
+             ``allreduce_timeout``; average with per-peer sample weights;
+             senders that miss the deadline are simply excluded (their
+             weight is dropped) — hivemind's ban-and-proceed.
+  gather   — send the averaged part i to every member; collect the other
+             averaged parts; parts whose owner died fall back to this
+             peer's locally-weighted value, so the round always returns.
+
+Every message carries the 16-byte group hash from matchmaking; chunks from
+a peer with a divergent group view are dropped (it effectively leaves the
+group). Client-mode members (no listener) contribute weight=their samples
+but own no part and receive nothing; their data still reaches part owners
+because *they* send in the scatter phase.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import struct
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dalle_tpu.swarm import compression
+from dalle_tpu.swarm.dht import DHT
+from dalle_tpu.swarm.matchmaking import AveragingGroup
+
+# group_hash, sender_index, weight, n_elems, codec
+_HDR = struct.Struct(">16sIdIB")
+
+
+def _tag(prefix: str, epoch: int, phase: str, receiver: str) -> int:
+    digest = hashlib.sha256(
+        f"{prefix}:ar:{epoch}:{phase}:{receiver}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def _part_slices(total: int, owners: int) -> List[Tuple[int, int]]:
+    """Contiguous [start, stop) per part, like np.array_split bounds."""
+    base, rem = divmod(total, owners)
+    out, start = [], 0
+    for k in range(owners):
+        stop = start + base + (1 if k < rem else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def flatten_tensors(tensors: Sequence[np.ndarray]) -> np.ndarray:
+    return np.concatenate(
+        [np.asarray(t, np.float32).reshape(-1) for t in tensors]) \
+        if tensors else np.zeros((0,), np.float32)
+
+
+def unflatten_tensors(flat: np.ndarray,
+                      like: Sequence[np.ndarray]) -> List[np.ndarray]:
+    out, off = [], 0
+    for t in like:
+        n = int(np.prod(t.shape)) if t.shape else 1
+        out.append(flat[off:off + n].reshape(t.shape).astype(np.float32))
+        off += n
+    return out
+
+
+def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
+                  tensors: Sequence[np.ndarray], weight: float,
+                  allreduce_timeout: float = 60.0,
+                  codec: Optional[int] = None,
+                  adaptive_threshold: int =
+                  compression.SIZE_ADAPTIVE_THRESHOLD) -> List[np.ndarray]:
+    """Weighted-average ``tensors`` across the group; returns new arrays.
+
+    ``weight`` is this peer's contribution weight (its accumulated sample
+    count, hivemind's per-peer weighting). ``codec=None`` selects
+    SizeAdaptive per part with ``adaptive_threshold``; receivers decode
+    whatever codec the wire header names.
+    """
+    flat = flatten_tensors(tensors)
+    owners = [m for m in group.members if m.addr]  # part owners
+    if group.size <= 1 or not owners or flat.size == 0:
+        return [np.array(t, np.float32, copy=True) for t in tensors]
+
+    me = group.members[group.my_index]
+    owner_index = {m.peer_id: k for k, m in enumerate(owners)}
+    my_part = owner_index.get(me.peer_id)  # None in client mode
+    slices = _part_slices(flat.size, len(owners))
+    deadline = time.monotonic() + allreduce_timeout
+
+    def part_codec(n: int) -> int:
+        if codec is None:
+            return compression.adaptive_codec(n, adaptive_threshold)
+        return codec
+
+    def send_chunk(addr: str, tag: int, body: bytes) -> bool:
+        remaining = max(0.1, deadline - time.monotonic())
+        return dht.send(addr, tag, body, timeout=remaining)
+
+    # --- scatter: my data for part k -> owner k -------------------------
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(owners))) as pool:
+        futures = []
+        for k, owner in enumerate(owners):
+            if k == my_part:
+                continue
+            lo, hi = slices[k]
+            chunk = flat[lo:hi]
+            c = part_codec(chunk.size)
+            body = _HDR.pack(group.group_hash, group.my_index, weight,
+                             chunk.size, c) + compression.compress(chunk, c)
+            futures.append(pool.submit(
+                send_chunk, owner.addr,
+                _tag(prefix, epoch, "scatter", owner.peer_id), body))
+
+        # --- reduce my part while scatter sends run ---------------------
+        averaged_mine: Optional[np.ndarray] = None
+        if my_part is not None:
+            lo, hi = slices[my_part]
+            mine = flat[lo:hi]
+            acc = mine * weight
+            total_w = weight
+            expected = {i for i, m in enumerate(group.members)
+                        if m.peer_id != me.peer_id}
+            my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
+            while expected and time.monotonic() < deadline:
+                raw = dht.recv(my_tag, timeout=min(
+                    0.5, max(0.05, deadline - time.monotonic())))
+                if raw is None:
+                    continue
+                parsed = _parse(raw, group, hi - lo)
+                if parsed is None:
+                    continue
+                sender, w, data = parsed
+                if sender not in expected:
+                    continue  # duplicate
+                expected.discard(sender)
+                acc += data * w
+                total_w += w
+            averaged_mine = acc / total_w
+
+        concurrent.futures.wait(futures)
+
+    # --- gather: averaged part i -> everyone; collect the rest ----------
+    out = flat.copy()
+    if my_part is not None:
+        lo, hi = slices[my_part]
+        out[lo:hi] = averaged_mine
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, group.size)) as pool:
+        futures = []
+        if my_part is not None:
+            c = part_codec(averaged_mine.size)
+            body = _HDR.pack(group.group_hash, group.my_index, 1.0,
+                             averaged_mine.size, c) \
+                + compression.compress(averaged_mine, c)
+            for m in group.members:
+                if m.peer_id == me.peer_id or not m.addr:
+                    continue
+                futures.append(pool.submit(
+                    send_chunk, m.addr,
+                    _tag(prefix, epoch, "gather", m.peer_id), body))
+            if any(not m.addr for m in group.members):
+                # client-mode members can't receive pushes: publish the
+                # averaged part in this owner's mailbox for them to pull
+                dht.post(_tag(prefix, epoch, "mailbox", me.peer_id), body,
+                         expiration_time=time.time()
+                         + 2 * allreduce_timeout)
+
+        if me.addr:  # client-mode peers receive no gather traffic
+            pending: Dict[int, Tuple[int, int]] = {
+                owner_index[m.peer_id]: slices[owner_index[m.peer_id]]
+                for m in owners if m.peer_id != me.peer_id}
+            sender_to_part = {
+                group.members.index(m): owner_index[m.peer_id]
+                for m in owners}
+            gather_tag = _tag(prefix, epoch, "gather", me.peer_id)
+            while pending and time.monotonic() < deadline:
+                raw = dht.recv(gather_tag, timeout=min(
+                    0.5, max(0.05, deadline - time.monotonic())))
+                if raw is None:
+                    continue
+                head = _peek(raw, group)
+                if head is None:
+                    continue
+                sender, _w = head
+                part = sender_to_part.get(sender)
+                if part is None or part not in pending:
+                    continue
+                lo, hi = pending[part]
+                parsed = _parse(raw, group, hi - lo)
+                if parsed is None:
+                    continue
+                _, _, data = parsed
+                out[lo:hi] = data
+                del pending[part]
+            # parts never received keep this peer's local values (owner
+            # died mid-round): degraded but well-defined
+        else:
+            # client mode: pull each averaged part from its owner's mailbox
+            pending = {k: m for k, m in enumerate(owners)}
+            while pending and time.monotonic() < deadline:
+                for k, owner in list(pending.items()):
+                    raw = dht.fetch(
+                        owner.addr, _tag(prefix, epoch, "mailbox",
+                                         owner.peer_id),
+                        timeout=min(2.0, max(
+                            0.1, deadline - time.monotonic())))
+                    if raw is None:
+                        continue
+                    lo, hi = slices[k]
+                    parsed = _parse(raw, group, hi - lo)
+                    if parsed is None:
+                        continue
+                    _, _, data = parsed
+                    out[lo:hi] = data
+                    del pending[k]
+                if pending:
+                    time.sleep(0.1)
+
+    return unflatten_tensors(out, tensors)
+
+
+def _peek(raw: bytes, group: AveragingGroup
+          ) -> Optional[Tuple[int, float]]:
+    if len(raw) < _HDR.size:
+        return None
+    ghash, sender, w, _n, _c = _HDR.unpack_from(raw)
+    if ghash != group.group_hash or not (0 <= sender < group.size):
+        return None
+    return sender, w
+
+
+def _parse(raw: bytes, group: AveragingGroup, expect_n: int
+           ) -> Optional[Tuple[int, float, np.ndarray]]:
+    head = _peek(raw, group)
+    if head is None:
+        return None
+    sender, w = head
+    _, _, _, n, codec = _HDR.unpack_from(raw)
+    if n != expect_n:
+        return None
+    body = raw[_HDR.size:]
+    try:
+        data = compression.decompress(body, codec, n)
+    except (ValueError, struct.error):
+        return None
+    return sender, float(w), data

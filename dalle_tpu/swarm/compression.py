@@ -1,0 +1,123 @@
+"""Wire compression codecs for swarm averaging.
+
+Capability parity with the reference's gradient/state compression choice
+(learning-at-home/dalle task.py:12,125-126):
+
+    SizeAdaptiveCompression(threshold=2**16 + 1, less=Float16Compression(),
+                            greater_equal=Uniform8BitQuantization())
+
+Codecs operate on host numpy arrays (the butterfly all-reduce runs on the
+host seam, once per swarm epoch — the device path stays uncompressed
+bfloat16/fp32 inside XLA). Each codec turns an ndarray into bytes and back;
+:func:`pack_array` / :func:`unpack_array` add a self-describing header so a
+stream can mix codecs per tensor, exactly like hivemind's per-part
+``CompressionInfo`` dispatch.
+
+Uniform 8-bit quantization is block-wise symmetric (256-element blocks, one
+fp32 scale per block) — same family as hivemind's bucketed uniform
+quantization, and the same math as our device-side Pallas blockwise
+quantizer (dalle_tpu/ops/quant.py), so wire and optimizer quantization
+behave consistently.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+import numpy as np
+
+# codec ids (wire stable)
+NONE = 0
+FLOAT16 = 1
+UNIFORM8BIT = 2
+
+#: elements >= this threshold use 8-bit, below it fp16 (task.py:125-126)
+SIZE_ADAPTIVE_THRESHOLD = 2 ** 16 + 1
+
+_QBLOCK = 256
+
+
+def compress_f16(x: np.ndarray) -> bytes:
+    x = np.asarray(x, np.float32)
+    f16 = np.clip(x, np.finfo(np.float16).min, np.finfo(np.float16).max)
+    return f16.astype(np.float16).tobytes()
+
+
+def decompress_f16(buf: bytes, n: int) -> np.ndarray:
+    return np.frombuffer(buf, np.float16, count=n).astype(np.float32)
+
+
+def compress_u8(x: np.ndarray) -> bytes:
+    """Block-wise symmetric uniform quantization to uint8.
+
+    Layout: u32 n, then ceil(n/256) fp32 scales, then n uint8 codes
+    (code 128 = zero, scale = max|x| per block / 127).
+    """
+    flat = np.asarray(x, np.float32).reshape(-1)
+    n = flat.size
+    pad = (-n) % _QBLOCK
+    padded = np.pad(flat, (0, pad)).reshape(-1, _QBLOCK)
+    scales = np.abs(padded).max(axis=1) / 127.0
+    safe = np.where(scales > 0, scales, 1.0)
+    codes = np.clip(np.rint(padded / safe[:, None]) + 128, 0, 255)
+    return (struct.pack(">I", n) + scales.astype(np.float32).tobytes()
+            + codes.astype(np.uint8).reshape(-1)[:n].tobytes())
+
+
+def decompress_u8(buf: bytes) -> np.ndarray:
+    (n,) = struct.unpack(">I", buf[:4])
+    nblocks = (n + _QBLOCK - 1) // _QBLOCK
+    scales = np.frombuffer(buf, np.float32, count=nblocks, offset=4)
+    codes = np.frombuffer(buf, np.uint8, count=n, offset=4 + 4 * nblocks)
+    pad = nblocks * _QBLOCK - n
+    padded = np.pad(codes.astype(np.float32) - 128.0, (0, pad))
+    out = padded.reshape(nblocks, _QBLOCK) * scales[:, None]
+    return out.reshape(-1)[:n].astype(np.float32)
+
+
+def adaptive_codec(n_elements: int,
+                   threshold: int = SIZE_ADAPTIVE_THRESHOLD) -> int:
+    """SizeAdaptiveCompression dispatch (reference task.py:125-126)."""
+    return UNIFORM8BIT if n_elements >= threshold else FLOAT16
+
+
+def is_float_dtype(dtype: np.dtype) -> bool:
+    """True for float dtypes including ml_dtypes extensions (bfloat16,
+    float8_*), whose kind is not 'f'."""
+    return dtype.kind == "f" or "float" in dtype.name
+
+
+def compress(x: np.ndarray, codec: int) -> bytes:
+    if codec == NONE:
+        return np.asarray(x, np.float32).tobytes()
+    if codec == FLOAT16:
+        return compress_f16(x)
+    if codec == UNIFORM8BIT:
+        return compress_u8(x)
+    raise ValueError(f"unknown codec {codec}")
+
+
+def decompress(buf: bytes, codec: int, n: int) -> np.ndarray:
+    if codec == NONE:
+        return np.frombuffer(buf, np.float32, count=n).copy()
+    if codec == FLOAT16:
+        return decompress_f16(buf, n)
+    if codec == UNIFORM8BIT:
+        out = decompress_u8(buf)
+        if out.size != n:
+            raise ValueError(f"decoded {out.size} elements, expected {n}")
+        return out
+    raise ValueError(f"unknown codec {codec}")
+
+
+def pack_array(x: np.ndarray, codec: int) -> bytes:
+    """Self-describing frame: u8 codec, u32 n_elements, payload."""
+    flat = np.asarray(x, np.float32).reshape(-1)
+    return struct.pack(">BI", codec, flat.size) + compress(flat, codec)
+
+
+def unpack_array(buf: bytes) -> Tuple[np.ndarray, int]:
+    """-> (flat float32 array, codec used)."""
+    codec, n = struct.unpack(">BI", buf[:5])
+    return decompress(buf[5:], codec, n), codec

@@ -275,6 +275,28 @@ class DHT:
             return None
         return _native.take_buffer(ptr, out_len.value)
 
+    def post(self, tag: int, payload: bytes, expiration_time: float) -> bool:
+        """Publish into this node's mailbox for remote ``fetch`` (the
+        pull half of the data plane, serving client-mode peers that have
+        no listener — reference arguments.py:89-92)."""
+        rc = self._lib.swarm_node_post(
+            self._node, tag, payload, len(payload), float(expiration_time))
+        return rc == 0
+
+    def fetch(self, addr: str, tag: int,
+              timeout: Optional[float] = None) -> Optional[bytes]:
+        """Single-round-trip mailbox read from a remote peer (poll to
+        wait)."""
+        host, _, port = addr.rpartition(":")
+        timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
+        out_len = ctypes.c_size_t()
+        ptr = self._lib.swarm_node_fetch(
+            self._node, host.encode(), int(port), tag, timeout_ms,
+            ctypes.byref(out_len))
+        if not ptr:
+            return None
+        return _native.take_buffer(ptr, out_len.value)
+
     # -- introspection -----------------------------------------------------
 
     def peers(self) -> Dict[str, str]:

@@ -1,0 +1,171 @@
+"""Matchmaking: form an averaging group for one swarm epoch.
+
+Capability parity with hivemind's ``Matchmaking`` (used by
+``DecentralizedAverager`` — reference SURVEY: DHT group keys, waiting for
+stragglers at most ``matchmaking_time=15s``, reference arguments.py:66-68).
+
+Protocol (epoch-scoped DHT key + leader confirmation):
+
+1. Every candidate stores ``{addr, weight}`` under
+   ``{prefix}_matchmaking.e{epoch}`` (subkey = its peer id) and polls the
+   key until ``matchmaking_time`` elapses (early exit once the candidate
+   set has been stable for two polls and has >= 2 members).
+2. The candidate set is ordered by peer id; the lowest id is the *leader*.
+   The leader sends the final member list to every follower over the data
+   plane; followers prefer the leader's list over their own DHT view, so
+   all members agree on the part assignment.
+3. Residual disagreement (a follower that missed the confirmation and saw
+   a different DHT snapshot) is tolerated downstream: every all-reduce
+   message carries the group hash, and mismatching messages are dropped —
+   the divergent peer just falls out of the round (hivemind's ban-and-
+   proceed elasticity, arguments.py:69-74).
+
+Client-mode peers (outbound-only, reference arguments.py:89-92) announce
+with weight but no listener address; they are skipped for part ownership.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import List, Optional
+
+import msgpack
+
+from dalle_tpu.swarm.dht import DHT, get_dht_time
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupMember:
+    peer_id: str
+    addr: str          # "" for client-mode peers (no listener)
+    weight: float
+
+
+@dataclasses.dataclass
+class AveragingGroup:
+    members: List[GroupMember]      # sorted by peer_id
+    my_index: int
+    group_hash: bytes               # binds messages to this membership
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+
+def group_hash_of(members: List[GroupMember]) -> bytes:
+    h = hashlib.sha256()
+    for m in members:
+        h.update(m.peer_id.encode())
+        h.update(b"|")
+    return h.digest()[:16]
+
+
+def _confirm_tag(prefix: str, epoch: int, peer_id: str) -> int:
+    digest = hashlib.sha256(
+        f"{prefix}:mm-confirm:{epoch}:{peer_id}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
+               matchmaking_time: float = 15.0,
+               min_group_size: int = 1,
+               client_mode: bool = False) -> Optional[AveragingGroup]:
+    """Announce, wait, and agree on this epoch's averaging group.
+
+    Returns None if this peer somehow isn't in the final group (can happen
+    only if its own announce failed and a leader confirmation without it
+    arrived) — callers should then skip averaging this epoch.
+    """
+    key = f"{prefix}_matchmaking.e{epoch}"
+    my_id = dht.peer_id
+    addr = "" if client_mode else dht.visible_address
+    deadline = time.monotonic() + matchmaking_time
+    dht.store(key, my_id, {"addr": addr, "weight": float(weight)},
+              expiration_time=get_dht_time() + matchmaking_time * 4 + 60)
+
+    seen: List[GroupMember] = []
+    stable_polls = 0
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            break
+        current = _read_candidates(dht, key)
+        if [m.peer_id for m in current] == [m.peer_id for m in seen]:
+            stable_polls += 1
+        else:
+            stable_polls = 0
+        seen = current
+        if (len(seen) >= max(2, min_group_size) and stable_polls >= 2):
+            break
+        time.sleep(min(0.25, max(0.0, deadline - now)))
+
+    members = _read_candidates(dht, key)
+    if not any(m.peer_id == my_id for m in members):
+        # our own announce hasn't landed anywhere readable: run solo
+        members = sorted(
+            members + [GroupMember(my_id, addr, float(weight))],
+            key=lambda m: m.peer_id)
+
+    # leader confirmation round
+    leader = members[0]
+    confirm_wait = min(5.0, matchmaking_time)
+    if leader.peer_id == my_id:
+        payload = msgpack.packb(
+            [[m.peer_id, m.addr, m.weight] for m in members],
+            use_bin_type=True)
+        for m in members:
+            if m.peer_id == my_id or not m.addr:
+                continue
+            dht.send(m.addr, _confirm_tag(prefix, epoch, m.peer_id), payload,
+                     timeout=confirm_wait)
+    elif client_mode:
+        pass  # no listener: keep our own DHT view of the group
+    else:
+        raw = dht.recv(_confirm_tag(prefix, epoch, my_id),
+                       timeout=confirm_wait)
+        if raw is not None:
+            try:
+                decoded = msgpack.unpackb(raw, raw=False)
+                confirmed = [GroupMember(str(p), str(a), float(w))
+                             for p, a, w in decoded]
+                if any(m.peer_id == my_id for m in confirmed):
+                    members = confirmed
+            except (msgpack.UnpackException, ValueError, TypeError):
+                pass  # fall back to our own DHT view
+
+    members = sorted(members, key=lambda m: m.peer_id)
+    try:
+        my_index = [m.peer_id for m in members].index(my_id)
+    except ValueError:
+        return None
+    return AveragingGroup(members=members, my_index=my_index,
+                         group_hash=group_hash_of(members))
+
+
+def _read_candidates(dht: DHT, key: str) -> List[GroupMember]:
+    entries = dht.get(key) or {}
+    out = {}
+    for _subkey, item in entries.items():
+        rec = item.value
+        if not isinstance(rec, dict) or "addr" not in rec:
+            continue
+        # the record is signed; the authoritative peer id comes from the
+        # subkey's owner, but we store it redundantly in no field — use
+        # the addr-keyed identity the announcer wrote under its own subkey
+        pid = _peer_id_from_subkey(_subkey)
+        if pid is None:
+            continue
+        out[pid] = GroupMember(pid, str(rec["addr"]),
+                               float(rec.get("weight", 1.0)))
+    return sorted(out.values(), key=lambda m: m.peer_id)
+
+
+def _peer_id_from_subkey(subkey: bytes) -> Optional[str]:
+    from dalle_tpu.swarm.dht import strip_owner
+    raw = strip_owner(subkey)
+    try:
+        return raw.decode()
+    except UnicodeDecodeError:
+        return None

@@ -1,0 +1,248 @@
+"""The collaborative optimizer: swarm-synchronous training facade.
+
+Capability parity with ``hivemind.Optimizer`` as configured by the
+reference (task.py:122-135): peers accumulate gradients locally until the
+swarm collectively reaches ``target_batch_size``; then they form a group
+(matchmaking), average gradients with a compressed butterfly all-reduce,
+and every peer applies an identical optimizer update — so the swarm
+behaves like one giant synchronous data-parallel trainer with elastic
+membership. Surfaces mirrored from the reference's call sites:
+``.step()`` (run_trainer_tpu.py:88), ``.local_epoch`` (callback.py:60),
+``.tracker`` (callback.py:63,79), ``.load_state_from_peers()``
+(callback.py:41), ``on_after_global_step`` / ``on_load_state_from_peers``
+callbacks (run_trainer_tpu.py:66-67).
+
+TPU-native seam: gradients arrive as a JAX pytree from a jitted
+``make_grad_step`` (device math stays in XLA); accumulation is a jitted
+tree-add on device; buffers cross to the host exactly once per swarm
+epoch for the wire all-reduce; the averaged result feeds the jitted
+``make_apply_step`` (LAMB on device — the reference's CPU offload was a
+2021-GPU workaround, SURVEY §2 parallelism table). The optimizer update
+is identical on every peer, so parameters stay bit-synchronized without
+per-epoch state averaging; periodic state averaging
+(``average_state_every``) bounds drift from lossy wire compression, and
+``load_state_from_peers`` handles joiners and stragglers.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.config import CollabConfig
+from dalle_tpu.swarm import compression
+from dalle_tpu.swarm.allreduce import run_allreduce
+from dalle_tpu.swarm.dht import DHT
+from dalle_tpu.swarm.matchmaking import make_group
+from dalle_tpu.swarm.progress import ProgressTracker
+from dalle_tpu.swarm.state_transfer import (StateServer,
+                                            load_state_from_peers)
+
+logger = logging.getLogger(__name__)
+
+_CODECS = {"none": compression.NONE, "float16": compression.FLOAT16,
+           "uniform8bit": compression.UNIFORM8BIT, "size_adaptive": None}
+
+
+class CollaborativeOptimizer:
+    """Owns the train state and drives swarm-synchronous updates.
+
+    Args:
+      dht: this peer's swarm node.
+      cfg: swarm-wide semantics (target batch, timeouts, compression).
+      state: initial TrainState (params + opt state + step).
+      apply_step: jitted ``(state, grads) -> state`` (make_apply_step).
+      client_mode: outbound-only peer — contributes gradients but owns no
+        all-reduce part (reference arguments.py:89-92).
+      serve_state: run a StateServer thread so joiners can bootstrap from
+        this peer (reference callback.py:41 semantics).
+    """
+
+    def __init__(self, dht: DHT, cfg: CollabConfig, state: Any,
+                 apply_step: Callable[[Any, Any], Any],
+                 client_mode: bool = False,
+                 serve_state: bool = True,
+                 matchmaking_min_group: int = 2):
+        self.dht = dht
+        self.cfg = cfg
+        self.state = state
+        self.apply_step = apply_step
+        self.client_mode = client_mode
+        self.matchmaking_min_group = matchmaking_min_group
+        self.local_epoch = 0
+        self.local_samples = 0
+        self.tracker = ProgressTracker(
+            dht, cfg.run_id, cfg.target_batch_size,
+            client_mode=client_mode)
+        self.on_after_global_step: List[Callable[[], None]] = []
+        self.on_load_state_from_peers: List[Callable[[], None]] = []
+        self._grad_codec = _CODECS[cfg.grad_compression]
+        self._state_codec = _CODECS[cfg.state_compression]
+        self._grad_acc = None
+        self._accumulate = jax.jit(
+            lambda acc, g, s: jax.tree.map(
+                lambda a, b: a + b.astype(jnp.float32) * s, acc, g))
+        self._server: Optional[StateServer] = None
+        if serve_state and not client_mode:
+            self._server = StateServer(
+                dht, cfg.run_id, self._state_snapshot,
+                codec=self._state_codec,
+                adaptive_threshold=cfg.size_adaptive_threshold).start()
+        self.tracker.report_local_progress(0, 0, force=True)
+
+    # -- state (de)construction -----------------------------------------
+
+    def _state_leaves(self) -> List[np.ndarray]:
+        leaves = jax.tree_util.tree_leaves(
+            (self.state.params, self.state.opt_state))
+        return [np.asarray(x) for x in leaves]
+
+    def _state_snapshot(self):
+        return self.local_epoch, self._state_leaves()
+
+    def _replace_state_leaves(self, arrays: List[np.ndarray]) -> None:
+        old = (self.state.params, self.state.opt_state)
+        treedef = jax.tree_util.tree_structure(old)
+        old_leaves = jax.tree_util.tree_leaves(old)
+        if len(arrays) != len(old_leaves):
+            raise ValueError(
+                f"state has {len(old_leaves)} leaves, got {len(arrays)}")
+        new_leaves = [
+            jax.device_put(np.asarray(a).astype(o.dtype).reshape(o.shape))
+            for a, o in zip(arrays, old_leaves)]
+        params, opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        self.state = self.state.replace(params=params, opt_state=opt_state)
+
+    # -- the hot path ----------------------------------------------------
+
+    def step(self, grads: Any, batch_size: int) -> bool:
+        """Record one local accumulation step; run a global step when the
+        swarm is ready. Returns True iff a global step happened."""
+        if self._grad_acc is None:
+            self._grad_acc = jax.tree.map(
+                lambda g: jnp.zeros(g.shape, jnp.float32), grads)
+        self._grad_acc = self._accumulate(
+            self._grad_acc, grads, float(batch_size))
+        self.local_samples += int(batch_size)
+        self.tracker.report_local_progress(
+            self.local_epoch, self.local_samples)
+
+        progress = self.tracker.global_progress()
+        if progress.epoch > self.local_epoch:
+            logger.info("behind the swarm (local %d < global %d): resyncing",
+                        self.local_epoch, progress.epoch)
+            self.load_state_from_peers(min_epoch=progress.epoch)
+            return False
+        if not progress.ready_to_update:
+            return False
+        self._run_global_step()
+        return True
+
+    def _run_global_step(self) -> None:
+        t0 = time.monotonic()
+        weight = float(max(self.local_samples, 1))
+        grads_host = [np.asarray(g) / weight for g in
+                      jax.tree_util.tree_leaves(self._grad_acc)]
+        treedef = jax.tree_util.tree_structure(self._grad_acc)
+
+        group = make_group(
+            self.dht, f"{self.cfg.run_id}_grads", self.local_epoch,
+            weight=weight, matchmaking_time=self.cfg.matchmaking_time,
+            min_group_size=self.matchmaking_min_group,
+            client_mode=self.client_mode)
+        if group is not None and group.size > 1:
+            budget = min(self.cfg.allreduce_timeout,
+                         max(1.0, self.cfg.averaging_timeout
+                             - (time.monotonic() - t0)))
+            averaged = run_allreduce(
+                self.dht, group, f"{self.cfg.run_id}_grads",
+                self.local_epoch, grads_host, weight=weight,
+                allreduce_timeout=budget, codec=self._grad_codec,
+                adaptive_threshold=self.cfg.size_adaptive_threshold)
+        else:
+            averaged = grads_host  # alone this epoch
+
+        grads_tree = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in averaged])
+        self.state = self.apply_step(self.state, grads_tree)
+
+        self.local_epoch += 1
+        self.local_samples = 0
+        self._grad_acc = None
+        self.tracker.reset_epoch(self.local_epoch)
+
+        if (self.cfg.average_state_every > 0
+                and self.local_epoch % self.cfg.average_state_every == 0):
+            self._average_state()
+
+        for cb in self.on_after_global_step:
+            cb()
+        logger.info("global step -> epoch %d (%.2fs, group=%s)",
+                    self.local_epoch, time.monotonic() - t0,
+                    group.size if group else 1)
+
+    # -- drift control / recovery ----------------------------------------
+
+    def _average_state(self) -> None:
+        """Butterfly-average float state leaves (params + float opt stats).
+
+        Integer leaves (step counters, 8-bit moment codes) stay local:
+        identical updates keep them synchronized, and lossy averaging of
+        code arrays would be meaningless (hivemind equally averages only
+        the tensors the optimizer exposes as floats)."""
+        group = make_group(
+            self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
+            weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
+            min_group_size=self.matchmaking_min_group,
+            client_mode=self.client_mode)
+        if group is None or group.size <= 1:
+            return
+        leaves = self._state_leaves()
+        float_idx = [i for i, a in enumerate(leaves)
+                     if compression.is_float_dtype(a.dtype)]
+        floats = [leaves[i].astype(np.float32) for i in float_idx]
+        averaged = run_allreduce(
+            self.dht, group, f"{self.cfg.run_id}_state", self.local_epoch,
+            floats, weight=1.0,
+            allreduce_timeout=self.cfg.allreduce_timeout,
+            codec=self._state_codec,
+            adaptive_threshold=self.cfg.size_adaptive_threshold)
+        for i, a in zip(float_idx, averaged):
+            leaves[i] = a
+        self._replace_state_leaves(leaves)
+
+    def load_state_from_peers(self, min_epoch: int = 0,
+                              timeout: Optional[float] = None) -> bool:
+        """Bootstrap params+opt state from the freshest live peer
+        (reference callback.py:41, run_aux_peer.py:48)."""
+        result = load_state_from_peers(
+            self.dht, self.cfg.run_id, min_epoch=min_epoch,
+            timeout=timeout or self.cfg.averaging_timeout)
+        if result is None:
+            logger.warning("load_state_from_peers: nobody answered")
+            return False
+        epoch, arrays = result
+        self._replace_state_leaves(arrays)
+        self.local_epoch = max(epoch, self.local_epoch)
+        self.local_samples = 0
+        self._grad_acc = None
+        self.tracker.reset_epoch(self.local_epoch)
+        for cb in self.on_load_state_from_peers:
+            cb()
+        return True
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __enter__(self) -> "CollaborativeOptimizer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,196 @@
+"""Swarm progress tracking: the DHT epoch clock.
+
+Capability parity with hivemind's ``ProgressTracker`` (used via
+``hivemind.Optimizer`` at reference task.py:122-135; surfaced through
+``.tracker.global_epoch`` at callback.py:79 and
+``.tracker.performance_ema.samples_per_second`` at callback.py:63):
+
+- every peer publishes ``{samples_accumulated, samples_per_second, epoch}``
+  into the DHT under ``{run_id}_progress`` (subkey = peer id);
+- every peer aggregates all entries to estimate swarm-wide progress toward
+  ``target_batch_size`` and decide when the next global step (*epoch*) is
+  due. The epoch counter is the global clock of the swarm.
+
+Unlike hivemind this tracker is synchronous: :meth:`report_local_progress`
+publishes (throttled) and :meth:`global_progress` fetches (throttled), both
+called from the training loop — no background thread, so behavior is
+deterministic under test. The DHT record TTL plays the role of hivemind's
+liveness: dead peers' contributions expire away
+(``statistics_expiration``-style, reference arguments.py:129-131).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from dalle_tpu.swarm.dht import DHT, get_dht_time, strip_owner
+
+
+class PerformanceEMA:
+    """Samples/sec exponential moving average (hivemind parity,
+    reference callback.py:63)."""
+
+    def __init__(self, alpha: float = 0.1):
+        self.alpha = alpha
+        self.samples_per_second = 0.0
+        self._last_time: Optional[float] = None
+
+    def update(self, n_samples: int) -> float:
+        now = time.perf_counter()
+        if self._last_time is not None and n_samples > 0:
+            elapsed = max(now - self._last_time, 1e-9)
+            rate = n_samples / elapsed
+            if self.samples_per_second == 0.0:
+                self.samples_per_second = rate
+            else:
+                self.samples_per_second = (
+                    self.alpha * rate
+                    + (1 - self.alpha) * self.samples_per_second)
+        self._last_time = now
+        return self.samples_per_second
+
+    def reset_timer(self) -> None:
+        self._last_time = time.perf_counter()
+
+
+@dataclasses.dataclass
+class LocalProgress:
+    peer_id: str
+    epoch: int
+    samples_accumulated: int
+    samples_per_second: float
+    time: float
+    client_mode: bool
+
+
+@dataclasses.dataclass
+class GlobalProgress:
+    epoch: int                  # max epoch over live peers
+    samples_accumulated: int    # sum over peers at the max epoch
+    target_batch_size: int
+    num_peers: int
+    num_clients: int
+    eta_next_epoch: float       # absolute dht-time estimate
+    samples_per_second: float   # swarm-wide sum
+
+    @property
+    def ready_to_update(self) -> bool:
+        return (self.samples_accumulated >= self.target_batch_size
+                or get_dht_time() >= self.eta_next_epoch)
+
+
+class ProgressTracker:
+    def __init__(self, dht: DHT, run_id: str, target_batch_size: int,
+                 expected_drift_peers: float = 3.0,
+                 metadata_expiration: float = 60.0,
+                 min_refresh_period: float = 0.5,
+                 client_mode: bool = False):
+        self.dht = dht
+        self.key = f"{run_id}_progress"
+        self.target_batch_size = target_batch_size
+        self.metadata_expiration = metadata_expiration
+        self.min_refresh_period = min_refresh_period
+        self.client_mode = client_mode
+        self.performance_ema = PerformanceEMA()
+        self.local_epoch = 0
+        self.samples_accumulated = 0
+        self._last_publish = 0.0
+        self._last_fetch = 0.0
+        self._cached_global: Optional[GlobalProgress] = None
+        del expected_drift_peers  # accepted for config parity
+
+    # -- local side -----------------------------------------------------
+
+    def report_local_progress(self, epoch: int, samples_accumulated: int,
+                              force: bool = False) -> None:
+        """Publish this peer's progress; throttled to min_refresh_period."""
+        new_samples = samples_accumulated - self.samples_accumulated
+        if new_samples > 0:
+            self.performance_ema.update(new_samples)
+        self.local_epoch = epoch
+        self.samples_accumulated = samples_accumulated
+        now = time.monotonic()
+        if not force and now - self._last_publish < self.min_refresh_period:
+            return
+        self._last_publish = now
+        record = {
+            "peer_id": self.dht.peer_id,
+            "epoch": int(epoch),
+            "samples_accumulated": int(samples_accumulated),
+            "samples_per_second": float(
+                self.performance_ema.samples_per_second),
+            "time": get_dht_time(),
+            "client_mode": self.client_mode,
+        }
+        self.dht.store(self.key, self.dht.peer_id, record,
+                       expiration_time=get_dht_time()
+                       + self.metadata_expiration)
+
+    def reset_epoch(self, epoch: int) -> None:
+        """Start accumulating for a new epoch (after a global step)."""
+        self.local_epoch = epoch
+        self.samples_accumulated = 0
+        self.performance_ema.reset_timer()
+        self.report_local_progress(epoch, 0, force=True)
+
+    # -- global side ----------------------------------------------------
+
+    def global_progress(self, force_refresh: bool = False) -> GlobalProgress:
+        now = time.monotonic()
+        if (not force_refresh and self._cached_global is not None
+                and now - self._last_fetch < self.min_refresh_period):
+            return self._cached_global
+        self._last_fetch = now
+
+        entries = self.dht.get(self.key) or {}
+        peers = []
+        # liveness = record TTL: dead peers' entries expire out of the DHT
+        for subkey, item in entries.items():
+            rec = item.value
+            if not isinstance(rec, dict):
+                continue
+            try:
+                prog = LocalProgress(
+                    peer_id=str(rec["peer_id"]),
+                    epoch=int(rec["epoch"]),
+                    samples_accumulated=int(rec["samples_accumulated"]),
+                    samples_per_second=float(rec["samples_per_second"]),
+                    time=float(rec["time"]),
+                    client_mode=bool(rec.get("client_mode", False)))
+            except (KeyError, TypeError, ValueError):
+                continue
+            del subkey  # identity enforced by SignatureValidator on read
+            peers.append(prog)
+
+        if not peers:
+            # alone in the swarm: progress is whatever we have locally
+            sps = max(self.performance_ema.samples_per_second, 1e-9)
+            remaining = max(
+                0, self.target_batch_size - self.samples_accumulated)
+            result = GlobalProgress(
+                epoch=self.local_epoch,
+                samples_accumulated=self.samples_accumulated,
+                target_batch_size=self.target_batch_size,
+                num_peers=1, num_clients=int(self.client_mode),
+                eta_next_epoch=get_dht_time() + remaining / sps,
+                samples_per_second=self.performance_ema.samples_per_second)
+            self._cached_global = result
+            return result
+
+        epoch = max(p.epoch for p in peers)
+        epoch = max(epoch, self.local_epoch)
+        current = [p for p in peers if p.epoch == epoch]
+        samples = sum(p.samples_accumulated for p in current)
+        sps = sum(p.samples_per_second for p in peers)
+        remaining = max(0, self.target_batch_size - samples)
+        eta = get_dht_time() + remaining / max(sps, 1e-9)
+        result = GlobalProgress(
+            epoch=epoch, samples_accumulated=samples,
+            target_batch_size=self.target_batch_size,
+            num_peers=len(peers),
+            num_clients=sum(1 for p in peers if p.client_mode),
+            eta_next_epoch=eta, samples_per_second=sps)
+        self._cached_global = result
+        return result

@@ -1,0 +1,287 @@
+"""Peer-to-peer training-state transfer.
+
+Capability parity with hivemind's ``load_state_from_peers`` /
+``TrainingStateAverager`` download path (reference callback.py:41,
+run_aux_peer.py:48): a joining or recovering peer downloads the latest
+params + optimizer state + epoch from any live peer, so the swarm is the
+checkpoint.
+
+Mechanism: state servers advertise ``{addr, epoch}`` under
+``{prefix}_state_servers`` (TTL'd, dead servers expire away). A client
+sends a request carrying its own address and a nonce; the server streams
+the serialized state back in chunks over the data plane (frames are capped
+well under the transport's 64 MB limit; tensors are compressed with the
+same SizeAdaptive codec used for state averaging, task.py:125-126).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import msgpack
+import numpy as np
+
+from dalle_tpu.swarm import compression
+from dalle_tpu.swarm.dht import DHT, get_dht_time, strip_owner
+
+_CHUNK = 8 << 20  # 8 MB frames (native transport caps at 64 MB)
+
+
+def _req_tag(prefix: str, peer_id: str) -> int:
+    d = hashlib.sha256(f"{prefix}:state_req:{peer_id}".encode()).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def _rsp_tag(prefix: str, nonce: bytes) -> int:
+    d = hashlib.sha256(b"%s:state_rsp:%s" % (prefix.encode(), nonce)).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def _chunk_tag(prefix: str, nonce: bytes, i: int) -> int:
+    d = hashlib.sha256(
+        b"%s:state_chunk:%s:%d" % (prefix.encode(), nonce, i)).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 dtype names
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_state(epoch: int, arrays: Sequence[np.ndarray],
+                    codec: Optional[int] = None,
+                    adaptive_threshold: int =
+                    compression.SIZE_ADAPTIVE_THRESHOLD) -> bytes:
+    """Dtype-preserving: float leaves ride the wire codec (lossy for the
+    8-bit path, like hivemind's state_averaging_compression); integer
+    leaves (step counters, quantized moment codes) are exact raw bytes."""
+    frames = []
+    for a in arrays:
+        a = np.asarray(a)
+        if compression.is_float_dtype(a.dtype):
+            f32 = a.astype(np.float32)
+            c = (compression.adaptive_codec(f32.size, adaptive_threshold)
+                 if codec is None else codec)
+            frames.append({"shape": list(a.shape), "dtype": a.dtype.name,
+                           "data": compression.pack_array(f32, c)})
+        else:
+            frames.append({"shape": list(a.shape), "dtype": a.dtype.name,
+                           "raw": a.tobytes()})
+    return msgpack.packb({"epoch": int(epoch), "arrays": frames},
+                         use_bin_type=True)
+
+
+def deserialize_state(blob: bytes) -> Tuple[int, List[np.ndarray]]:
+    obj = msgpack.unpackb(blob, raw=False)
+    arrays = []
+    for fr in obj["arrays"]:
+        dtype = _np_dtype(fr["dtype"])
+        if "raw" in fr:
+            arrays.append(np.frombuffer(fr["raw"], dtype)
+                          .reshape(fr["shape"]).copy())
+        else:
+            flat, _codec = compression.unpack_array(fr["data"])
+            arrays.append(flat.reshape(fr["shape"]).astype(dtype))
+    return int(obj["epoch"]), arrays
+
+
+class StateServer:
+    """Background thread serving this peer's training state to the swarm."""
+
+    def __init__(self, dht: DHT, prefix: str,
+                 provider: Callable[[], Tuple[int, List[np.ndarray]]],
+                 announce_period: float = 15.0,
+                 codec: Optional[int] = None,
+                 adaptive_threshold: int =
+                 compression.SIZE_ADAPTIVE_THRESHOLD,
+                 max_concurrent_streams: int = 2):
+        self.dht = dht
+        self.prefix = prefix
+        self.provider = provider
+        self.codec = codec
+        self.adaptive_threshold = adaptive_threshold
+        self.announce_period = announce_period
+        self.key = f"{prefix}_state_servers"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        # streams run on worker threads so a multi-GB transfer neither
+        # starves the announce loop (whose record has a 3x TTL) nor
+        # serializes behind another joiner's download
+        self._stream_slots = threading.Semaphore(max_concurrent_streams)
+
+    def start(self) -> "StateServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _announce(self) -> None:
+        epoch, _ = self.provider()
+        self.dht.store(
+            self.key, self.dht.peer_id,
+            {"addr": self.dht.visible_address, "epoch": int(epoch)},
+            expiration_time=get_dht_time() + 3 * self.announce_period)
+
+    def _run(self) -> None:
+        tag = _req_tag(self.prefix, self.dht.peer_id)
+        last_announce = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_announce >= self.announce_period:
+                try:
+                    self._announce()
+                except Exception:  # noqa: BLE001 - dht may be shutting down
+                    pass
+                last_announce = now
+            raw = self.dht.recv(tag, timeout=0.5)
+            if raw is None:
+                continue
+            try:
+                req = msgpack.unpackb(raw, raw=False)
+                reply_addr, nonce = str(req["addr"]), bytes(req["nonce"])
+            except Exception:  # noqa: BLE001 - malformed request
+                continue
+            if not self._stream_slots.acquire(blocking=False):
+                continue  # at capacity: requester retries another server
+            threading.Thread(target=self._stream, daemon=True,
+                             args=(reply_addr, nonce)).start()
+
+    def _stream(self, reply_addr: str, nonce: bytes) -> None:
+        try:
+            epoch, arrays = self.provider()
+            blob = serialize_state(epoch, arrays, self.codec,
+                                   self.adaptive_threshold)
+            if reply_addr:
+                self._send_chunks(reply_addr, nonce, blob)
+            else:
+                # client-mode requester (no listener): park the chunks in
+                # this server's mailbox for the requester to pull
+                self._post_chunks(nonce, blob)
+        except Exception:  # noqa: BLE001 - peer vanished mid-stream
+            pass
+        finally:
+            self._stream_slots.release()
+
+    def _post_chunks(self, nonce: bytes, blob: bytes) -> None:
+        n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
+        exp = time.time() + 300.0
+        for i in range(n):
+            part = blob[i * _CHUNK:(i + 1) * _CHUNK]
+            frame = struct.pack(">II", i, n) + part
+            self.dht.post(_chunk_tag(self.prefix, nonce, i), frame, exp)
+
+    def _send_chunks(self, addr: str, nonce: bytes, blob: bytes) -> None:
+        tag = _rsp_tag(self.prefix, nonce)
+        n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
+        for i in range(n):
+            part = blob[i * _CHUNK:(i + 1) * _CHUNK]
+            frame = struct.pack(">II", i, n) + part
+            if not self.dht.send(addr, tag, frame, timeout=30.0):
+                return
+
+
+def load_state_from_peers(dht: DHT, prefix: str,
+                          min_epoch: int = 0,
+                          timeout: float = 60.0
+                          ) -> Optional[Tuple[int, List[np.ndarray]]]:
+    """Download (epoch, arrays) from the freshest advertised state server.
+
+    Tries servers in descending epoch order; returns None if nobody
+    suitable answered within ``timeout``.
+    """
+    entries = dht.get(f"{prefix}_state_servers") or {}
+    servers = []
+    for subkey, item in entries.items():
+        rec = item.value
+        if not isinstance(rec, dict) or "addr" not in rec:
+            continue
+        pid = strip_owner(subkey).decode(errors="replace")
+        if pid == dht.peer_id:
+            continue
+        servers.append((int(rec.get("epoch", 0)), str(rec["addr"]), pid))
+    servers.sort(reverse=True)
+
+    deadline = time.monotonic() + timeout
+    for epoch, addr, pid in servers:
+        if epoch < min_epoch:
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        nonce = np.random.bytes(16)
+        reply_addr = "" if dht.client_mode else dht.visible_address
+        req = msgpack.packb({"addr": reply_addr, "nonce": nonce},
+                            use_bin_type=True)
+        if not dht.send(addr, _req_tag(prefix, pid), req,
+                        timeout=min(10.0, remaining)):
+            continue
+        if dht.client_mode:
+            blob = _pull_chunks(dht, prefix, addr, nonce, deadline)
+        else:
+            blob = _collect_chunks(dht, _rsp_tag(prefix, nonce), deadline)
+        if blob is None:
+            continue
+        try:
+            return deserialize_state(blob)
+        except Exception:  # noqa: BLE001 - corrupt stream
+            continue
+    return None
+
+
+def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
+                 deadline: float) -> Optional[bytes]:
+    """Client-mode download: poll the server's mailbox for each chunk."""
+    chunks = {}
+    total = None
+    i = 0
+    while time.monotonic() < deadline:
+        raw = dht.fetch(addr, _chunk_tag(prefix, nonce, i),
+                        timeout=min(5.0, max(
+                            0.1, deadline - time.monotonic())))
+        if raw is None:
+            time.sleep(0.2)  # server still serializing/posting
+            continue
+        if len(raw) < 8:
+            return None
+        idx, n = struct.unpack(">II", raw[:8])
+        if idx != i or (total is not None and n != total):
+            return None
+        total = n
+        chunks[i] = raw[8:]
+        i += 1
+        if i == total:
+            return b"".join(chunks[k] for k in range(total))
+    return None
+
+
+def _collect_chunks(dht: DHT, tag: int, deadline: float) -> Optional[bytes]:
+    chunks = {}
+    total = None
+    while time.monotonic() < deadline:
+        raw = dht.recv(tag, timeout=min(
+            1.0, max(0.05, deadline - time.monotonic())))
+        if raw is None:
+            if total is not None and len(chunks) == total:
+                break
+            continue
+        if len(raw) < 8:
+            continue
+        i, n = struct.unpack(">II", raw[:8])
+        total = n if total is None else total
+        if n != total or i >= n:
+            continue
+        chunks[i] = raw[8:]
+        if len(chunks) == total:
+            break
+    if total is None or len(chunks) != total:
+        return None
+    return b"".join(chunks[i] for i in range(total))

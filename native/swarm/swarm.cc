@@ -34,7 +34,8 @@ constexpr int kBucketSize = 16;   // Kademlia k
 constexpr int kAlpha = 3;         // lookup parallelism (serialized batches)
 constexpr uint8_t kPing = 1, kPong = 2, kStore = 3, kStoreOk = 4,
                   kFindNode = 5, kNodes = 6, kFindValue = 7, kValue = 8,
-                  kMsg = 9, kMsgOk = 10;
+                  kMsg = 9, kMsgOk = 10, kFetch = 11, kFetchHit = 12,
+                  kFetchMiss = 13;
 
 double now_unix() {
   return std::chrono::duration<double>(
@@ -332,6 +333,20 @@ struct SwarmNode {
   std::condition_variable msg_cv;
   std::map<uint64_t, std::deque<std::string>> msgs;
 
+  /* mailbox: TTL'd single-slot entries served to remote FETCHes */
+  struct MailEntry {
+    std::string payload;
+    double expiration;
+  };
+  std::mutex mail_mu;
+  std::map<uint64_t, MailEntry> mailbox;
+
+  void mailbox_gc_locked() {
+    double t = now_unix();
+    for (auto it = mailbox.begin(); it != mailbox.end();)
+      it = (it->second.expiration < t) ? mailbox.erase(it) : std::next(it);
+  }
+
   explicit SwarmNode(const NodeId &id_) : id(id_), rt(id_) {}
 
   std::string header() const {
@@ -425,6 +440,22 @@ struct SwarmNode {
         }
         msg_cv.notify_all();
         rep.push_back(char(kMsgOk));
+        break;
+      }
+      case kFetch: {
+        uint64_t tag = r.u64();
+        if (!r.ok) return {};
+        std::lock_guard<std::mutex> g(mail_mu);
+        mailbox_gc_locked();
+        auto it = mailbox.find(tag);
+        if (it == mailbox.end()) {
+          rep.push_back(char(kFetchMiss));
+        } else {
+          rep.push_back(char(kFetchHit));
+          put_bytes(rep,
+                    reinterpret_cast<const uint8_t *>(it->second.payload.data()),
+                    it->second.payload.size());
+        }
         break;
       }
       default:
@@ -707,6 +738,35 @@ uint8_t *swarm_node_recv(SwarmNode *node, uint64_t tag, int timeout_ms,
         std::chrono::steady_clock::now() >= deadline)
       return nullptr;
   }
+}
+
+int swarm_node_post(SwarmNode *node, uint64_t tag, const uint8_t *payload,
+                    size_t len, double expiration) {
+  if (len > kMaxFrame) return -1;
+  std::lock_guard<std::mutex> g(node->mail_mu);
+  node->mailbox_gc_locked();
+  node->mailbox[tag] = {std::string(reinterpret_cast<const char *>(payload),
+                                    len),
+                        expiration};
+  return 0;
+}
+
+uint8_t *swarm_node_fetch(SwarmNode *node, const char *host, int port,
+                          uint64_t tag, int timeout_ms, size_t *out_len) {
+  std::string body;
+  put_u64(body, tag);
+  std::string reply;
+  if (!node->rpc(host, port, kFetch, body, &reply, timeout_ms))
+    return nullptr;
+  Reader r(reply);
+  if (!r.need(1) || r.p[0] != kFetchHit) return nullptr;
+  r.off = 1;
+  std::string payload = r.bytes();
+  if (!r.ok) return nullptr;
+  auto *buf = static_cast<uint8_t *>(malloc(payload.size()));
+  memcpy(buf, payload.data(), payload.size());
+  *out_len = payload.size();
+  return buf;
 }
 
 uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len) {

@@ -71,6 +71,21 @@ int swarm_node_send(SwarmNode *node, const char *host, int port,
 uint8_t *swarm_node_recv(SwarmNode *node, uint64_t tag, int timeout_ms,
                          size_t *out_len);
 
+/* Mailbox: the pull-based half of the data plane, for client-mode peers
+ * (outbound-only, no listener — reference arguments.py:89-92) that cannot
+ * receive pushed messages. A listener posts a payload under a tag with an
+ * absolute unix expiration; any peer may then FETCH it over a normal
+ * outbound connection. One payload per tag (reposting replaces); expired
+ * entries are garbage-collected. */
+int swarm_node_post(SwarmNode *node, uint64_t tag, const uint8_t *payload,
+                    size_t len, double expiration);
+
+/* Fetch a mailbox entry from a remote peer. Single round trip; returns
+ * malloc'd payload (swarm_free) or NULL if absent/expired/unreachable.
+ * Callers poll. */
+uint8_t *swarm_node_fetch(SwarmNode *node, const char *host, int port,
+                          uint64_t tag, int timeout_ms, size_t *out_len);
+
 /* Routing table dump: malloc'd buffer of u32 count entries:
  * 32B id, u32 host_len, host, u16 port (BE). */
 uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len);

@@ -239,6 +239,35 @@ class TestDataPlane:
         assert not ok
         assert time.monotonic() - t0 < 3.0
 
+    def test_mailbox_post_fetch(self, swarm5):
+        addr = swarm5[0].visible_address
+        assert swarm5[0].post(42, b"averaged-part", get_dht_time() + 10)
+        assert swarm5[1].fetch(addr, 42) == b"averaged-part"
+        assert swarm5[1].fetch(addr, 43) is None
+        # repost replaces
+        assert swarm5[0].post(42, b"v2", get_dht_time() + 10)
+        assert swarm5[2].fetch(addr, 42) == b"v2"
+
+    def test_mailbox_expiry(self, swarm5):
+        addr = swarm5[0].visible_address
+        swarm5[0].post(7, b"ephemeral", get_dht_time() + 0.3)
+        assert swarm5[1].fetch(addr, 7) == b"ephemeral"
+        time.sleep(0.5)
+        assert swarm5[1].fetch(addr, 7) is None
+
+    def test_client_mode_can_fetch(self):
+        nodes = make_swarm(2)
+        client = DHT(initial_peers=[nodes[0].visible_address],
+                     client_mode=True, rpc_timeout=2.0)
+        try:
+            nodes[1].post(9, b"for-the-client", get_dht_time() + 10)
+            assert client.fetch(nodes[1].visible_address, 9) \
+                == b"for-the-client"
+        finally:
+            client.shutdown()
+            for n in nodes:
+                n.shutdown()
+
 
 class TestIdentity:
     def test_persisted_identity_roundtrip(self, tmp_path):
