@@ -1,0 +1,126 @@
+"""Trainer peer CLI: join the swarm and train.
+
+Capability parity with the reference's volunteer entry points
+(``run_trainer.py:26-56`` and the TPU host loop ``run_trainer_tpu.py:26-91``):
+parse the three-axis config split, assemble the task, print the connection
+banner with a copyable ``--initial-peers`` line (``utils.py:39-56``), run the
+3-step warmup self-check, then the accumulate -> swarm-step loop forever
+(bounded by ``--max-epochs``/``--max-steps`` for tests and benchmarks).
+
+Usage::
+
+    python -m dalle_tpu.cli.run_trainer --preset tiny            # first peer
+    python -m dalle_tpu.cli.run_trainer --preset tiny \
+        --initial-peers 127.0.0.1:31337                          # joiner
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Optional, Sequence
+
+from dalle_tpu.config import (CollabConfig, ModelConfig, OptimizerConfig,
+                              PeerConfig, TrainerConfig, tiny_model_config)
+from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
+                                 dataclass_from_args)
+
+logger = logging.getLogger("dalle_tpu.trainer")
+
+MODEL_PRESETS = {
+    "flagship": ModelConfig,                  # the 1.3B (task.py:62-83)
+    "tiny": tiny_model_config,                # CPU smoke shape
+}
+
+CONFIG_CLASSES = (ModelConfig, OptimizerConfig, TrainerConfig, CollabConfig,
+                  PeerConfig)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    check_no_collisions(*CONFIG_CLASSES)
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-trainer", description=__doc__.splitlines()[0])
+    parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
+                        default="flagship",
+                        help="base model shape that field flags override")
+    parser.add_argument("--max-epochs", type=int, default=None,
+                        help="stop after this many global steps")
+    parser.add_argument("--max-steps", type=int, default=None,
+                        help="stop after this many local mini-steps")
+    parser.add_argument("--warmup-batches", type=int, default=3,
+                        help="compile/self-check steps before joining")
+    parser.add_argument("--data-path", type=str, default=None,
+                        help="codes dataset dir/file (default: synthetic)")
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="append one JSON line per epoch to this file")
+    parser.add_argument("--platform", type=str, default=None,
+                        help="force a jax platform (cpu/tpu) before init")
+    parser.add_argument("--log-level", type=str, default="INFO")
+    for cls in CONFIG_CLASSES:
+        add_dataclass_args(parser, cls)
+    return parser
+
+
+def configs_from_args(args: argparse.Namespace):
+    model = dataclass_from_args(ModelConfig, args,
+                                base=MODEL_PRESETS[args.preset]())
+    return (model,
+            dataclass_from_args(OptimizerConfig, args),
+            dataclass_from_args(TrainerConfig, args),
+            dataclass_from_args(CollabConfig, args),
+            dataclass_from_args(PeerConfig, args))
+
+
+def banner(task) -> None:
+    """Connection banner with the copyable joiner line (utils.py:39-56)."""
+    addr = task.dht.visible_address
+    logger.info("=" * 60)
+    logger.info("peer %s listening on %s", task.dht.peer_id[:16], addr)
+    logger.info("to join this swarm, run a peer with:")
+    logger.info("    --initial-peers %s", addr)
+    logger.info("=" * 60)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from dalle_tpu.task import TrainingTask
+    from dalle_tpu.training.loop import train_loop
+
+    model, opt, trainer, collab, peer = configs_from_args(args)
+    task = TrainingTask(model, opt, trainer, collab, peer,
+                        data_path=args.data_path)
+
+    def on_epoch(report):
+        if args.metrics_file:
+            with open(args.metrics_file, "a") as f:
+                f.write(json.dumps({
+                    "epoch": report.epoch,
+                    "loss": report.loss,
+                    "mini_steps": report.mini_steps,
+                    "samples_per_second": report.samples_per_second,
+                }) + "\n")
+
+    with task:
+        banner(task)
+        reports = train_loop(task,
+                             max_epochs=args.max_epochs,
+                             max_steps=args.max_steps,
+                             warmup_steps=args.warmup_batches,
+                             on_epoch=on_epoch)
+    if reports:
+        logger.info("done: %d epochs, final mean loss %.4f",
+                    len(reports), reports[-1].loss)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

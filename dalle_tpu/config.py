@@ -154,7 +154,12 @@ class CollabConfig:
     allreduce_timeout: float = 60.0   # arguments.py:69-71
     averaging_timeout: float = 180.0  # arguments.py:72-74
     # Average params+opt state with peers every N epochs to bound drift.
-    average_state_every: int = 1
+    # Rounds are byte-identical across surviving members (part owners apply
+    # the same lossy wire bytes they broadcast), so state averaging is
+    # repair for peers that missed chunks, not a per-epoch necessity —
+    # and keeping it off the common path halves the per-epoch matchmaking
+    # cost, which keeps peers' matchmaking windows aligned.
+    average_state_every: int = 10
     # Compression: tensors with <= threshold elems -> fp16, else uniform 8-bit
     # (SizeAdaptiveCompression(threshold=2**16+1, ...), task.py:125-126).
     size_adaptive_threshold: int = 2 ** 16 + 1

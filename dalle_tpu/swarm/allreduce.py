@@ -11,13 +11,18 @@ Shape of one round, group of N members (sorted by peer id), member i:
 
   scatter  — split the flattened concat vector into N contiguous parts;
              send my local data for part j to member j (compressed).
-  reduce   — collect the other N-1 members' chunks of part i until
-             ``allreduce_timeout``; average with per-peer sample weights;
-             senders that miss the deadline are simply excluded (their
-             weight is dropped) — hivemind's ban-and-proceed.
+  reduce   — collect the other N-1 members' chunks of part i; average with
+             per-peer sample weights. A sender that makes no progress for
+             ``sender_timeout`` (or misses the reduce-phase budget — a
+             fraction of ``allreduce_timeout``, so gather always keeps
+             time) is excluded and its weight dropped — hivemind's
+             ban-and-proceed, bounded per sender rather than per round.
   gather   — send the averaged part i to every member; collect the other
              averaged parts; parts whose owner died fall back to this
              peer's locally-weighted value, so the round always returns.
+             The part owner applies the same compress->decompress result
+             it broadcasts, so every member ends the round with
+             byte-identical averaged values even under lossy codecs.
 
 Every message carries the 16-byte group hash from matchmaking; chunks from
 a peer with a divergent group view are dropped (it effectively leaves the
@@ -82,13 +87,17 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   allreduce_timeout: float = 60.0,
                   codec: Optional[int] = None,
                   adaptive_threshold: int =
-                  compression.SIZE_ADAPTIVE_THRESHOLD) -> List[np.ndarray]:
+                  compression.SIZE_ADAPTIVE_THRESHOLD,
+                  sender_timeout: Optional[float] = None) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
     ``weight`` is this peer's contribution weight (its accumulated sample
     count, hivemind's per-peer weighting). ``codec=None`` selects
     SizeAdaptive per part with ``adaptive_threshold``; receivers decode
-    whatever codec the wire header names.
+    whatever codec the wire header names. ``sender_timeout`` bounds how
+    long the reduce phase waits without receiving any new chunk before
+    banning the missing senders (default: a quarter of the round budget),
+    so one dead peer cannot burn the whole round's budget.
     """
     flat = flatten_tensors(tensors)
     owners = [m for m in group.members if m.addr]  # part owners
@@ -100,6 +109,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     my_part = owner_index.get(me.peer_id)  # None in client mode
     slices = _part_slices(flat.size, len(owners))
     deadline = time.monotonic() + allreduce_timeout
+    # the reduce phase may consume at most this much of the budget, so the
+    # gather phase is never starved by a dead sender (one shared deadline
+    # previously let a single dead peer degrade the round to no averaging)
+    reduce_deadline = time.monotonic() + 0.5 * allreduce_timeout
+    if sender_timeout is None:
+        sender_timeout = max(1.0, 0.25 * allreduce_timeout)
 
     def part_codec(n: int) -> int:
         if codec is None:
@@ -136,9 +151,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             expected = {i for i, m in enumerate(group.members)
                         if m.peer_id != me.peer_id}
             my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
-            while expected and time.monotonic() < deadline:
+            last_progress = time.monotonic()
+            while expected:
+                now = time.monotonic()
+                if now >= reduce_deadline:
+                    break  # ban remaining senders; gather keeps its budget
+                if now - last_progress >= sender_timeout:
+                    break  # no chunk for a while: remaining senders banned
                 raw = dht.recv(my_tag, timeout=min(
-                    0.5, max(0.05, deadline - time.monotonic())))
+                    0.5, max(0.05, reduce_deadline - now)))
                 if raw is None:
                     continue
                 parsed = _parse(raw, group, hi - lo)
@@ -150,24 +171,26 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 expected.discard(sender)
                 acc += data * w
                 total_w += w
+                last_progress = time.monotonic()
             averaged_mine = acc / total_w
 
         concurrent.futures.wait(futures)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
     out = flat.copy()
-    if my_part is not None:
-        lo, hi = slices[my_part]
-        out[lo:hi] = averaged_mine
 
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(8, group.size)) as pool:
         futures = []
         if my_part is not None:
+            lo, hi = slices[my_part]
             c = part_codec(averaged_mine.size)
+            wire = compression.compress(averaged_mine, c)
+            # apply the same lossy wire bytes locally so all members end
+            # the round with byte-identical values for this part
+            out[lo:hi] = compression.decompress(wire, c, averaged_mine.size)
             body = _HDR.pack(group.group_hash, group.my_index, 1.0,
-                             averaged_mine.size, c) \
-                + compression.compress(averaged_mine, c)
+                             averaged_mine.size, c) + wire
             for m in group.members:
                 if m.peer_id == me.peer_id or not m.addr:
                     continue
@@ -189,9 +212,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 group.members.index(m): owner_index[m.peer_id]
                 for m in owners}
             gather_tag = _tag(prefix, epoch, "gather", me.peer_id)
-            while pending and time.monotonic() < deadline:
+            last_progress = time.monotonic()
+            while pending:
+                now = time.monotonic()
+                if now >= deadline or now - last_progress >= sender_timeout:
+                    break  # dead owners: their parts keep local values
                 raw = dht.recv(gather_tag, timeout=min(
-                    0.5, max(0.05, deadline - time.monotonic())))
+                    0.5, max(0.05, deadline - now)))
                 if raw is None:
                     continue
                 head = _peek(raw, group)
@@ -208,12 +235,17 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 _, _, data = parsed
                 out[lo:hi] = data
                 del pending[part]
+                last_progress = time.monotonic()
             # parts never received keep this peer's local values (owner
             # died mid-round): degraded but well-defined
         else:
             # client mode: pull each averaged part from its owner's mailbox
             pending = {k: m for k, m in enumerate(owners)}
-            while pending and time.monotonic() < deadline:
+            last_progress = time.monotonic()
+            while pending:
+                now = time.monotonic()
+                if now >= deadline or now - last_progress >= sender_timeout:
+                    break
                 for k, owner in list(pending.items()):
                     raw = dht.fetch(
                         owner.addr, _tag(prefix, epoch, "mailbox",
@@ -229,6 +261,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     _, _, data = parsed
                     out[lo:hi] = data
                     del pending[k]
+                    last_progress = time.monotonic()
                 if pending:
                     time.sleep(0.1)
 

@@ -12,8 +12,9 @@ Protocol (epoch-scoped DHT key + leader confirmation):
    set has been stable for two polls and has >= 2 members).
 2. The candidate set is ordered by peer id; the lowest id is the *leader*.
    The leader sends the final member list to every follower over the data
-   plane; followers prefer the leader's list over their own DHT view, so
-   all members agree on the part assignment.
+   plane (and parks a copy in its mailbox for client-mode followers, who
+   have no listener to push to); followers prefer the leader's list over
+   their own DHT view, so all members agree on the part assignment.
 3. Residual disagreement (a follower that missed the confirmation and saw
    a different DHT snapshot) is tolerated downstream: every all-reduce
    message carries the group hash, and mismatching messages are dropped —
@@ -115,16 +116,38 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
         payload = msgpack.packb(
             [[m.peer_id, m.addr, m.weight] for m in members],
             use_bin_type=True)
+        if any(not m.addr for m in members):
+            # client-mode members have no listener: park the confirmation in
+            # the leader's mailbox for them to pull. Post BEFORE the send
+            # loop — sends to dead followers can block for confirm_wait
+            # each, and the clients' polling window would expire first.
+            dht.post(_confirm_tag(prefix, epoch, "clients"), payload,
+                     expiration_time=get_dht_time()
+                     + matchmaking_time * 4 + 60)
         for m in members:
             if m.peer_id == my_id or not m.addr:
                 continue
             dht.send(m.addr, _confirm_tag(prefix, epoch, m.peer_id), payload,
                      timeout=confirm_wait)
-    elif client_mode:
-        pass  # no listener: keep our own DHT view of the group
     else:
-        raw = dht.recv(_confirm_tag(prefix, epoch, my_id),
-                       timeout=confirm_wait)
+        if client_mode:
+            # pull from the leader's mailbox; poll, since the leader may
+            # still be finishing its own matchmaking window
+            raw = None
+            confirm_deadline = time.monotonic() + confirm_wait
+            while raw is None and leader.addr:
+                remaining = confirm_deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                raw = dht.fetch(leader.addr,
+                                _confirm_tag(prefix, epoch, "clients"),
+                                timeout=min(2.0, remaining))
+                if raw is None:
+                    time.sleep(min(0.2, max(0.0, confirm_deadline
+                                            - time.monotonic())))
+        else:
+            raw = dht.recv(_confirm_tag(prefix, epoch, my_id),
+                           timeout=confirm_wait)
         if raw is not None:
             try:
                 decoded = msgpack.unpackb(raw, raw=False)

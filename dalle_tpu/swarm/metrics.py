@@ -1,0 +1,80 @@
+"""Per-peer training metrics published through the DHT.
+
+Capability parity with the reference's monitoring records (``utils.py:15-30``
+defines a strict pydantic ``LocalMetrics``/``MetricSchema`` pair;
+``callback.py:60-86`` signs and stores one record per epoch under
+``{experiment_prefix}_metrics``; ``run_aux_peer.py:106-144`` aggregates them).
+
+:func:`make_validators` wires the same two defenses the reference installs
+at ``task.py:55`` — a signature validator whose public key is the peer
+identity, with the metrics key *protected* (unsigned records dropped), and a
+schema validator rejecting malformed values — so the aux peer only ever
+aggregates authenticated, well-formed metrics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pydantic
+
+from dalle_tpu.swarm.dht import (DHT, RecordValidatorBase, SchemaValidator,
+                                 SignatureValidator, get_dht_time)
+from dalle_tpu.swarm.identity import Identity
+
+
+class LocalMetrics(pydantic.BaseModel, extra="forbid"):
+    """One peer's per-epoch report (reference ``utils.py:15-21``)."""
+
+    peer_id: str
+    epoch: int
+    samples_per_second: float
+    samples_accumulated: int
+    loss: float
+    mini_steps: int
+
+
+def metrics_key(experiment_prefix: str) -> str:
+    return f"{experiment_prefix}_metrics"
+
+
+def make_validators(identity: Identity, experiment_prefix: str
+                    ) -> List[RecordValidatorBase]:
+    """The standard validator chain for a peer (reference ``utils.py:27-30``,
+    wired at ``task.py:55,111``)."""
+    return [
+        SchemaValidator({metrics_key(experiment_prefix): LocalMetrics}),
+        SignatureValidator(
+            identity, protected_keys=(metrics_key(experiment_prefix),)),
+    ]
+
+
+def publish_metrics(dht: DHT, experiment_prefix: str, record: LocalMetrics,
+                    expiration: float = 600.0) -> bool:
+    """Store this peer's epoch report (reference ``callback.py:80-86``)."""
+    return dht.store(
+        metrics_key(experiment_prefix), dht.peer_id,
+        record.model_dump(), expiration_time=get_dht_time() + expiration)
+
+
+def fetch_metrics(dht: DHT, experiment_prefix: str
+                  ) -> List[LocalMetrics]:
+    """All live peers' latest reports (reference ``run_aux_peer.py:107-118``).
+
+    Forged or malformed records were already dropped by the validator chain
+    on read; anything that still fails to parse is skipped defensively.
+    """
+    entries = dht.get(metrics_key(experiment_prefix)) or {}
+    out: List[LocalMetrics] = []
+    for item in entries.values():
+        try:
+            out.append(LocalMetrics.model_validate(item.value))
+        except pydantic.ValidationError:
+            continue
+    return out
+
+
+def peer_data_seed(identity: Identity, base_seed: int = 0) -> int:
+    """Per-peer shuffle seed derived from the peer identity (reference
+    ``run_trainer.py:46``: ``data_seed=hash(local_public_key)``)."""
+    return base_seed ^ int.from_bytes(identity.public_bytes[:8], "big")

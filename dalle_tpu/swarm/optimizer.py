@@ -87,12 +87,14 @@ class CollaborativeOptimizer:
         self._accumulate = jax.jit(
             lambda acc, g, s: jax.tree.map(
                 lambda a, b: a + b.astype(jnp.float32) * s, acc, g))
+        self._next_resync = 0.0
         self._server: Optional[StateServer] = None
         if serve_state and not client_mode:
             self._server = StateServer(
                 dht, cfg.run_id, self._state_snapshot,
                 codec=self._state_codec,
-                adaptive_threshold=cfg.size_adaptive_threshold).start()
+                adaptive_threshold=cfg.size_adaptive_threshold,
+                epoch_fn=lambda: self.local_epoch).start()
         self.tracker.report_local_progress(0, 0, force=True)
 
     # -- state (de)construction -----------------------------------------
@@ -134,9 +136,15 @@ class CollaborativeOptimizer:
 
         progress = self.tracker.global_progress()
         if progress.epoch > self.local_epoch:
-            logger.info("behind the swarm (local %d < global %d): resyncing",
-                        self.local_epoch, progress.epoch)
-            self.load_state_from_peers(min_epoch=progress.epoch)
+            # keep accumulating between throttled attempts: hammering
+            # load_state_from_peers starves the host (and the swarm's
+            # state servers) without helping us catch up any faster
+            if time.monotonic() >= self._next_resync:
+                logger.info(
+                    "behind the swarm (local %d < global %d): resyncing",
+                    self.local_epoch, progress.epoch)
+                self.load_state_from_peers(min_epoch=progress.epoch)
+                self._next_resync = time.monotonic() + 1.0
             return False
         if not progress.ready_to_update:
             return False
@@ -227,6 +235,14 @@ class CollaborativeOptimizer:
             logger.warning("load_state_from_peers: nobody answered")
             return False
         epoch, arrays = result
+        # accept only state that moves us forward; same-epoch state would
+        # wipe the gradient accumulator for nothing (except at epoch 0,
+        # where a fresh joiner synchronizes its random init with the swarm)
+        if epoch < self.local_epoch or (epoch == self.local_epoch
+                                        and self.local_epoch > 0):
+            logger.warning("ignoring stale peer state (epoch %d <= local %d)",
+                           epoch, self.local_epoch)
+            return False
         self._replace_state_leaves(arrays)
         self.local_epoch = max(epoch, self.local_epoch)
         self.local_samples = 0

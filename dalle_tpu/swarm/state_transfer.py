@@ -101,10 +101,17 @@ class StateServer:
                  codec: Optional[int] = None,
                  adaptive_threshold: int =
                  compression.SIZE_ADAPTIVE_THRESHOLD,
-                 max_concurrent_streams: int = 2):
+                 max_concurrent_streams: int = 2,
+                 epoch_fn: Optional[Callable[[], int]] = None):
         self.dht = dht
         self.prefix = prefix
         self.provider = provider
+        # cheap epoch probe so announcements refresh the moment the epoch
+        # advances; stale announced epochs otherwise starve resyncing
+        # stragglers for a whole period. Without it, announcements stay on
+        # the period cadence (probing via the provider would materialize
+        # the full state snapshot every loop tick).
+        self.epoch_fn = epoch_fn
         self.codec = codec
         self.adaptive_threshold = adaptive_threshold
         self.announce_period = announce_period
@@ -124,8 +131,7 @@ class StateServer:
         self._stop.set()
         self._thread.join(timeout=5.0)
 
-    def _announce(self) -> None:
-        epoch, _ = self.provider()
+    def _announce(self, epoch: int) -> None:
         self.dht.store(
             self.key, self.dht.peer_id,
             {"addr": self.dht.visible_address, "epoch": int(epoch)},
@@ -134,11 +140,22 @@ class StateServer:
     def _run(self) -> None:
         tag = _req_tag(self.prefix, self.dht.peer_id)
         last_announce = 0.0
+        last_epoch: Optional[int] = None
         while not self._stop.is_set():
             now = time.monotonic()
-            if now - last_announce >= self.announce_period:
+            epoch: Optional[int] = None
+            if self.epoch_fn is not None:
                 try:
-                    self._announce()
+                    epoch = int(self.epoch_fn())
+                except Exception:  # noqa: BLE001 - racing shutdown
+                    epoch = last_epoch
+            due = now - last_announce >= self.announce_period
+            if due or (epoch is not None and epoch != last_epoch):
+                try:
+                    if epoch is None:
+                        epoch = int(self.provider()[0])
+                    self._announce(epoch)
+                    last_epoch = epoch
                 except Exception:  # noqa: BLE001 - dht may be shutting down
                     pass
                 last_announce = now
@@ -195,8 +212,13 @@ def load_state_from_peers(dht: DHT, prefix: str,
                           ) -> Optional[Tuple[int, List[np.ndarray]]]:
     """Download (epoch, arrays) from the freshest advertised state server.
 
-    Tries servers in descending epoch order; returns None if nobody
-    suitable answered within ``timeout``.
+    Tries servers in descending *advertised* epoch order. Advertisements
+    are stale lower bounds (servers re-announce on epoch change, but the
+    record still has store/propagation latency), so servers advertising
+    less than ``min_epoch`` are still tried; the epoch that matters is the
+    one in the downloaded state. If nobody serves ``min_epoch`` or newer,
+    the freshest state actually received is returned — catching a
+    straggler up partway beats returning nothing.
     """
     entries = dht.get(f"{prefix}_state_servers") or {}
     servers = []
@@ -211,12 +233,20 @@ def load_state_from_peers(dht: DHT, prefix: str,
     servers.sort(reverse=True)
 
     deadline = time.monotonic() + timeout
-    for epoch, addr, pid in servers:
-        if epoch < min_epoch:
-            break
+    best: Optional[Tuple[int, List[np.ndarray]]] = None
+    tried_below_min = False
+    for advertised, addr, pid in servers:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
+        if advertised < min_epoch:
+            # below min_epoch, advertisements are sorted descending: pull
+            # only the freshest such server as the fallback — sweeping the
+            # full state from every server would multiply the traffic for
+            # strictly staler results
+            if tried_below_min:
+                break
+            tried_below_min = True
         nonce = np.random.bytes(16)
         reply_addr = "" if dht.client_mode else dht.visible_address
         req = msgpack.packb({"addr": reply_addr, "nonce": nonce},
@@ -231,10 +261,14 @@ def load_state_from_peers(dht: DHT, prefix: str,
         if blob is None:
             continue
         try:
-            return deserialize_state(blob)
+            result = deserialize_state(blob)
         except Exception:  # noqa: BLE001 - corrupt stream
             continue
-    return None
+        if result[0] >= min_epoch:
+            return result
+        if best is None or result[0] > best[0]:
+            best = result
+    return best
 
 
 def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,

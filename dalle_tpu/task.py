@@ -1,0 +1,173 @@
+"""Task assembly: lazily wire mesh, model, data, DHT and swarm optimizer.
+
+Capability parity with the reference's ``TrainingTask`` (``task.py:25-181``):
+one container that every entry point (trainer peer, aux peer, inference)
+shares, building each subsystem on first access so an aux peer never pays
+for a model it does not train and a trainer never opens a DHT it was not
+asked to join. The TPU-native differences: the model is a jitted Flax module
+over a ``jax.sharding.Mesh`` (replacing the reference's torch_xla
+``TPUManager`` child process, ``lib/training/tpu.py``), and the optimizer
+step runs on device (the reference's CPU offload was a GPU-peer workaround,
+``task.py:130``).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from dalle_tpu.config import (CollabConfig, ModelConfig, OptimizerConfig,
+                              PeerConfig, TrainerConfig)
+from dalle_tpu.swarm.metrics import make_validators, peer_data_seed
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingTask:
+    """Lazy container: each property builds its subsystem on first use."""
+
+    def __init__(self,
+                 model: ModelConfig,
+                 optimizer: OptimizerConfig,
+                 trainer: TrainerConfig,
+                 collab: CollabConfig,
+                 peer: PeerConfig,
+                 data_path: Optional[str] = None):
+        model.validate()
+        self.model_cfg = model
+        self.opt_cfg = optimizer
+        self.trainer_cfg = trainer
+        self.collab_cfg = collab
+        self.peer_cfg = peer
+        self.data_path = data_path
+
+    # -- identity / swarm -------------------------------------------------
+
+    @functools.cached_property
+    def identity(self):
+        from dalle_tpu.swarm.identity import Identity
+        return Identity.load_or_create(self.peer_cfg.identity_path)
+
+    @functools.cached_property
+    def dht(self):
+        """This peer's swarm node (reference ``task.py:101-119``)."""
+        from dalle_tpu.swarm.dht import DHT
+        dht = DHT(host=self.peer_cfg.host,
+                  port=self.peer_cfg.port,
+                  initial_peers=self.peer_cfg.initial_peers,
+                  client_mode=self.peer_cfg.client_mode,
+                  identity=self.identity,
+                  record_validators=make_validators(
+                      self.identity, self.peer_cfg.experiment_prefix))
+        logger.info("swarm node up: peer_id=%s addr=%s",
+                    dht.peer_id[:16], dht.visible_address)
+        return dht
+
+    @functools.cached_property
+    def collab_optimizer(self):
+        """Swarm-synchronous optimizer owning the train state (reference
+        ``task.py:121-135``)."""
+        from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+        return CollaborativeOptimizer(
+            self.dht, self.collab_cfg, self.train_state, self.apply_step,
+            client_mode=self.peer_cfg.client_mode)
+
+    # -- mesh / compute ---------------------------------------------------
+
+    @functools.cached_property
+    def mesh(self):
+        from dalle_tpu.parallel.mesh import make_mesh
+        t = self.trainer_cfg
+        return make_mesh(dp=t.dp, fsdp=t.fsdp, tp=t.tp, sp=t.sp)
+
+    @functools.cached_property
+    def model(self):
+        from dalle_tpu.models.dalle import DALLE
+        return DALLE(self.model_cfg)
+
+    @functools.cached_property
+    def tx(self):
+        from dalle_tpu.optim import make_optimizer
+        return make_optimizer(self.opt_cfg)
+
+    @functools.cached_property
+    def train_state(self):
+        """Initial sharded TrainState (fresh params; checkpoint restore is
+        the trainer loop's job, reference ``task.py:88-93``)."""
+        from dalle_tpu.models.dalle import init_params
+        from dalle_tpu.parallel.sharding import shard_train_state
+        from dalle_tpu.training.steps import TrainState
+        params = init_params(self.model,
+                             jax.random.PRNGKey(self.trainer_cfg.seed))
+        return shard_train_state(self.mesh,
+                                 TrainState.create(params, self.tx))
+
+    @functools.cached_property
+    def grad_step(self):
+        """Jitted (params, batch) -> (grads, metrics); the per-minibatch
+        device program (reference ``lib/training/tpu.py:119-126``)."""
+        from dalle_tpu.training.steps import make_grad_step
+        return jax.jit(make_grad_step(self.model))
+
+    @functools.cached_property
+    def apply_step(self):
+        """Jitted (state, averaged_grads) -> state; the once-per-epoch
+        optimizer update (reference ``run_trainer_tpu.py:85-88`` seam)."""
+        from dalle_tpu.training.steps import make_apply_step
+        return jax.jit(make_apply_step(self.tx), donate_argnums=0)
+
+    # -- data -------------------------------------------------------------
+
+    @property
+    def data_shards(self) -> int:
+        return self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+
+    @property
+    def local_batch_size(self) -> int:
+        """Samples contributed per grad_step call on this peer (reference
+        ``arguments.py:39-56``: device batch x accum x device count)."""
+        t = self.trainer_cfg
+        return t.per_device_batch * t.grad_accum_steps * self.data_shards
+
+    @functools.cached_property
+    def data_seed(self) -> int:
+        """Per-peer shuffle seed so peers see different data (reference
+        ``run_trainer.py:46``, ``hf_trainer.py:30-33``)."""
+        return peer_data_seed(self.identity, self.trainer_cfg.seed)
+
+    @functools.cached_property
+    def dataset(self):
+        if self.data_path is not None:
+            from dalle_tpu.data.dataset import CodesDataset
+            return CodesDataset(self.data_path, self.model_cfg)
+        from dalle_tpu.data.synthetic import SyntheticCodes
+        return SyntheticCodes(
+            self.model_cfg,
+            num_samples=max(64, 2 * self.local_batch_size),
+            seed=self.trainer_cfg.seed)
+
+    def batches(self) -> Iterator[Dict[str, jax.Array]]:
+        """Device-placed batches, sharded over the mesh's data axes."""
+        from dalle_tpu.parallel.mesh import batch_sharding
+        sharding = batch_sharding(self.mesh)
+        for batch in self.dataset.batches(self.local_batch_size,
+                                          seed=self.data_seed):
+            yield jax.device_put(batch, sharding)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def shutdown(self) -> None:
+        if "collab_optimizer" in self.__dict__:
+            self.collab_optimizer.shutdown()
+        if "dht" in self.__dict__:
+            self.dht.shutdown()
+
+    def __enter__(self) -> "TrainingTask":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

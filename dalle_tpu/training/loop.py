@@ -1,0 +1,119 @@
+"""The trainer peer's host loop: warmup self-check, then accumulate/step.
+
+Capability parity with the reference's hand-rolled TPU host loop
+(``run_trainer_tpu.py:47-91``): 3 warmup steps validate compile + data flow
+before joining the swarm; then forever: draw a batch, run the jitted
+grad step, hand the gradients to the collaborative optimizer, and do
+per-epoch bookkeeping (metrics publish, checkpoints) through callbacks.
+The reference's "copy grads -> hivemind step -> push params" seam
+(``run_trainer_tpu.py:85-88``) collapses here to
+``grad_step -> collab.step``: gradients stay on device until the swarm
+round needs them on the host.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from dalle_tpu.swarm.metrics import LocalMetrics, publish_metrics
+from dalle_tpu.task import TrainingTask
+
+logger = logging.getLogger(__name__)
+
+
+class EpochReport:
+    """What the loop knows at the end of a global step."""
+
+    def __init__(self, epoch: int, loss: float, mini_steps: int,
+                 samples_per_second: float):
+        self.epoch = epoch
+        self.loss = loss
+        self.mini_steps = mini_steps
+        self.samples_per_second = samples_per_second
+
+
+def warmup(task: TrainingTask, steps: int = 3) -> float:
+    """Compile + run the grad step a few times before joining the swarm
+    (the reference's explicit warmup, ``run_trainer_tpu.py:47-57``).
+    Returns the last warmup loss; raises if it is not finite."""
+    batches = task.batches()
+    params = task.collab_optimizer.state.params
+    loss = float("nan")
+    for i in range(steps):
+        t0 = time.monotonic()
+        grads, metrics = task.grad_step(params, next(batches))
+        jax.block_until_ready(grads)
+        loss = float(metrics["loss"])
+        logger.info("warmup %d/%d: loss=%.4f (%.2fs)",
+                    i + 1, steps, loss, time.monotonic() - t0)
+    if not np.isfinite(loss):
+        raise RuntimeError(f"warmup produced non-finite loss {loss}")
+    # warmup gradients are discarded; the tracker timer starts fresh
+    task.collab_optimizer.tracker.performance_ema.reset_timer()
+    return loss
+
+
+def train_loop(task: TrainingTask,
+               max_epochs: Optional[int] = None,
+               max_steps: Optional[int] = None,
+               warmup_steps: int = 3,
+               publish_metrics_records: bool = True,
+               on_epoch: Optional[Callable[[EpochReport], None]] = None,
+               on_step: Optional[Callable[[int, float], None]] = None
+               ) -> List[EpochReport]:
+    """Run the peer until ``max_epochs`` global steps (None = forever).
+
+    Returns the per-epoch reports (for tests and the CLI's summary).
+    """
+    collab = task.collab_optimizer
+    if warmup_steps:
+        warmup(task, warmup_steps)
+
+    reports: List[EpochReport] = []
+    loss_sum, mini_steps, local_steps = 0.0, 0, 0
+    batches = task.batches()
+    while ((max_epochs is None or collab.local_epoch < max_epochs)
+           and (max_steps is None or local_steps < max_steps)):
+        batch = next(batches)
+        grads, metrics = task.grad_step(collab.state.params, batch)
+        loss = float(metrics["loss"])
+        loss_sum += loss
+        mini_steps += 1
+        local_steps += 1
+        if on_step is not None:
+            on_step(local_steps, loss)
+
+        epoch_before = collab.local_epoch
+        did_global = collab.step(grads, batch_size=task.local_batch_size)
+        if collab.local_epoch != epoch_before:
+            # global step OR resync-from-peers: either way a new epoch
+            report = EpochReport(
+                epoch=collab.local_epoch,
+                loss=loss_sum / max(mini_steps, 1),
+                mini_steps=mini_steps,
+                samples_per_second=(
+                    collab.tracker.performance_ema.samples_per_second))
+            reports.append(report)
+            if did_global and publish_metrics_records:
+                publish_metrics(
+                    task.dht, task.peer_cfg.experiment_prefix,
+                    LocalMetrics(
+                        peer_id=task.dht.peer_id,
+                        epoch=report.epoch,
+                        samples_per_second=report.samples_per_second,
+                        samples_accumulated=0,
+                        loss=report.loss,
+                        mini_steps=report.mini_steps),
+                    expiration=task.collab_cfg.metrics_expiration)
+            logger.info("epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",
+                        report.epoch, report.loss, report.mini_steps,
+                        report.samples_per_second)
+            if on_epoch is not None:
+                on_epoch(report)
+            loss_sum, mini_steps = 0.0, 0
+    return reports

@@ -1,0 +1,104 @@
+"""Integration tests: the trainer CLI as real subprocesses on localhost.
+
+The transferable strategy from SURVEY.md §4: many real peers in one box on
+loopback, real wire protocol, real process boundaries. These are the
+slowest tests in the suite (each subprocess pays a fresh JAX init + tiny
+compile on a single-core VM), so there is exactly one two-peer test.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_env() -> dict:
+    env = dict(os.environ)
+    # children must see exactly ONE cpu device (the parent's conftest spoofs
+    # 8) and must not dial the TPU relay (sitecustomize does when the pool
+    # var is set)
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def launch_trainer(port: int, metrics_file: Path, *extra: str,
+                   max_epochs: int = 5) -> subprocess.Popen:
+    args = [
+        sys.executable, "-m", "dalle_tpu.cli.run_trainer",
+        "--preset", "tiny", "--platform", "cpu",
+        "--max-epochs", str(max_epochs),
+        "--target-batch-size", "64", "--per-device-batch", "8",
+        "--matchmaking-time", "3", "--allreduce-timeout", "15",
+        "--averaging-timeout", "30",
+        "--warmup-batches", "1", "--warmup-steps", "5",
+        "--learning-rate", "5e-3",
+        "--port", str(port),
+        "--metrics-file", str(metrics_file),
+        *extra,
+    ]
+    return subprocess.Popen(args, env=child_env(), cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def read_metrics(path: Path):
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+class TestTrainerCLI:
+    def test_two_peers_cotrain_from_shell(self, tmp_path):
+        """Two trainer processes co-train on localhost: both finish, they
+        form real averaging groups, and the loss falls (VERDICT round-1
+        'Next round' item 2; reference run_trainer_tpu.py:26-91)."""
+        port_a, port_b = free_port(), free_port()
+        metrics_a, metrics_b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+
+        proc_a = launch_trainer(port_a, metrics_a)
+        try:
+            time.sleep(8)  # let A's swarm node come up and A start training
+            proc_b = launch_trainer(
+                port_b, metrics_b,
+                "--initial-peers", f"127.0.0.1:{port_a}")
+            try:
+                out_a = proc_a.communicate(timeout=240)[0]
+                out_b = proc_b.communicate(timeout=240)[0]
+            except subprocess.TimeoutExpired:
+                proc_a.kill()
+                proc_b.kill()
+                raise
+        finally:
+            for p in (proc_a, locals().get("proc_b")):
+                if p is not None and p.poll() is None:
+                    p.kill()
+
+        assert proc_a.returncode == 0, out_a[-4000:]
+        assert proc_b.returncode == 0, out_b[-4000:]
+
+        rows_a = read_metrics(metrics_a)
+        rows_b = read_metrics(metrics_b)
+        assert len(rows_a) == 5, out_a[-4000:]
+        assert rows_b, out_b[-4000:]
+
+        # collaboration actually happened: at least one averaging group of 2
+        assert "group=2" in out_a + out_b, (out_a[-2000:], out_b[-2000:])
+        # the co-trained model is learning the synthetic mapping
+        assert rows_a[-1]["loss"] < rows_a[0]["loss"] - 0.01, rows_a

@@ -187,6 +187,61 @@ class TestAllReduce:
             np.testing.assert_allclose(res[0], expected, rtol=5e-3,
                                        atol=5e-3)
 
+    def test_lossy_rounds_are_byte_identical(self, swarm3):
+        """Part owners apply the same compressed wire bytes they broadcast,
+        so all members end a lossy round with byte-identical values (the
+        precondition for 'identical updates keep peers bit-synchronized')."""
+        rng = np.random.RandomState(11)
+        tensors = [[rng.randn(90000).astype(np.float32)] for _ in swarm3]
+
+        def peer(i):
+            g = make_group(swarm3[i], "arb", epoch=4, weight=1.0,
+                           matchmaking_time=3.0, min_group_size=3)
+            return run_allreduce(swarm3[i], g, "arb", 4, tensors[i],
+                                 weight=1.0, allreduce_timeout=10.0,
+                                 codec=compression.UNIFORM8BIT)
+
+        results = run_threads([lambda i=i: peer(i) for i in range(3)])
+        for res in results[1:]:
+            np.testing.assert_array_equal(res[0], results[0][0])
+
+    def test_dead_sender_leaves_gather_budget(self, swarm3):
+        """One dead group member must not burn the whole round budget in
+        the reduce phase: survivors still exchange their averaged parts in
+        the gather phase (per-sender timeout + split budget)."""
+        rng = np.random.RandomState(12)
+        tensors = [[rng.randn(300).astype(np.float32)] for _ in swarm3]
+
+        def peer(i):
+            g = make_group(swarm3[i], "arg", epoch=5, weight=1.0,
+                           matchmaking_time=3.0, min_group_size=3)
+            assert g is not None and g.size == 3
+            if i == 2:
+                return g, None  # dies silently after matchmaking
+            res = run_allreduce(swarm3[i], g, "arg", 5, tensors[i],
+                                weight=1.0, allreduce_timeout=6.0,
+                                sender_timeout=1.0,
+                                codec=compression.NONE)
+            return g, res
+
+        results = run_threads([lambda i=i: peer(i) for i in range(3)])
+        group = results[0][0]
+        flats = [flatten_tensors(t) for t in tensors]
+        slices = _part_slices(flats[0].size, 3)
+        member_ids = [m.peer_id for m in group.members]
+        live_avg = (flats[0] + flats[1]) / 2
+        for i in (0, 1):
+            _, res = results[i]
+            got = flatten_tensors(res)
+            other = 1 - i
+            other_part = member_ids.index(swarm3[other].peer_id)
+            lo, hi = slices[other_part]
+            # the *other survivor's* part arrived via gather — under the old
+            # shared deadline the stalled reduce left gather no budget and
+            # this stayed at the local value
+            np.testing.assert_allclose(got[lo:hi], live_avg[lo:hi],
+                                       rtol=1e-5, atol=1e-6)
+
     def test_peer_dies_after_matchmaking(self, swarm3):
         """A group member that never shows up for the all-reduce is dropped:
         survivors finish fast with the dead peer's weight excluded on their
@@ -321,6 +376,53 @@ class TestStateTransfer:
 
     def test_no_server_returns_none(self, swarm3):
         assert load_state_from_peers(swarm3[1], "empty", timeout=1.0) is None
+
+    def test_stale_advertisement_still_served(self, swarm3):
+        """Advertised epochs are stale lower bounds: a client demanding a
+        newer epoch than any advertisement must still download and get the
+        freshest state actually held (previously it gave up immediately)."""
+        arrays = [np.full((8,), 1.5, np.float32)]
+        server = StateServer(swarm3[0], "stale", lambda: (5, arrays),
+                             announce_period=0.2)
+        server.start()
+        try:
+            deadline = time.monotonic() + 10
+            result = None
+            while result is None and time.monotonic() < deadline:
+                result = load_state_from_peers(swarm3[1], "stale",
+                                               min_epoch=9, timeout=3.0)
+            assert result is not None
+            assert result[0] == 5  # freshest available, below min_epoch
+        finally:
+            server.stop()
+
+    def test_announce_refreshes_on_epoch_change(self, swarm3):
+        """The server re-announces as soon as its epoch advances, not a
+        full announce_period later (stragglers resync promptly)."""
+        epoch_box = {"e": 0}
+        arrays = [np.zeros((4,), np.float32)]
+        server = StateServer(swarm3[0], "fresh",
+                             lambda: (epoch_box["e"], arrays),
+                             announce_period=60.0,
+                             epoch_fn=lambda: epoch_box["e"])
+        server.start()
+        try:
+            def advertised_epoch():
+                entries = swarm3[2].get("fresh_state_servers") or {}
+                return max((item.value.get("epoch", -1)
+                            for item in entries.values()), default=None)
+
+            deadline = time.monotonic() + 10
+            while advertised_epoch() != 0 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert advertised_epoch() == 0
+            epoch_box["e"] = 3
+            deadline = time.monotonic() + 10
+            while advertised_epoch() != 3 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert advertised_epoch() == 3  # well before announce_period
+        finally:
+            server.stop()
 
 
 def _make_collab_peer(dht, cfg, seed=0):
