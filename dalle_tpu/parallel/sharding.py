@@ -90,14 +90,63 @@ def param_shardings(mesh: Mesh, params) -> Any:
     return jax.tree.map(_fix, params, specs)
 
 
-def shard_train_state(mesh: Mesh, state):
-    """Place a TrainState on the mesh: params per PARAM_RULES, step and
-    optimizer state replicated. The single canonical placement used by the
-    driver dry-run, the benchmark, and the trainer CLI."""
+def opt_state_shardings(mesh: Mesh, opt_state, params) -> Any:
+    """Shardings for optimizer state: moment trees (same treedef as the
+    params) inherit the param shardings; block-quantized moments shard
+    their (n_blocks, ...) codes/absmax over the fsdp axis; everything else
+    (step counts, scalars) replicates.
+
+    Replicating fp32 moments — the largest tensors in training — on every
+    chip would defeat FSDP and negate the memory point of 8-bit state.
+    """
+    from dalle_tpu.ops.quant import Quantized
+
     rep = NamedSharding(mesh, P())
+    pshards = param_shardings(mesh, params)
+    ptreedef = jax.tree.structure(params)
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def _is_q(x) -> bool:
+        return isinstance(x, Quantized)
+
+    def _quantized_shardings(q: Quantized) -> Quantized:
+        blocks = NamedSharding(
+            mesh,
+            P("fsdp") if fsdp > 1 and q.codes.shape[0] % fsdp == 0 else P())
+        return Quantized(codes=blocks, absmax=blocks,
+                         shape=q.shape, signed=q.signed)
+
+    def _moment_tree(tree):
+        # dense moment leaves share their param's shape, so its sharding
+        # applies directly
+        def f(m, s):
+            return _quantized_shardings(m) if _is_q(m) else s
+        return jax.tree.map(f, tree, pshards, is_leaf=_is_q)
+
+    def place(node):
+        try:
+            if jax.tree.structure(node, is_leaf=_is_q) == ptreedef:
+                return _moment_tree(node)
+        except (TypeError, ValueError):
+            pass
+        if isinstance(node, tuple):
+            rebuilt = [place(child) for child in node]
+            return (type(node)(*rebuilt) if hasattr(node, "_fields")
+                    else tuple(rebuilt))
+        return jax.tree.map(lambda _: rep, node)
+
+    return place(opt_state)
+
+
+def shard_train_state(mesh: Mesh, state):
+    """Place a TrainState on the mesh: params per PARAM_RULES, optimizer
+    moments inheriting the param shardings (Quantized codes/absmax sharded
+    over fsdp), step counters replicated. The single canonical placement
+    used by the driver dry-run, the benchmark, and the trainer CLI."""
+    rep = NamedSharding(mesh, P())
+    opt_sh = opt_state_shardings(mesh, state.opt_state, state.params)
     return type(state)(
         step=jax.device_put(state.step, rep),
         params=jax.device_put(state.params, param_shardings(mesh,
                                                             state.params)),
-        opt_state=jax.tree.map(lambda x: jax.device_put(x, rep),
-                               state.opt_state))
+        opt_state=jax.tree.map(jax.device_put, state.opt_state, opt_sh))

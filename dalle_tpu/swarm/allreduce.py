@@ -13,13 +13,15 @@ Shape of one round, group of N members (sorted by peer id), member i:
              send my local data for part j to member j (compressed).
   reduce   — collect the other N-1 members' chunks of part i; average with
              per-peer sample weights. A sender that makes no progress for
-             ``sender_timeout`` (or misses the reduce-phase budget — a
-             fraction of ``allreduce_timeout``, so gather always keeps
-             time) is excluded and its weight dropped — hivemind's
-             ban-and-proceed, bounded per sender rather than per round.
+             ``sender_timeout`` is excluded and its weight dropped —
+             hivemind's ban-and-proceed, bounded per missing sender rather
+             than per round, so gather keeps budget whenever a peer dies
+             (while actively streaming senders are never banned early).
   gather   — send the averaged part i to every member; collect the other
-             averaged parts; parts whose owner died fall back to this
-             peer's locally-weighted value, so the round always returns.
+             averaged parts (no-progress-bounded like reduce, with the
+             timer anchored past the senders' own legitimate stall);
+             parts whose owner died fall back to this peer's
+             locally-weighted value, so the round always returns.
              The part owner applies the same compress->decompress result
              it broadcasts, so every member ends the round with
              byte-identical averaged values even under lossy codecs.
@@ -108,13 +110,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     owner_index = {m.peer_id: k for k, m in enumerate(owners)}
     my_part = owner_index.get(me.peer_id)  # None in client mode
     slices = _part_slices(flat.size, len(owners))
-    deadline = time.monotonic() + allreduce_timeout
-    # the reduce phase may consume at most this much of the budget, so the
-    # gather phase is never starved by a dead sender (one shared deadline
-    # previously let a single dead peer degrade the round to no averaging)
-    reduce_deadline = time.monotonic() + 0.5 * allreduce_timeout
+    t0 = time.monotonic()
+    deadline = t0 + allreduce_timeout
     if sender_timeout is None:
         sender_timeout = max(1.0, 0.25 * allreduce_timeout)
+    # Gather no-progress timers start no earlier than this: senders that
+    # stalled on a dead peer legitimately post their parts only after their
+    # own sender_timeout fires, so a receiver counting from gather entry
+    # would give up the moment the parts appear.
+    gather_baseline = t0 + 0.5 * allreduce_timeout
 
     def part_codec(n: int) -> int:
         if codec is None:
@@ -154,12 +158,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             last_progress = time.monotonic()
             while expected:
                 now = time.monotonic()
-                if now >= reduce_deadline:
-                    break  # ban remaining senders; gather keeps its budget
+                if now >= deadline:
+                    break
                 if now - last_progress >= sender_timeout:
                     break  # no chunk for a while: remaining senders banned
                 raw = dht.recv(my_tag, timeout=min(
-                    0.5, max(0.05, reduce_deadline - now)))
+                    0.5, max(0.05, deadline - now)))
                 if raw is None:
                     continue
                 parsed = _parse(raw, group, hi - lo)
@@ -212,7 +216,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 group.members.index(m): owner_index[m.peer_id]
                 for m in owners}
             gather_tag = _tag(prefix, epoch, "gather", me.peer_id)
-            last_progress = time.monotonic()
+            last_progress = max(time.monotonic(), gather_baseline)
             while pending:
                 now = time.monotonic()
                 if now >= deadline or now - last_progress >= sender_timeout:
@@ -241,7 +245,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         else:
             # client mode: pull each averaged part from its owner's mailbox
             pending = {k: m for k, m in enumerate(owners)}
-            last_progress = time.monotonic()
+            last_progress = max(time.monotonic(), gather_baseline)
             while pending:
                 now = time.monotonic()
                 if now >= deadline or now - last_progress >= sender_timeout:

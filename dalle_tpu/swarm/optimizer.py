@@ -197,12 +197,17 @@ class CollaborativeOptimizer:
     # -- drift control / recovery ----------------------------------------
 
     def _average_state(self) -> None:
-        """Butterfly-average float state leaves (params + float opt stats).
+        """Butterfly-average the float content of the state (params + opt
+        statistics).
 
-        Integer leaves (step counters, 8-bit moment codes) stay local:
-        identical updates keep them synchronized, and lossy averaging of
-        code arrays would be meaningless (hivemind equally averages only
-        the tensors the optimizer exposes as floats)."""
+        Block-quantized moments are dequantized before averaging and
+        requantized after: averaging their absmax scales against another
+        peer's codes would corrupt the moments precisely in the divergent-
+        peer situation state averaging exists for. Integer step counters
+        stay local (identical updates keep them synchronized)."""
+        from dalle_tpu.ops.quant import (Quantized, dequantize_blockwise,
+                                         quantize_blockwise)
+
         group = make_group(
             self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
             weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
@@ -210,19 +215,47 @@ class CollaborativeOptimizer:
             client_mode=self.client_mode)
         if group is None or group.size <= 1:
             return
-        leaves = self._state_leaves()
-        float_idx = [i for i, a in enumerate(leaves)
-                     if compression.is_float_dtype(a.dtype)]
-        floats = [leaves[i].astype(np.float32) for i in float_idx]
+        tree = (self.state.params, self.state.opt_state)
+        is_q = lambda x: isinstance(x, Quantized)  # noqa: E731
+        leaves = jax.tree_util.tree_leaves(tree, is_leaf=is_q)
+        float_idx, floats = [], []
+        for i, leaf in enumerate(leaves):
+            if is_q(leaf):
+                float_idx.append(i)
+                floats.append(np.asarray(dequantize_blockwise(leaf),
+                                         dtype=np.float32))
+            elif compression.is_float_dtype(
+                    getattr(leaf, "dtype", np.asarray(leaf).dtype)):
+                float_idx.append(i)
+                floats.append(np.asarray(leaf, dtype=np.float32))
         averaged = run_allreduce(
             self.dht, group, f"{self.cfg.run_id}_state", self.local_epoch,
             floats, weight=1.0,
             allreduce_timeout=self.cfg.allreduce_timeout,
             codec=self._state_codec,
             adaptive_threshold=self.cfg.size_adaptive_threshold)
-        for i, a in zip(float_idx, averaged):
-            leaves[i] = a
-        self._replace_state_leaves(leaves)
+        new_leaves = list(leaves)
+        for i, avg in zip(float_idx, averaged):
+            old = leaves[i]
+            if is_q(old):
+                requant = quantize_blockwise(
+                    jnp.asarray(avg.reshape(old.shape)),
+                    block_size=old.codes.shape[1], signed=old.signed)
+                # keep the mesh placement (sharded moments must stay
+                # sharded or the next jitted step recompiles/replicates)
+                new_leaves[i] = type(old)(
+                    codes=jax.device_put(requant.codes, old.codes.sharding),
+                    absmax=jax.device_put(requant.absmax,
+                                          old.absmax.sharding),
+                    shape=old.shape, signed=old.signed)
+            else:
+                arr = jnp.asarray(avg.reshape(old.shape)).astype(old.dtype)
+                new_leaves[i] = jax.device_put(
+                    arr, old.sharding) if hasattr(old, "sharding") \
+                    else jax.device_put(arr)
+        treedef = jax.tree_util.tree_structure(tree, is_leaf=is_q)
+        params, opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        self.state = self.state.replace(params=params, opt_state=opt_state)
 
     def load_state_from_peers(self, min_epoch: int = 0,
                               timeout: Optional[float] = None) -> bool:

@@ -234,19 +234,17 @@ def load_state_from_peers(dht: DHT, prefix: str,
 
     deadline = time.monotonic() + timeout
     best: Optional[Tuple[int, List[np.ndarray]]] = None
-    tried_below_min = False
     for advertised, addr, pid in servers:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
         if advertised < min_epoch:
-            # below min_epoch, advertisements are sorted descending: pull
-            # only the freshest such server as the fallback — sweeping the
-            # full state from every server would multiply the traffic for
-            # strictly staler results
-            if tried_below_min:
+            # below min_epoch, advertisements are sorted descending: once a
+            # fallback download is in hand, further servers are strictly
+            # staler — stop sweeping. Failed attempts (dead server) don't
+            # count; the next stale server still gets its chance.
+            if best is not None:
                 break
-            tried_below_min = True
         nonce = np.random.bytes(16)
         reply_addr = "" if dht.client_mode else dht.visible_address
         req = msgpack.packb({"addr": reply_addr, "nonce": nonce},
