@@ -63,6 +63,11 @@ class ModelConfig:
     # Memory saving: jax.checkpoint (remat) replaces the reference's
     # reversible layers (task.py:81) with the XLA-idiomatic equivalent.
     remat: bool = True
+    # None = blanket remat (save only block boundaries); "save_attn"
+    # additionally saves rotated q/k/v + attention context so backward
+    # skips recomputing projections and attention (more memory, less
+    # compute).
+    remat_policy: Optional[str] = None
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
 

@@ -66,13 +66,33 @@ def _rotate_half(x: jax.Array) -> jax.Array:
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
+@functools.lru_cache(maxsize=8)
+def _rotation_matrix(head_dim: int) -> np.ndarray:
+    """(d, d) matrix R with x @ R == rotate_half(x).
+
+    The concat/slice lowering of rotate_half costs two HBM copies per q/k
+    per layer (it was the largest single line in the step profile); as a
+    tiny matmul it rides the MXU and fuses with the surrounding elementwise
+    multiply-adds.
+    """
+    half = head_dim // 2
+    r = np.zeros((head_dim, head_dim), dtype=np.float32)
+    for i in range(half):
+        r[half + i, i] = -1.0   # out[..., :half] = -x2
+        r[i, half + i] = 1.0    # out[..., half:] = x1
+    return r
+
+
 def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """Apply rotary embedding. x: (..., T, H, d); cos/sin: (T, d) or (..., T, d)."""
     if cos.ndim < x.ndim:  # insert the heads axis for broadcasting
         cos = cos[..., :, None, :]
         sin = sin[..., :, None, :]
     xf = x.astype(jnp.float32)
-    out = xf * cos + _rotate_half(xf) * sin
+    rot = jnp.einsum("...d,de->...e", xf,
+                     jnp.asarray(_rotation_matrix(x.shape[-1])),
+                     preferred_element_type=jnp.float32)
+    out = xf * cos + rot * sin
     return out.astype(x.dtype)
 
 
@@ -185,24 +205,61 @@ def _axial_lines(q_g: jax.Array, k_g: jax.Array, v_g: jax.Array,
     line_causal = jnp.tril(jnp.ones((n, n), dtype=bool))
     s_l = jnp.where(line_causal[None, None, None], s_l, NEG_INF)
 
-    joint = jnp.concatenate([s_t, s_l], axis=-1)
-    probs = jax.nn.softmax(joint, axis=-1)
-    p_t, p_l = probs[..., : s_t.shape[-1]], probs[..., s_t.shape[-1]:]
-    out = jnp.einsum("blhns,bshd->blnhd", p_t.astype(v_t.dtype), v_t,
+    # Joint softmax over [text-scores || line-scores] WITHOUT materializing
+    # the concatenation: concat/slice pairs at this size dominated the step
+    # profile as HBM copies, while max/exp/sum fuse into the matmuls.
+    m = jnp.maximum(jnp.max(s_t, axis=-1), jnp.max(s_l, axis=-1))
+    e_t = jnp.exp(s_t - m[..., None])
+    e_l = jnp.exp(s_l - m[..., None])
+    denom = jnp.sum(e_t, axis=-1) + jnp.sum(e_l, axis=-1)  # (b,l,h,n)
+    out = jnp.einsum("blhns,bshd->blnhd", e_t.astype(v_t.dtype), v_t,
                      preferred_element_type=jnp.float32)
-    out = out + jnp.einsum("blhnm,blmhd->blnhd", p_l.astype(v_g.dtype), v_g,
+    out = out + jnp.einsum("blhnm,blmhd->blnhd", e_l.astype(v_g.dtype), v_g,
                            preferred_element_type=jnp.float32)
+    out = out / denom.transpose(0, 1, 3, 2)[..., None]
     return out.astype(q_g.dtype)
 
 
+def axial_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
+                          attn_type: str, text_len: int, grid: int,
+                          interpret: bool = False) -> jax.Array:
+    """Pallas fused axial attention: scores and probabilities live in VMEM
+    only (flash-attention style, with a custom backward); the XLA lowering
+    of the same math materialized them in HBM at ~31% of the train step.
+
+    Operands are (B, T, H, d); the kernels want heads-major (B, H, T, d),
+    so each call pays explicit swapaxes relayouts. A variant emitting
+    heads-major straight from the q/k/v projections measured ~12% slower
+    overall (XLA's transposed-epilogue matmuls cost more than these
+    transposes), so the copies stay. ``interpret=True`` runs the kernels
+    on CPU for tests."""
+    from dalle_tpu.ops.pallas.attention_kernels import line_attention
+
+    q, k, v = (x.swapaxes(1, 2) for x in (q, k, v))
+    q_t, k_t, v_t = (x[:, :, :text_len] for x in (q, k, v))
+    q_i, k_i, v_i = (x[:, :, text_len:] for x in (q, k, v))
+    out_t = line_attention(q_t, k_t, v_t, None, None,
+                           text_len, 0, False, interpret)
+    out_i = line_attention(q_i, k_i, v_i, k_t, v_t,
+                           grid, grid, attn_type == ATTN_AXIAL_COL,
+                           interpret)
+    return jnp.concatenate([out_t, out_i], axis=2).swapaxes(1, 2)
+
+
 def axial_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    attn_type: str, text_len: int, grid: int) -> jax.Array:
+                    attn_type: str, text_len: int, grid: int,
+                    use_pallas: Optional[bool] = None) -> jax.Array:
     """Axial row/col attention over [text || image] sequence.
 
     q/k/v: (B, T, H, d) with T = text_len + grid*grid. The image block is
     viewed as a (grid, grid) raster; rows (axial_row) or columns (axial_col)
     become a batch dimension so XLA sees large, regular batched matmuls.
+    ``use_pallas=None`` auto-selects the fused VMEM kernel on TPU.
     """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        return axial_attention_fused(q, k, v, attn_type, text_len, grid)
     b, t, h, d = q.shape
     q_t, k_t, v_t = (x[:, :text_len] for x in (q, k, v))
     out_t = _text_causal(q_t, k_t, v_t)
@@ -235,3 +292,5 @@ def zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if attn_type in (ATTN_AXIAL_ROW, ATTN_AXIAL_COL):
         return axial_attention(q, k, v, attn_type, text_len, grid)
     return dense_zoo_attention(q, k, v, attn_type, text_len, grid, conv_kernel)
+
+

