@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="compile/self-check steps before joining")
     parser.add_argument("--data-path", type=str, default=None,
                         help="codes dataset dir/file (default: synthetic)")
+    parser.add_argument("--tokenizer-path", type=str, default=None,
+                        help="tokenizer.json for --data-path captions")
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="append one JSON line per epoch to this file")
     parser.add_argument("--platform", type=str, default=None,
@@ -97,7 +99,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     model, opt, trainer, collab, peer = configs_from_args(args)
     task = TrainingTask(model, opt, trainer, collab, peer,
-                        data_path=args.data_path)
+                        data_path=args.data_path,
+                        tokenizer_path=args.tokenizer_path)
 
     def on_epoch(report):
         if args.metrics_file:

@@ -106,6 +106,10 @@ class ModelConfig:
                 raise ValueError(f"unknown attention type {t!r}")
         if self.dim != self.heads * self.head_dim:
             raise ValueError("dim must equal heads * head_dim")
+        if self.remat_policy not in (None, "save_attn"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "expected None or 'save_attn'")
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,101 @@
+"""Caption tokenizer: T5-style sentencepiece/unigram over HF ``tokenizers``.
+
+Capability parity with the reference's ``T5TokenizerFast`` (``task.py:58-59``
+of learning-at-home/dalle: t5-small vocab, ``pad_token = eos``). The
+reference's fast tokenizer is itself a wrapper over the Rust ``tokenizers``
+library; this module uses the same library directly, so a real T5
+``tokenizer.json`` drops in unchanged via :meth:`CaptionTokenizer.load`.
+Because this environment has no network (and no cached T5 vocab), the class
+can also *train* a T5-style Unigram model from a caption corpus offline
+(:meth:`CaptionTokenizer.train`) with the same special-token layout
+(``<pad>``=0, ``</s>``=1, ``<unk>``=2) and Metaspace pre-tokenization.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PAD_ID = 0
+EOS_ID = 1
+UNK_ID = 2
+
+
+class CaptionTokenizer:
+    """Encode/decode captions; pad-to-max with a loss mask."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self.vocab_size = tokenizer.get_vocab_size()
+        self.pad_id = PAD_ID
+        self.eos_id = EOS_ID
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "CaptionTokenizer":
+        """Load a saved ``tokenizer.json`` (ours or a real T5 one)."""
+        from tokenizers import Tokenizer
+        return cls(Tokenizer.from_file(path))
+
+    @classmethod
+    def train(cls, corpus: Iterable[str], vocab_size: int = 32100,
+              save_path: Optional[str] = None) -> "CaptionTokenizer":
+        """Train a T5-style Unigram tokenizer from an iterator of captions."""
+        from tokenizers import Tokenizer, decoders, models, normalizers, \
+            pre_tokenizers, trainers
+
+        tok = Tokenizer(models.Unigram())
+        tok.normalizer = normalizers.Sequence(
+            [normalizers.Nmt(), normalizers.NFKC(),
+             normalizers.Replace(r" {2,}", " ")])
+        tok.pre_tokenizer = pre_tokenizers.Metaspace()
+        tok.decoder = decoders.Metaspace()
+        trainer = trainers.UnigramTrainer(
+            vocab_size=vocab_size,
+            special_tokens=["<pad>", "</s>", "<unk>"],
+            unk_token="<unk>")
+        tok.train_from_iterator(corpus, trainer=trainer)
+        if save_path is not None:
+            os.makedirs(os.path.dirname(save_path) or ".", exist_ok=True)
+            tok.save(save_path)
+        return cls(tok)
+
+    def save(self, path: str) -> None:
+        self._tok.save(path)
+
+    # -- encoding ---------------------------------------------------------
+
+    def encode(self, text: str, max_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, mask) padded/truncated to ``max_len``; eos-terminated.
+
+        The mask marks real tokens (incl. eos) with 1 and padding with 0 —
+        the collator's loss mask (reference pads captions to max length and
+        the pad token is the eos, task.py:58-59,178-181).
+        """
+        ids = list(self._tok.encode(text).ids)
+        # a real T5 tokenizer.json carries a post-processor that already
+        # appends </s>; only append when the encoding lacks it
+        if not ids or ids[-1] != self.eos_id:
+            ids.append(self.eos_id)
+        if len(ids) > max_len:
+            ids = ids[: max_len - 1] + [self.eos_id]
+        n = len(ids)
+        out = np.full((max_len,), self.pad_id, np.int32)
+        out[:n] = np.asarray(ids, np.int32)
+        mask = np.zeros((max_len,), np.float32)
+        mask[:n] = 1.0
+        return out, mask
+
+    def encode_batch(self, texts: Sequence[str], max_len: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        pairs = [self.encode(t, max_len) for t in texts]
+        return (np.stack([p[0] for p in pairs]),
+                np.stack([p[1] for p in pairs]))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        ids = [int(i) for i in ids if int(i) not in (self.pad_id,
+                                                     self.eos_id)]
+        return self._tok.decode(ids)

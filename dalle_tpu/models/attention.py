@@ -60,15 +60,9 @@ def rotary_cos_sin(positions: jax.Array, head_dim: int,
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def _rotate_half(x: jax.Array) -> jax.Array:
-    half = x.shape[-1] // 2
-    x1, x2 = x[..., :half], x[..., half:]
-    return jnp.concatenate([-x2, x1], axis=-1)
-
-
 @functools.lru_cache(maxsize=8)
 def _rotation_matrix(head_dim: int) -> np.ndarray:
-    """(d, d) matrix R with x @ R == rotate_half(x).
+    """(d, d) matrix R with x @ R == rotate_half(x) == concat(-x2, x1).
 
     The concat/slice lowering of rotate_half costs two HBM copies per q/k
     per layer (it was the largest single line in the step profile); as a

@@ -47,14 +47,6 @@ def _line_mask(rows: int, n: int) -> jax.Array:
     return (qi // n == kj // n) & (kj % n <= qi % n)
 
 
-def _maybe_transpose(x: jax.Array, grid: int, transpose: bool) -> jax.Array:
-    """(T, D) raster-order rows -> column-major rows when ``transpose``."""
-    if not transpose:
-        return x
-    d = x.shape[-1]
-    return x.reshape(grid, grid, d).swapaxes(0, 1).reshape(grid * grid, d)
-
-
 def _fwd_kernel(q_ref, kl_ref, vl_ref, kp_ref, vp_ref, out_ref, stats_ref,
                 *, scale: float, n: int, block_rows: int):
     t = q_ref.shape[2]

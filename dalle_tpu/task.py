@@ -36,7 +36,8 @@ class TrainingTask:
                  trainer: TrainerConfig,
                  collab: CollabConfig,
                  peer: PeerConfig,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 tokenizer_path: Optional[str] = None):
         model.validate()
         self.model_cfg = model
         self.opt_cfg = optimizer
@@ -44,6 +45,7 @@ class TrainingTask:
         self.collab_cfg = collab
         self.peer_cfg = peer
         self.data_path = data_path
+        self.tokenizer_path = tokenizer_path
 
     # -- identity / swarm -------------------------------------------------
 
@@ -143,7 +145,13 @@ class TrainingTask:
     def dataset(self):
         if self.data_path is not None:
             from dalle_tpu.data.dataset import CodesDataset
-            return CodesDataset(self.data_path, self.model_cfg)
+            dataset = CodesDataset(self.data_path, self.model_cfg,
+                                   tokenizer_path=self.tokenizer_path)
+            if dataset.tokenizer.vocab_size > self.model_cfg.vocab_text:
+                raise ValueError(
+                    f"tokenizer vocab {dataset.tokenizer.vocab_size} "
+                    f"exceeds model vocab_text {self.model_cfg.vocab_text}")
+            return dataset
         from dalle_tpu.data.synthetic import SyntheticCodes
         return SyntheticCodes(
             self.model_cfg,

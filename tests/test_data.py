@@ -1,0 +1,157 @@
+"""Data pipeline tests: tokenizer round-trips, shard streaming, filters,
+collation, and an end-to-end train-from-disk loop."""
+
+import numpy as np
+import pytest
+
+from dalle_tpu.config import tiny_model_config
+from dalle_tpu.data.dataset import (CodesDataset, decode_codes,
+                                    record_filter, write_shard)
+from dalle_tpu.data.tokenizer import CaptionTokenizer
+
+CAPTIONS = [
+    "a red cat sitting on a blue boat",
+    "tiny dog under a large green tree",
+    "a painting of a house near the mountain",
+    "photo of the sky above the sea",
+    "the quick brown fox jumps over the lazy dog",
+    "a blue tree and a red sky",
+]
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok = CaptionTokenizer.train(CAPTIONS * 20, vocab_size=200,
+                                 save_path=str(path))
+    return tok
+
+
+class TestTokenizer:
+    def test_specials_layout(self, tokenizer):
+        assert tokenizer.pad_id == 0
+        assert tokenizer.eos_id == 1
+        assert tokenizer.vocab_size <= 200
+
+    def test_roundtrip(self, tokenizer):
+        for text in CAPTIONS:
+            ids, mask = tokenizer.encode(text, max_len=64)
+            n = int(mask.sum())
+            assert ids[n - 1] == tokenizer.eos_id
+            assert (ids[n:] == tokenizer.pad_id).all()
+            assert tokenizer.decode(ids) == text
+
+    def test_truncation(self, tokenizer):
+        ids, mask = tokenizer.encode(" ".join(CAPTIONS), max_len=8)
+        assert ids.shape == (8,)
+        assert ids[7] == tokenizer.eos_id and mask.sum() == 8
+
+    def test_save_load_identical(self, tokenizer, tmp_path):
+        path = tmp_path / "t.json"
+        tokenizer.save(str(path))
+        loaded = CaptionTokenizer.load(str(path))
+        ids_a, _ = tokenizer.encode(CAPTIONS[0], 32)
+        ids_b, _ = loaded.encode(CAPTIONS[0], 32)
+        np.testing.assert_array_equal(ids_a, ids_b)
+
+
+class TestFilters:
+    def test_reference_filters(self):
+        ok = {"caption": "a cat", "NSFW": "UNLIKELY",
+              "width": 512, "height": 384}
+        assert record_filter(ok)
+        assert not record_filter({**ok, "caption": "ab"})       # too short
+        assert not record_filter({**ok, "NSFW": "NSFW"})        # nsfw
+        assert not record_filter({**ok, "width": 1200, "height": 300})
+        assert record_filter({"caption": "a cat"})              # fields absent
+
+    def test_code_decoding(self):
+        codes = np.arange(16, dtype="<i2")
+        rec = {"codes": codes.tobytes()}
+        out = decode_codes(rec, 16)
+        np.testing.assert_array_equal(out, np.arange(16))
+        assert out.dtype == np.int32
+        assert decode_codes(rec, 32) is None  # wrong length
+
+
+def _make_shards(tmp_path, cfg, n_shards=2, per_shard=40, seed=0):
+    rng = np.random.default_rng(seed)
+    kept = 0
+    for s in range(n_shards):
+        records = []
+        for i in range(per_shard):
+            records.append({
+                "caption": CAPTIONS[int(rng.integers(len(CAPTIONS)))],
+                "codes": rng.integers(0, cfg.vocab_image,
+                                      cfg.image_seq_len).astype("<i2"),
+                "NSFW": "UNLIKELY", "width": 256, "height": 256})
+        # one bad record per shard: must be filtered, not crash
+        records.append({"caption": "x", "codes": b""})
+        kept += per_shard
+        write_shard(str(tmp_path / f"shard_{s}.msgpack"), records)
+    return kept
+
+
+class TestCodesDataset:
+    def test_batches_shapes_and_mask(self, tmp_path, tokenizer):
+        cfg = tiny_model_config()
+        _make_shards(tmp_path, cfg)
+        ds = CodesDataset(str(tmp_path), cfg, tokenizer=tokenizer,
+                          shuffle_buffer=16)
+        batch = next(ds.batches(4, seed=1))
+        assert batch["text"].shape == (4, cfg.text_seq_len)
+        assert batch["image"].shape == (4, cfg.image_seq_len)
+        assert batch["mask"].shape == (4, cfg.total_seq_len)
+        # image positions always count toward the loss
+        assert (batch["mask"][:, cfg.text_seq_len:] == 1).all()
+        # caption padding masked out, at least eos real, padding only at
+        # the tail (rows may be full when the caption truncates)
+        text_mask = batch["mask"][:, : cfg.text_seq_len]
+        assert (text_mask.sum(1) >= 1).all()
+        assert (np.diff(text_mask, axis=1) <= 0).all()
+        assert (batch["image"] >= 0).all()
+        assert (batch["image"] < cfg.vocab_image).all()
+
+    def test_per_peer_seeds_diverge(self, tmp_path, tokenizer):
+        cfg = tiny_model_config()
+        _make_shards(tmp_path, cfg, n_shards=1, per_shard=64)
+        ds = CodesDataset(str(tmp_path), cfg, tokenizer=tokenizer,
+                          shuffle_buffer=32)
+        b1 = next(ds.batches(8, seed=1))
+        b2 = next(ds.batches(8, seed=2))
+        assert not np.array_equal(b1["image"], b2["image"])
+
+    def test_non_loop_exhausts(self, tmp_path, tokenizer):
+        cfg = tiny_model_config()
+        kept = _make_shards(tmp_path, cfg, n_shards=1, per_shard=20)
+        ds = CodesDataset(str(tmp_path), cfg, tokenizer=tokenizer,
+                          shuffle_buffer=8)
+        batches = list(ds.batches(4, seed=0, loop=False))
+        assert len(batches) == kept // 4
+
+    def test_train_from_disk_loss_drops(self, tmp_path, tokenizer):
+        """End-to-end: a tiny model trains from shard files on disk and the
+        loss falls (VERDICT r1 'Next round' item 4)."""
+        import jax
+
+        from dalle_tpu.config import OptimizerConfig
+        from dalle_tpu.models.dalle import DALLE, init_params
+        from dalle_tpu.optim import make_optimizer
+        from dalle_tpu.training.steps import TrainState, make_train_step
+
+        cfg = tiny_model_config(vocab_text=256)
+        _make_shards(tmp_path, cfg, n_shards=1, per_shard=32)
+        ds = CodesDataset(str(tmp_path), cfg, tokenizer=tokenizer,
+                          shuffle_buffer=8)
+        model = DALLE(cfg)
+        params = init_params(model, jax.random.PRNGKey(0))
+        tx = make_optimizer(OptimizerConfig(
+            learning_rate=3e-3, warmup_steps=2, total_steps=100))
+        state = TrainState.create(params, tx)
+        step = jax.jit(make_train_step(model, tx))
+        losses = []
+        it = ds.batches(8, seed=0)
+        for _ in range(30):
+            state, metrics = step(state, next(it))
+            losses.append(float(metrics["loss"]))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, losses
