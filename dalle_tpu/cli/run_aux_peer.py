@@ -1,0 +1,139 @@
+"""Aux peer CLI: swarm bootstrap node, metrics aggregator, checkpointer.
+
+Capability parity with the reference's monitor peer
+(``run_aux_peer.py:21-152`` of learning-at-home/dalle): a non-training
+peer that (a) anchors the DHT so joiners have a stable ``--initial-peers``
+target, (b) aggregates every trainer's signed per-epoch metrics records
+into swarm-wide stats each ``refresh_period`` (alive peers, summed
+samples/sec, loss — the reference's wandb dashboard, ``:106-144``; here a
+JSONL sink and the log), and (c) periodically downloads the freshest
+training state from the swarm and archives it as a local checkpoint
+(``CheckpointHandler``, ``:38-76``).
+
+Usage::
+
+    python -m dalle_tpu.cli.run_aux_peer --preset tiny \
+        --port 31337 --checkpoint-dir archive/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+from typing import Optional, Sequence
+
+from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
+                                 dataclass_from_args)
+from dalle_tpu.config import (AuxConfig, CollabConfig, ModelConfig,
+                              OptimizerConfig, PeerConfig)
+from dalle_tpu.cli.run_trainer import MODEL_PRESETS, banner
+
+logger = logging.getLogger("dalle_tpu.aux")
+
+CONFIG_CLASSES = (ModelConfig, OptimizerConfig, CollabConfig, PeerConfig,
+                  AuxConfig)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    check_no_collisions(*CONFIG_CLASSES)
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-aux-peer", description=__doc__.splitlines()[0])
+    parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
+                        default="flagship")
+    parser.add_argument("--max-rounds", type=int, default=None,
+                        help="stop after this many refresh rounds")
+    parser.add_argument("--save-every-epochs", type=int, default=2,
+                        help="archive swarm state every N global epochs "
+                             "(reference pulls every 2, arguments.py:150)")
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="append one JSON line per refresh round")
+    parser.add_argument("--platform", type=str, default=None)
+    parser.add_argument("--log-level", type=str, default="INFO")
+    for cls in CONFIG_CLASSES:
+        add_dataclass_args(parser, cls)
+    return parser
+
+
+def aggregate(metrics):
+    """Swarm-wide stats from per-peer reports (run_aux_peer.py:119-144)."""
+    if not metrics:
+        return {"alive_peers": 0, "epoch": -1, "sum_sps": 0.0,
+                "mean_loss": None, "sum_mini_steps": 0}
+    epoch = max(m.epoch for m in metrics)
+    current = [m for m in metrics if m.epoch == epoch]
+    return {
+        "alive_peers": len(metrics),
+        "epoch": epoch,
+        "sum_sps": sum(m.samples_per_second for m in metrics),
+        "mean_loss": sum(m.loss for m in current) / len(current),
+        "sum_mini_steps": sum(m.mini_steps for m in current),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from dalle_tpu.config import TrainerConfig
+    from dalle_tpu.swarm.metrics import fetch_metrics
+    from dalle_tpu.swarm.state_transfer import (apply_state_arrays,
+                                                load_state_from_peers)
+    from dalle_tpu.task import TrainingTask
+
+    model = dataclass_from_args(ModelConfig, args,
+                                base=MODEL_PRESETS[args.preset]())
+    opt = dataclass_from_args(OptimizerConfig, args)
+    collab = dataclass_from_args(CollabConfig, args)
+    peer = dataclass_from_args(PeerConfig, args)
+    aux = dataclass_from_args(AuxConfig, args)
+
+    task = TrainingTask(model, opt, TrainerConfig(), collab, peer)
+    ckpt_mgr = None
+    if aux.checkpoint_dir:
+        from dalle_tpu.training.checkpoint import CheckpointManager
+        ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
+
+    last_archived = -1
+    rounds = 0
+    with task:
+        banner(task)
+        while args.max_rounds is None or rounds < args.max_rounds:
+            rounds += 1
+            time.sleep(aux.refresh_period)
+            stats = aggregate(fetch_metrics(
+                task.dht, peer.experiment_prefix))
+            logger.info(
+                "round %d: epoch=%s alive=%d sum_sps=%.1f mean_loss=%s",
+                rounds, stats["epoch"], stats["alive_peers"],
+                stats["sum_sps"], stats["mean_loss"])
+            if args.metrics_file:
+                with open(args.metrics_file, "a") as f:
+                    f.write(json.dumps({"round": rounds, **stats}) + "\n")
+
+            if (ckpt_mgr is not None and aux.store_checkpoints
+                    and stats["epoch"] >= 0
+                    and stats["epoch"] >= last_archived
+                    + args.save_every_epochs):
+                result = load_state_from_peers(
+                    task.dht, collab.run_id, timeout=collab.averaging_timeout)
+                if result is not None:
+                    epoch, arrays = result
+                    state = apply_state_arrays(task.train_state, arrays)
+                    ckpt_mgr.save(state, epoch, backup=True)
+                    last_archived = epoch
+                    logger.info("archived swarm state at epoch %d", epoch)
+                else:
+                    logger.warning("state archive pull failed this round")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

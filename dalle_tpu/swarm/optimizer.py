@@ -108,17 +108,8 @@ class CollaborativeOptimizer:
         return self.local_epoch, self._state_leaves()
 
     def _replace_state_leaves(self, arrays: List[np.ndarray]) -> None:
-        old = (self.state.params, self.state.opt_state)
-        treedef = jax.tree_util.tree_structure(old)
-        old_leaves = jax.tree_util.tree_leaves(old)
-        if len(arrays) != len(old_leaves):
-            raise ValueError(
-                f"state has {len(old_leaves)} leaves, got {len(arrays)}")
-        new_leaves = [
-            jax.device_put(np.asarray(a).astype(o.dtype).reshape(o.shape))
-            for a, o in zip(arrays, old_leaves)]
-        params, opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        self.state = self.state.replace(params=params, opt_state=opt_state)
+        from dalle_tpu.swarm.state_transfer import apply_state_arrays
+        self.state = apply_state_arrays(self.state, arrays)
 
     # -- the hot path ----------------------------------------------------
 

@@ -92,6 +92,27 @@ def deserialize_state(blob: bytes) -> Tuple[int, List[np.ndarray]]:
     return int(obj["epoch"]), arrays
 
 
+def apply_state_arrays(state, arrays: Sequence[np.ndarray]):
+    """Rebuild a TrainState-like pytree from transferred arrays (the wire
+    format is the flat leaf list of ``(params, opt_state)``), preserving
+    each leaf's dtype, shape, and device placement."""
+    import jax
+
+    old = (state.params, state.opt_state)
+    treedef = jax.tree_util.tree_structure(old)
+    old_leaves = jax.tree_util.tree_leaves(old)
+    if len(arrays) != len(old_leaves):
+        raise ValueError(
+            f"state has {len(old_leaves)} leaves, got {len(arrays)}")
+    new_leaves = []
+    for a, o in zip(arrays, old_leaves):
+        arr = np.asarray(a).astype(o.dtype).reshape(o.shape)
+        new_leaves.append(jax.device_put(arr, o.sharding)
+                          if hasattr(o, "sharding") else jax.device_put(arr))
+    params, opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state.replace(params=params, opt_state=opt_state)
+
+
 class StateServer:
     """Background thread serving this peer's training state to the swarm."""
 

@@ -27,6 +27,7 @@ from typing import Any, List, Optional, Tuple
 import flax.serialization
 import jax
 import jax.numpy as jnp
+import msgpack
 
 logger = logging.getLogger(__name__)
 
@@ -49,11 +50,42 @@ def params_are_finite(params: Any) -> bool:
 
 
 def _serialize(state: Any, epoch: int) -> bytes:
-    payload = {
-        "epoch": int(epoch),
-        "state": flax.serialization.to_state_dict(state),
-    }
-    return flax.serialization.msgpack_serialize(payload)
+    """A small msgpack header {'epoch': N} followed by the flax-serialized
+    state dict — the header is peekable without deserializing the (large)
+    state, so restore can pick the freshest candidate cheaply."""
+    head = msgpack.packb({"epoch": int(epoch)}, use_bin_type=True)
+    body = flax.serialization.msgpack_serialize(
+        flax.serialization.to_state_dict(state))
+    return head + body
+
+
+def _read_header(path: str) -> Optional[int]:
+    try:
+        with open(path, "rb") as f:
+            unpacker = msgpack.Unpacker(f, raw=False)
+            return int(unpacker.unpack()["epoch"])
+    except Exception:  # noqa: BLE001 - corrupt/missing file
+        return None
+
+
+def _read_payload(path: str):
+    with open(path, "rb") as f:
+        blob = f.read()
+    unpacker = msgpack.Unpacker(raw=False)
+    unpacker.feed(blob)
+    epoch = int(unpacker.unpack()["epoch"])
+    state_dict = flax.serialization.msgpack_restore(blob[unpacker.tell():])
+    return epoch, state_dict
+
+
+def _place_like(template: Any, restored: Any) -> Any:
+    """Device-place restored (host) leaves with the template's shardings so
+    a resumed state keeps the mesh placement shard_train_state chose."""
+    def f(t, n):
+        arr = jnp.asarray(n, getattr(t, "dtype", None))
+        return jax.device_put(arr, t.sharding) if hasattr(t, "sharding") \
+            else jax.device_put(arr)
+    return jax.tree.map(f, template, restored)
 
 
 def _write_atomic(path: str, blob: bytes) -> None:
@@ -74,7 +106,7 @@ class CheckpointManager:
 
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
-        self.keep = keep
+        self.keep = max(1, keep)  # 0 would disable pruning entirely
         os.makedirs(directory, exist_ok=True)
 
     # -- paths ------------------------------------------------------------
@@ -122,28 +154,31 @@ class CheckpointManager:
     def _restore_file(self, path: str, template: Any
                       ) -> Optional[Tuple[Any, int]]:
         try:
-            with open(path, "rb") as f:
-                payload = flax.serialization.msgpack_restore(f.read())
-            state = flax.serialization.from_state_dict(
-                template, payload["state"])
-            return state, int(payload["epoch"])
+            epoch, state_dict = _read_payload(path)
+            state = flax.serialization.from_state_dict(template, state_dict)
+            return _place_like(template, state), epoch
         except Exception:  # noqa: BLE001 - corrupt/partial file
             logger.warning("failed to restore %s", path, exc_info=True)
             return None
 
+    def _candidates(self) -> List[Tuple[int, str]]:
+        """(epoch, path) for every readable candidate, freshest first,
+        using the peekable header (no full deserialization)."""
+        out = [(e, p) for e, p in self.checkpoints()]
+        backup_epoch = _read_header(self.backup_path) \
+            if os.path.exists(self.backup_path) else None
+        if backup_epoch is not None:
+            out.append((backup_epoch, self.backup_path))
+        return sorted(out, reverse=True)
+
     def restore_latest(self, template: Any) -> Optional[Tuple[Any, int]]:
-        """Freshest of numbered checkpoints and the backup, or None."""
-        candidates = self.checkpoints()
-        best: Optional[Tuple[Any, int]] = None
-        for _epoch, path in reversed(candidates):
-            best = self._restore_file(path, template)
-            if best is not None:
-                break
-        backup = (self._restore_file(self.backup_path, template)
-                  if os.path.exists(self.backup_path) else None)
-        if backup is not None and (best is None or backup[1] >= best[1]):
-            best = backup
-        return best
+        """Freshest of numbered checkpoints and the backup, or None. Only
+        the winning candidate is deserialized; losers cost a header peek."""
+        for _epoch, path in self._candidates():
+            result = self._restore_file(path, template)
+            if result is not None:
+                return result
+        return None
 
     def restore_backup(self, template: Any) -> Optional[Tuple[Any, int]]:
         if not os.path.exists(self.backup_path):
@@ -152,19 +187,16 @@ class CheckpointManager:
 
     def restore_params_latest(self, params_template: Any
                               ) -> Optional[Tuple[Any, int]]:
-        """Restore only the params subtree from the freshest checkpoint —
-        inference needs no optimizer state, and this keeps checkpoints
-        loadable regardless of which optimizer flags trained them."""
-        for _epoch, path in (list(reversed(self.checkpoints()))
-                             + ([(-1, self.backup_path)]
-                                if os.path.exists(self.backup_path)
-                                else [])):
+        """Restore only the params subtree from the freshest candidate
+        (numbered or backup) — inference needs no optimizer state, and
+        this keeps checkpoints loadable regardless of which optimizer
+        flags trained them."""
+        for _epoch, path in self._candidates():
             try:
-                with open(path, "rb") as f:
-                    payload = flax.serialization.msgpack_restore(f.read())
+                epoch, state_dict = _read_payload(path)
                 params = flax.serialization.from_state_dict(
-                    params_template, payload["state"]["params"])
-                return params, int(payload["epoch"])
+                    params_template, state_dict["params"])
+                return _place_like(params_template, params), epoch
             except Exception:  # noqa: BLE001 - corrupt/mismatched file
                 logger.warning("failed to restore params from %s", path,
                                exc_info=True)

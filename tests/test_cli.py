@@ -64,30 +64,63 @@ def read_metrics(path: Path):
     return [json.loads(line) for line in path.read_text().splitlines()]
 
 
-class TestTrainerCLI:
-    def test_two_peers_cotrain_from_shell(self, tmp_path):
-        """Two trainer processes co-train on localhost: both finish, they
-        form real averaging groups, and the loss falls (VERDICT round-1
-        'Next round' item 2; reference run_trainer_tpu.py:26-91)."""
-        port_a, port_b = free_port(), free_port()
-        metrics_a, metrics_b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+def launch_aux(port: int, metrics_file: Path, ckpt_dir: Path,
+               rounds: int = 120) -> subprocess.Popen:
+    args = [
+        sys.executable, "-m", "dalle_tpu.cli.run_aux_peer",
+        "--preset", "tiny", "--platform", "cpu",
+        "--refresh-period", "2",
+        "--max-rounds", str(rounds),
+        "--save-every-epochs", "2",
+        "--checkpoint-dir", str(ckpt_dir),
+        "--metrics-file", str(metrics_file),
+        "--port", str(port),
+        "--averaging-timeout", "15",
+    ]
+    return subprocess.Popen(args, env=child_env(), cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
 
-        proc_a = launch_trainer(port_a, metrics_a)
+
+class TestTrainerCLI:
+    def test_swarm_cotrains_with_aux_monitor(self, tmp_path):
+        """Two trainer processes co-train on localhost while an aux peer
+        bootstraps the DHT, aggregates their signed metrics, and archives
+        swarm state (VERDICT round-1 'Next round' items 2 and 7; reference
+        run_trainer_tpu.py:26-91, run_aux_peer.py:21-152)."""
+        port_aux, port_a, port_b = free_port(), free_port(), free_port()
+        metrics_a, metrics_b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        metrics_aux = tmp_path / "aux.jsonl"
+        archive = tmp_path / "archive"
+
+        proc_aux = launch_aux(port_aux, metrics_aux, archive)
+        procs = [proc_aux]
         try:
-            time.sleep(8)  # let A's swarm node come up and A start training
-            proc_b = launch_trainer(
-                port_b, metrics_b,
-                "--initial-peers", f"127.0.0.1:{port_a}")
+            time.sleep(6)  # aux DHT up
+            boot = ("--initial-peers", f"127.0.0.1:{port_aux}")
+            proc_a = launch_trainer(port_a, metrics_a, *boot)
+            procs.append(proc_a)
+            time.sleep(6)
+            proc_b = launch_trainer(port_b, metrics_b, *boot)
+            procs.append(proc_b)
             try:
                 out_a = proc_a.communicate(timeout=240)[0]
                 out_b = proc_b.communicate(timeout=240)[0]
             except subprocess.TimeoutExpired:
-                proc_a.kill()
-                proc_b.kill()
+                for p in procs:
+                    p.kill()
                 raise
+            # the aux's round budget (120 x 2s) outlives the trainers; once
+            # they are done, give it a short grace period to archive the
+            # final state, then stop it
+            try:
+                out_aux = proc_aux.communicate(timeout=20)[0]
+            except subprocess.TimeoutExpired:
+                proc_aux.kill()
+                out_aux = proc_aux.communicate()[0]
         finally:
-            for p in (proc_a, locals().get("proc_b")):
-                if p is not None and p.poll() is None:
+            for p in procs:
+                if p.poll() is None:
                     p.kill()
 
         assert proc_a.returncode == 0, out_a[-4000:]
@@ -102,3 +135,14 @@ class TestTrainerCLI:
         assert "group=2" in out_a + out_b, (out_a[-2000:], out_b[-2000:])
         # the co-trained model is learning the synthetic mapping
         assert rows_a[-1]["loss"] < rows_a[0]["loss"] - 0.01, rows_a
+
+        # the aux peer aggregated the swarm's signed metrics...
+        rows_aux = read_metrics(metrics_aux)
+        assert rows_aux, out_aux[-4000:]
+        live = [r for r in rows_aux if r["alive_peers"] > 0]
+        assert live, rows_aux
+        assert any(r["alive_peers"] >= 2 for r in live) or \
+            max(r["epoch"] for r in live) >= 1, rows_aux
+        assert any(r["mean_loss"] is not None for r in live)
+        # ...and archived at least one swarm checkpoint
+        assert any(archive.glob("ckpt_*.msgpack")), out_aux[-4000:]
