@@ -45,10 +45,39 @@ import numpy as np
 
 from dalle_tpu.swarm import compression
 from dalle_tpu.swarm.dht import DHT
+from dalle_tpu.swarm.identity import (Identity, PK_LEN, SIG_LEN,
+                                      open_frame, signed_frame)
 from dalle_tpu.swarm.matchmaking import AveragingGroup
 
 # group_hash, sender_index, weight, n_elems, codec
 _HDR = struct.Struct(">16sIdIB")
+_PREFIX_LEN = _HDR.size + PK_LEN + SIG_LEN
+
+
+def _sign_ctx(prefix: str, epoch: int, phase: str,
+              receiver: str = "") -> bytes:
+    """Domain-separation context bound into every chunk signature: run,
+    epoch, phase, and (for scatter, where each receiver gets a distinct
+    part) the intended receiver — so a chunk cannot be replayed into
+    another round NOR cross-fed to a different part owner with the honest
+    sender's attribution."""
+    return f"{prefix}:ar:{epoch}:{phase}:{receiver}".encode()
+
+
+def _make_frame(identity: Identity, ctx: bytes, group_hash: bytes,
+                sender: int, weight: float, n: int, codec: int,
+                payload: bytes) -> bytes:
+    """Signed data-plane chunk. Frames carry sender-supplied weights and
+    gradient bytes; unsigned they let any peer that knows the run id
+    inject arbitrary contributions (ADVICE r1)."""
+    hdr = _HDR.pack(group_hash, sender, weight, n, codec)
+    return signed_frame(identity, ctx, hdr, payload)
+
+
+def _verify_frame(raw: bytes, ctx: bytes, group: AveragingGroup,
+                  sender: int) -> bool:
+    return open_frame(raw, ctx, _HDR.size,
+                      group.members[sender].peer_id) is not None
 
 
 def _tag(prefix: str, epoch: int, phase: str, receiver: str) -> int:
@@ -114,6 +143,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     deadline = t0 + allreduce_timeout
     if sender_timeout is None:
         sender_timeout = max(1.0, 0.25 * allreduce_timeout)
+    gather_ctx = _sign_ctx(prefix, epoch, "gather")
     # The reduce phase may use at most 3/4 of the budget even while chunks
     # are still trickling in, so a slow-but-alive sender cannot starve the
     # gather phase into returning divergent, unaveraged parts (a dead
@@ -144,8 +174,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             lo, hi = slices[k]
             chunk = flat[lo:hi]
             c = part_codec(chunk.size)
-            body = _HDR.pack(group.group_hash, group.my_index, weight,
-                             chunk.size, c) + compression.compress(chunk, c)
+            body = _make_frame(dht.identity,
+                               _sign_ctx(prefix, epoch, "scatter",
+                                         owner.peer_id),
+                               group.group_hash,
+                               group.my_index, weight, chunk.size, c,
+                               compression.compress(chunk, c))
             futures.append(pool.submit(
                 send_chunk, owner.addr,
                 _tag(prefix, epoch, "scatter", owner.peer_id), body))
@@ -171,7 +205,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     0.5, max(0.05, reduce_deadline - now)))
                 if raw is None:
                     continue
-                parsed = _parse(raw, group, hi - lo)
+                parsed = _parse(raw, group, hi - lo,
+                                _sign_ctx(prefix, epoch, "scatter",
+                                          me.peer_id))
                 if parsed is None:
                     continue
                 sender, w, data = parsed
@@ -198,8 +234,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             # apply the same lossy wire bytes locally so all members end
             # the round with byte-identical values for this part
             out[lo:hi] = compression.decompress(wire, c, averaged_mine.size)
-            body = _HDR.pack(group.group_hash, group.my_index, 1.0,
-                             averaged_mine.size, c) + wire
+            body = _make_frame(dht.identity, gather_ctx, group.group_hash,
+                               group.my_index, 1.0, averaged_mine.size, c,
+                               wire)
             for m in group.members:
                 if m.peer_id == me.peer_id or not m.addr:
                     continue
@@ -238,7 +275,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 if part is None or part not in pending:
                     continue
                 lo, hi = pending[part]
-                parsed = _parse(raw, group, hi - lo)
+                parsed = _parse(raw, group, hi - lo, gather_ctx)
                 if parsed is None:
                     continue
                 _, _, data = parsed
@@ -264,7 +301,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     if raw is None:
                         continue
                     lo, hi = slices[k]
-                    parsed = _parse(raw, group, hi - lo)
+                    parsed = _parse(raw, group, hi - lo, gather_ctx)
                     if parsed is None:
                         continue
                     _, _, data = parsed
@@ -279,7 +316,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
 
 def _peek(raw: bytes, group: AveragingGroup
           ) -> Optional[Tuple[int, float]]:
-    if len(raw) < _HDR.size:
+    if len(raw) < _PREFIX_LEN:
         return None
     ghash, sender, w, _n, _c = _HDR.unpack_from(raw)
     if ghash != group.group_hash or not (0 <= sender < group.size):
@@ -287,7 +324,7 @@ def _peek(raw: bytes, group: AveragingGroup
     return sender, w
 
 
-def _parse(raw: bytes, group: AveragingGroup, expect_n: int
+def _parse(raw: bytes, group: AveragingGroup, expect_n: int, ctx: bytes
            ) -> Optional[Tuple[int, float, np.ndarray]]:
     head = _peek(raw, group)
     if head is None:
@@ -296,7 +333,9 @@ def _parse(raw: bytes, group: AveragingGroup, expect_n: int
     _, _, _, n, codec = _HDR.unpack_from(raw)
     if n != expect_n:
         return None
-    body = raw[_HDR.size:]
+    if not _verify_frame(raw, ctx, group, sender):
+        return None  # forged or replayed chunk: drop
+    body = raw[_PREFIX_LEN:]
     try:
         data = compression.decompress(body, codec, n)
     except (ValueError, struct.error):

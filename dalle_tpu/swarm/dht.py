@@ -22,6 +22,7 @@ Addresses are ``host:port`` strings (multiaddr-lite).
 from __future__ import annotations
 
 import ctypes
+import functools
 import hashlib
 import struct
 import time
@@ -89,6 +90,32 @@ def strip_owner(subkey: bytes) -> bytes:
     if open_at < 0 or not subkey.endswith(_OWNER_CLOSE):
         return subkey
     return subkey[:open_at]
+
+
+def owner_bound_peer_id(subkey: bytes) -> Optional[str]:
+    """The peer id a subkey claims, verified against its signing key.
+
+    Consumers that interpret a record's subkey as a peer identity (the
+    progress tracker, metrics aggregation, matchmaking, state-server
+    announcements) must not trust the claimed id alone: a signed record's
+    subkey content is attacker-chosen, so a peer could impersonate another
+    by writing the victim's id under its OWN valid signature. The binding
+    rule: with an ownership marker present, the claimed id must equal
+    sha256(owner_pubkey); without a marker (open/unvalidated swarms, e.g.
+    tests) the claimed id is returned as-is. Returns None for a marked
+    subkey whose claimed id does not match its key (spoofing attempt).
+    """
+    raw = strip_owner(subkey)
+    try:
+        claimed = raw.decode()
+    except UnicodeDecodeError:
+        return None
+    public_bytes = owner_public_key(subkey)
+    if public_bytes is None:
+        return claimed
+    if hashlib.sha256(public_bytes).hexdigest() == claimed:
+        return claimed
+    return None
 
 
 class SignatureValidator(RecordValidatorBase):
@@ -191,6 +218,27 @@ class DHT:
     @property
     def peer_id(self) -> str:
         return self.identity.node_id.hex()
+
+    @functools.cached_property
+    def signature_enforced(self) -> bool:
+        """Whether this node runs a SignatureValidator (validated swarm)."""
+        return any(isinstance(v, SignatureValidator) for v in self.validators)
+
+    def bound_peer_id(self, subkey: bytes) -> Optional[str]:
+        """The verified peer identity a record subkey claims.
+
+        In a validated swarm (a SignatureValidator is installed) an
+        UNMARKED subkey is rejected too: otherwise an attacker could skip
+        signing entirely and claim any identity on keys that are not in
+        ``protected_keys`` — the exact spoofing the marker check exists to
+        stop. Open swarms (no validator, e.g. tests) accept the claimed id.
+        """
+        bound = owner_bound_peer_id(subkey)
+        if bound is None:
+            return None
+        if self.signature_enforced and owner_public_key(subkey) is None:
+            return None
+        return bound
 
     @property
     def visible_address(self) -> str:

@@ -66,11 +66,17 @@ def fetch_metrics(dht: DHT, experiment_prefix: str
     """
     entries = dht.get(metrics_key(experiment_prefix)) or {}
     out: List[LocalMetrics] = []
-    for item in entries.values():
+    for subkey, item in entries.items():
+        bound = dht.bound_peer_id(subkey)
+        if bound is None:
+            continue  # spoofed identity binding
         try:
-            out.append(LocalMetrics.model_validate(item.value))
+            m = LocalMetrics.model_validate(item.value)
         except pydantic.ValidationError:
             continue
+        if m.peer_id != bound:
+            continue
+        out.append(m)
     return out
 
 

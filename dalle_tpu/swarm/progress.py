@@ -25,7 +25,7 @@ import dataclasses
 import time
 from typing import Optional
 
-from dalle_tpu.swarm.dht import DHT, get_dht_time, strip_owner
+from dalle_tpu.swarm.dht import DHT, get_dht_time
 
 
 class PerformanceEMA:
@@ -145,15 +145,21 @@ class ProgressTracker:
         self._last_fetch = now
 
         entries = self.dht.get(self.key) or {}
-        peers = []
+        by_peer = {}
         # liveness = record TTL: dead peers' entries expire out of the DHT
         for subkey, item in entries.items():
             rec = item.value
             if not isinstance(rec, dict):
                 continue
+            # the peer identity is the subkey, verified against the record's
+            # signing key — a record claiming another peer's id is dropped
+            # (and the record's own peer_id field must agree)
+            bound = self.dht.bound_peer_id(subkey)
+            if bound is None or str(rec.get("peer_id")) != bound:
+                continue
             try:
                 prog = LocalProgress(
-                    peer_id=str(rec["peer_id"]),
+                    peer_id=bound,
                     epoch=int(rec["epoch"]),
                     samples_accumulated=int(rec["samples_accumulated"]),
                     samples_per_second=float(rec["samples_per_second"]),
@@ -161,8 +167,8 @@ class ProgressTracker:
                     client_mode=bool(rec.get("client_mode", False)))
             except (KeyError, TypeError, ValueError):
                 continue
-            del subkey  # identity enforced by SignatureValidator on read
-            peers.append(prog)
+            by_peer[bound] = prog
+        peers = list(by_peer.values())
 
         if not peers:
             # alone in the swarm: progress is whatever we have locally

@@ -17,6 +17,7 @@ same SizeAdaptive codec used for state averaging, task.py:125-126).
 from __future__ import annotations
 
 import hashlib
+import os
 import struct
 import threading
 import time
@@ -26,9 +27,31 @@ import msgpack
 import numpy as np
 
 from dalle_tpu.swarm import compression
-from dalle_tpu.swarm.dht import DHT, get_dht_time, strip_owner
+from dalle_tpu.swarm.dht import DHT, get_dht_time
+from dalle_tpu.swarm.identity import Identity, open_frame, signed_frame
 
 _CHUNK = 8 << 20  # 8 MB frames (native transport caps at 64 MB)
+
+
+def _chunk_frame(identity: Identity, prefix: str, nonce: bytes, i: int,
+                 n: int, part: bytes) -> bytes:
+    """Signed state chunk: an unsigned stream would let any peer that
+    learns the nonce poison a joiner's entire training state."""
+    head = struct.pack(">II", i, n)
+    ctx = b"%s:state:%s" % (prefix.encode(), nonce)
+    return signed_frame(identity, ctx, head, part)
+
+
+def _open_chunk(raw: bytes, prefix: str, nonce: bytes,
+                expected_pid: str):
+    """(idx, total, payload) iff signed by ``expected_pid``, else None."""
+    ctx = b"%s:state:%s" % (prefix.encode(), nonce)
+    opened = open_frame(raw, ctx, 8, expected_pid)
+    if opened is None:
+        return None
+    head, payload, _signer = opened
+    i, n = struct.unpack(">II", head)
+    return i, n, payload
 
 
 def _req_tag(prefix: str, peer_id: str) -> int:
@@ -214,7 +237,8 @@ class StateServer:
         exp = time.time() + 300.0
         for i in range(n):
             part = blob[i * _CHUNK:(i + 1) * _CHUNK]
-            frame = struct.pack(">II", i, n) + part
+            frame = _chunk_frame(self.dht.identity, self.prefix, nonce,
+                                 i, n, part)
             self.dht.post(_chunk_tag(self.prefix, nonce, i), frame, exp)
 
     def _send_chunks(self, addr: str, nonce: bytes, blob: bytes) -> None:
@@ -222,7 +246,8 @@ class StateServer:
         n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
         for i in range(n):
             part = blob[i * _CHUNK:(i + 1) * _CHUNK]
-            frame = struct.pack(">II", i, n) + part
+            frame = _chunk_frame(self.dht.identity, self.prefix, nonce,
+                                 i, n, part)
             if not self.dht.send(addr, tag, frame, timeout=30.0):
                 return
 
@@ -247,8 +272,8 @@ def load_state_from_peers(dht: DHT, prefix: str,
         rec = item.value
         if not isinstance(rec, dict) or "addr" not in rec:
             continue
-        pid = strip_owner(subkey).decode(errors="replace")
-        if pid == dht.peer_id:
+        pid = dht.bound_peer_id(subkey)
+        if pid is None or pid == dht.peer_id:
             continue
         servers.append((int(rec.get("epoch", 0)), str(rec["addr"]), pid))
     servers.sort(reverse=True)
@@ -266,7 +291,7 @@ def load_state_from_peers(dht: DHT, prefix: str,
             # count; the next stale server still gets its chance.
             if best is not None:
                 break
-        nonce = np.random.bytes(16)
+        nonce = os.urandom(16)  # CSPRNG: the nonce is the freshness binding
         reply_addr = "" if dht.client_mode else dht.visible_address
         req = msgpack.packb({"addr": reply_addr, "nonce": nonce},
                             use_bin_type=True)
@@ -274,9 +299,10 @@ def load_state_from_peers(dht: DHT, prefix: str,
                         timeout=min(10.0, remaining)):
             continue
         if dht.client_mode:
-            blob = _pull_chunks(dht, prefix, addr, nonce, deadline)
+            blob = _pull_chunks(dht, prefix, addr, nonce, deadline, pid)
         else:
-            blob = _collect_chunks(dht, _rsp_tag(prefix, nonce), deadline)
+            blob = _collect_chunks(dht, _rsp_tag(prefix, nonce), deadline,
+                                   prefix, nonce, pid)
         if blob is None:
             continue
         try:
@@ -291,7 +317,7 @@ def load_state_from_peers(dht: DHT, prefix: str,
 
 
 def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
-                 deadline: float) -> Optional[bytes]:
+                 deadline: float, expected_pid: str) -> Optional[bytes]:
     """Client-mode download: poll the server's mailbox for each chunk."""
     chunks = {}
     total = None
@@ -303,20 +329,22 @@ def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
         if raw is None:
             time.sleep(0.2)  # server still serializing/posting
             continue
-        if len(raw) < 8:
+        opened = _open_chunk(raw, prefix, nonce, expected_pid)
+        if opened is None:
             return None
-        idx, n = struct.unpack(">II", raw[:8])
+        idx, n, part = opened
         if idx != i or (total is not None and n != total):
             return None
         total = n
-        chunks[i] = raw[8:]
+        chunks[i] = part
         i += 1
         if i == total:
             return b"".join(chunks[k] for k in range(total))
     return None
 
 
-def _collect_chunks(dht: DHT, tag: int, deadline: float) -> Optional[bytes]:
+def _collect_chunks(dht: DHT, tag: int, deadline: float, prefix: str,
+                    nonce: bytes, expected_pid: str) -> Optional[bytes]:
     chunks = {}
     total = None
     while time.monotonic() < deadline:
@@ -326,13 +354,14 @@ def _collect_chunks(dht: DHT, tag: int, deadline: float) -> Optional[bytes]:
             if total is not None and len(chunks) == total:
                 break
             continue
-        if len(raw) < 8:
+        opened = _open_chunk(raw, prefix, nonce, expected_pid)
+        if opened is None:
             continue
-        i, n = struct.unpack(">II", raw[:8])
+        i, n, part = opened
         total = n if total is None else total
         if n != total or i >= n:
             continue
-        chunks[i] = raw[8:]
+        chunks[i] = part
         if len(chunks) == total:
             break
     if total is None or len(chunks) != total:

@@ -280,12 +280,40 @@ struct Record {
 
 class RecordStore {
  public:
-  /* Newest expiration wins per (key, subkey) — hivemind's freshness rule. */
-  void put(const NodeId &key, const std::string &subkey,
+  /* Abuse bounds: any peer that can reach the node can issue STOREs, so
+   * the store caps record size, subkeys per key, distinct keys, and TTL —
+   * a flood fills the caps and stops instead of exhausting memory. */
+  static constexpr size_t kMaxValueBytes = 1u << 20;
+  static constexpr size_t kMaxSubkeyBytes = 1024;
+  static constexpr size_t kMaxSubkeysPerKey = 4096;
+  static constexpr size_t kMaxKeys = 1u << 16;
+  static constexpr double kMaxTtlSeconds = 24 * 3600.0;
+
+  /* Newest expiration wins per (key, subkey) — hivemind's freshness rule.
+   * Returns false when a bound rejects the record. */
+  bool put(const NodeId &key, const std::string &subkey,
            const std::string &value, double expiration) {
+    if (value.size() > kMaxValueBytes || subkey.size() > kMaxSubkeyBytes)
+      return false;
     std::lock_guard<std::mutex> g(mu_);
+    double t = now_unix();
+    if (expiration < t) return false;
+    if (expiration > t + kMaxTtlSeconds) expiration = t + kMaxTtlSeconds;
+    auto kit = data_.find(key);
+    if (kit == data_.end() && data_.size() >= kMaxKeys) {
+      gc_locked();
+      if (data_.size() >= kMaxKeys) return false;
+    }
+    if (data_[key].find(subkey) == data_[key].end() &&
+        data_[key].size() >= kMaxSubkeysPerKey) {
+      gc_locked();  /* expired entries may be holding the cap */
+      if (data_[key].find(subkey) == data_[key].end() &&
+          data_[key].size() >= kMaxSubkeysPerKey)
+        return false;
+    }
     auto &slot = data_[key][subkey];
     if (expiration >= slot.expiration) slot = {value, expiration};
+    return true;
   }
 
   std::map<std::string, Record> get(const NodeId &key) {
@@ -398,8 +426,10 @@ struct SwarmNode {
         std::string subkey = r.bytes(), value = r.bytes();
         double exp = r.f64();
         if (!r.ok) return {};
-        store.put(key, subkey, value, exp);
-        rep.push_back(char(kStoreOk));
+        if (store.put(key, subkey, value, exp))
+          rep.push_back(char(kStoreOk));
+        else
+          rep.push_back(char(0));  /* bound rejected the record */
         break;
       }
       case kFindNode: {
