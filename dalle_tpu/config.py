@@ -24,6 +24,14 @@ ATTN_CONV_LIKE = "conv_like"
 
 VALID_ATTN_TYPES = (ATTN_FULL, ATTN_AXIAL_ROW, ATTN_AXIAL_COL, ATTN_CONV_LIKE)
 
+# Sequence/context parallelism modes over the mesh's ``sp`` axis (the
+# reference has none — SURVEY.md §5; long-context is first-class here).
+SP_NONE = "none"
+SP_ULYSSES = "ulysses"   # all-to-all seq<->head resharding; any attn type
+SP_RING = "ring"         # ppermute ring flash attention; full-causal layers
+
+VALID_SP_MODES = (SP_NONE, SP_ULYSSES, SP_RING)
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -70,6 +78,11 @@ class ModelConfig:
     remat_policy: Optional[str] = None
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
+    # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"
+    # (all-to-all, any attention type) or "ring" (ring attention; requires
+    # every layer be 'full'). Active only when the model is built with a
+    # mesh whose sp axis is > 1 (parallel/sequence.py).
+    sequence_parallel: str = SP_NONE
 
     @property
     def image_seq_len(self) -> int:
@@ -110,6 +123,18 @@ class ModelConfig:
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
                 "expected None or 'save_attn'")
+        if self.sequence_parallel not in VALID_SP_MODES:
+            raise ValueError(
+                f"unknown sequence_parallel {self.sequence_parallel!r}; "
+                f"expected one of {VALID_SP_MODES}")
+        if self.sequence_parallel == SP_RING:
+            types = set(self.attn_types) | (
+                {ATTN_CONV_LIKE} if self.final_conv_block else set())
+            if types != {ATTN_FULL}:
+                raise ValueError(
+                    "sequence_parallel='ring' requires every layer be "
+                    f"'full' attention (got {sorted(types)}); axial/conv "
+                    "masks need mode 'ulysses'")
 
 
 @dataclass(frozen=True)
@@ -190,6 +215,12 @@ class PeerConfig:
     identity_path: Optional[str] = None  # persisted keypair (arguments.py:118-124)
     experiment_prefix: str = "dalle-tpu"
     statistics_expiration: float = 600.0
+    # Access-token authorization (swarm/auth.py; reference
+    # huggingface_auth.py:46-193): hex Ed25519 public key of the experiment
+    # authority (None = open swarm) and the path to this peer's token file
+    # issued by ``python -m dalle_tpu.cli.issue_token``.
+    auth_authority: Optional[str] = None
+    auth_token_path: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -221,3 +252,16 @@ def flagship_model_config(**overrides: Any) -> ModelConfig:
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     return cfg
+
+
+def long_context_model_config(**overrides: Any) -> ModelConfig:
+    """Long-sequence variant: a 64x64 code grid (4096 image tokens, e.g.
+    512px images under an f8 VQGAN) with full-causal layers sharded over the
+    ``sp`` mesh axis via ring attention. The reference caps its sequence at
+    1280 tokens and has no sequence parallelism (SURVEY.md §5); this preset
+    is the long-context extension the sp axis exists for.
+    """
+    base = dict(image_grid=64, attn_types=(ATTN_FULL,),
+                final_conv_block=False, sequence_parallel=SP_RING)
+    base.update(overrides)
+    return dataclasses.replace(ModelConfig(), **base)

@@ -22,7 +22,7 @@ image positions only image ids (segment logit masking, as dalle-pytorch does).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -34,6 +34,10 @@ from dalle_tpu.models.transformer import Transformer
 
 class DALLE(nn.Module):
     cfg: ModelConfig
+    # Device mesh, needed only when cfg.sequence_parallel != "none": the
+    # attention ops become explicit shard_map programs over the mesh's sp
+    # axis (parallel/sequence.py). Parameter shapes do not depend on it.
+    mesh: Any = None
 
     def setup(self):
         cfg = self.cfg
@@ -53,7 +57,7 @@ class DALLE(nn.Module):
             "img_row_emb", emb_init, (cfg.image_grid, cfg.dim), pdt)
         self.img_col_emb = self.param(
             "img_col_emb", emb_init, (cfg.image_grid, cfg.dim), pdt)
-        self.transformer = Transformer(cfg)
+        self.transformer = Transformer(cfg, mesh=self.mesh)
         if not cfg.tied_embeddings:
             self.lm_head = nn.Dense(
                 cfg.vocab_total, use_bias=False,

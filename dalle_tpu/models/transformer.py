@@ -39,10 +39,16 @@ def _param_dtype(cfg: ModelConfig):
 
 
 class ZooAttention(nn.Module):
-    """Multi-head attention with a static zoo type (full/axial/conv_like)."""
+    """Multi-head attention with a static zoo type (full/axial/conv_like).
+
+    When ``cfg.sequence_parallel != "none"`` and a mesh with ``sp > 1`` is
+    attached, the attention op is an explicit ``shard_map`` program over the
+    sequence axis (ring or Ulysses all-to-all; parallel/sequence.py).
+    """
 
     cfg: ModelConfig
     attn_type: str
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, rot=None) -> jax.Array:
@@ -72,9 +78,17 @@ class ZooAttention(nn.Module):
         q = checkpoint_name(q, "attn_q")
         k = checkpoint_name(k, "attn_k")
         v = checkpoint_name(v, "attn_v")
-        out = zoo_attention(
-            q, k, v, attn_type=self.attn_type, text_len=cfg.text_seq_len,
-            grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
+        if (cfg.sequence_parallel != "none" and self.mesh is not None
+                and self.mesh.shape.get("sp", 1) > 1):
+            from dalle_tpu.parallel.sequence import sp_zoo_attention
+            out = sp_zoo_attention(
+                q, k, v, mesh=self.mesh, mode=cfg.sequence_parallel,
+                attn_type=self.attn_type, text_len=cfg.text_seq_len,
+                grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
+        else:
+            out = zoo_attention(
+                q, k, v, attn_type=self.attn_type, text_len=cfg.text_seq_len,
+                grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
         out = checkpoint_name(out, "attn_ctx")
         out = out.reshape(b, t, cfg.dim)
         return nn.Dense(cfg.dim, dtype=_dtype(cfg),
@@ -106,13 +120,15 @@ class TransformerBlock(nn.Module):
 
     cfg: ModelConfig
     attn_type: str
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, rot=None) -> jax.Array:
         cfg = self.cfg
         h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
                          name="attn_norm")(x)
-        x = x + ZooAttention(cfg, self.attn_type, name="attn")(h, rot)
+        x = x + ZooAttention(cfg, self.attn_type, mesh=self.mesh,
+                             name="attn")(h, rot)
         h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
                          name="ff_norm")(x)
         x = x + GEGLUFeedForward(cfg, name="ff")(h)
@@ -133,6 +149,7 @@ class BlockCycle(nn.Module):
     cfg: ModelConfig
     block_cls: Any
     n_body: int
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, it: jax.Array) -> jax.Array:
@@ -142,7 +159,8 @@ class BlockCycle(nn.Module):
         exact = self.n_body % cycle == 0
         for uid in range(cycle):
             attn_type = cfg.attn_types[uid % len(cfg.attn_types)]
-            y = self.block_cls(cfg, attn_type, name=f"block_{uid}")(x, rot)
+            y = self.block_cls(cfg, attn_type, mesh=self.mesh,
+                               name=f"block_{uid}")(x, rot)
             if exact:
                 x = y
             else:
@@ -171,6 +189,7 @@ class Transformer(nn.Module):
     """
 
     cfg: ModelConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -193,7 +212,7 @@ class Transformer(nn.Module):
             scan = nn.scan(BlockCycle,
                            variable_broadcast="params",
                            split_rngs={"params": False})
-            x, _ = scan(cfg, block_cls, body,
+            x, _ = scan(cfg, block_cls, body, mesh=self.mesh,
                         name="cycle")(x, jnp.arange(reps))
             rest = sched[body:]
         else:
@@ -204,7 +223,8 @@ class Transformer(nn.Module):
         for uid, attn_type in rest:
             if uid not in blocks:
                 name = "block_wconv" if uid == -1 else f"block_{uid}"
-                blocks[uid] = block_cls(cfg, attn_type, name=name)
+                blocks[uid] = block_cls(cfg, attn_type, mesh=self.mesh,
+                                        name=name)
             x = blocks[uid](x, rot)
 
         return nn.LayerNorm(dtype=_dtype(cfg),

@@ -1,0 +1,186 @@
+"""Sequence/context parallelism over the mesh's ``sp`` axis.
+
+The reference tames its 1280-token sequence with attention *sparsity* (axial
+masks + weight sharing, ``task.py:63-66`` of learning-at-home/dalle) and has
+no sequence parallelism (SURVEY.md §5). Long-context support is first-class
+here: the token axis itself shards over the ``sp`` mesh axis, so sequences
+can grow past one chip's HBM. Two schemes, both explicit ``shard_map``
+programs whose collectives ride the ICI:
+
+- **Ring attention** (:func:`ring_attention`) — for ``full`` (plain-causal)
+  layers. Each device holds one contiguous sequence shard of q/k/v; k/v
+  blocks rotate around the ring via ``lax.ppermute`` while a flash-style
+  online softmax (running max / normalizer / weighted accumulator)
+  accumulates each query block's attention over every key block. Score
+  matrices never exceed (shard, shard), so attention memory is O(T²/sp²)
+  per device and the full (T, T) matrix never exists anywhere.
+
+- **Ulysses all-to-all** (:func:`ulysses_attention`) — for the whole zoo
+  (axial/conv_like masks don't decompose along a contiguous ring).
+  ``lax.all_to_all`` re-shards q/k/v from sequence-sharded to head-sharded,
+  every device runs the unmodified zoo kernel on the full sequence for its
+  subset of heads, and a second all-to-all restores sequence sharding.
+  Requires ``heads / tp`` divisible by ``sp``.
+
+:func:`sp_zoo_attention` dispatches: ring for ``full`` layers when
+``mode="ring"``, Ulysses otherwise. Composes with the ``dp``/``fsdp`` batch
+axes and ``tp`` head sharding (q/k/v enter as (B, T, H, d) with
+``P((dp, fsdp), sp, tp, None)``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dalle_tpu.config import ATTN_FULL, SP_RING, SP_ULYSSES
+from dalle_tpu.models.attention import zoo_attention
+
+BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, n_shards: int,
+                   vary_axes: Tuple[str, ...] = ()) -> jax.Array:
+    """Per-shard ring attention body (call inside ``shard_map``).
+
+    q/k/v: (B, T/sp, H, d) local sequence shards, contiguous layout (shard i
+    holds global positions [i*T/sp, (i+1)*T/sp)). Global semantics: plain
+    causal attention over the full sequence — exactly the zoo's ``full``
+    type (text causality included; see models/attention.py docstring).
+
+    Iteration r holds the k/v block of shard (i - r) mod sp; blocks entirely
+    in the future are fully masked (their exp-scores underflow to 0), which
+    costs one wasted block matmul per future block — the price of the simple
+    contiguous layout. A zigzag layout would balance that load; noted as
+    future work, the capability is what matters here.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+    scale = d ** -0.5
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    qpos = idx * tl + jnp.arange(tl)
+
+    # The accumulators start device-invariant but the scan body makes them
+    # device-varying (q/k/v vary over every mesh axis the shard_map spans);
+    # mark them varying up front so the carry types are stable across
+    # iterations.
+    def _vary(x):
+        return jax.lax.pcast(x, vary_axes, to="varying")
+
+    m0 = _vary(jnp.full((b, h, tl), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, tl), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, tl, d), jnp.float32))
+
+    def body(carry, r):
+        k_c, v_c, m, l, acc = carry
+        src = (idx - r) % n_shards
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_c,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = src * tl + jnp.arange(tl)
+        allowed = kpos[None, :] <= qpos[:, None]
+        s = jnp.where(allowed[None, None], s, -jnp.inf)
+        # r=0 is the local block whose causal diagonal is always allowed, so
+        # m is finite for every row from the first iteration on; later fully
+        # masked (future) blocks contribute exp(-inf - m) = 0.
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)  # exp(-inf - finite) = 0 at r=0
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_c.dtype), v_c,
+            preferred_element_type=jnp.float32)
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_n, v_n, m_new, l_new, acc_new), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n_shards))
+    out = acc / l[..., None]  # causal diag guarantees l > 0 everywhere
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, attn_type: str, text_len: int,
+                      grid: int, conv_kernel: int) -> jax.Array:
+    """Per-shard Ulysses body (call inside ``shard_map``).
+
+    q/k/v: (B, T/sp, Hl, d). all_to_all trades the sequence sharding for
+    head sharding, so the unmodified zoo kernel (any mask type) runs on the
+    full sequence with Hl/sp heads, then the output is traded back.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # One stacked all-to-all for q/k/v rather than three: same bytes on the
+    # wire in one collective. The optimization barriers are a CPU-backend
+    # workaround: XLA decomposes a tiled all-to-all into a tuple op whose
+    # chunk operands must share a layout, but its simplifier can leave them
+    # with different ones (transpose vs reshape producers) and the verifier
+    # rejects the module; the barrier forces a materialized canonical layout.
+    # TPU lowering doesn't take that path, so the barrier is skipped there.
+    cpu = jax.default_backend() == "cpu"
+    qkv = jnp.stack((q, k, v))                       # (3, B, Tl, Hl, d)
+    if cpu:
+        qkv = jax.lax.optimization_barrier(qkv)
+    qkv = a2a(qkv, split_axis=3, concat_axis=2)      # (3, B, T, Hl/sp, d)
+    out = zoo_attention(qkv[0], qkv[1], qkv[2], attn_type=attn_type,
+                        text_len=text_len, grid=grid,
+                        conv_kernel=conv_kernel)
+    if cpu:
+        out = jax.lax.optimization_barrier(out)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def sp_zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                     mesh: Mesh, mode: str, attn_type: str, text_len: int,
+                     grid: int, conv_kernel: int = 11,
+                     sp_axis: str = "sp", tp_axis: str = "tp") -> jax.Array:
+    """Sequence-parallel zoo attention on global (B, T, H, d) arrays.
+
+    ``mode="ring"`` uses ring attention for ``full`` layers (and requires
+    every layer be ``full``, enforced by ``ModelConfig.validate``);
+    ``mode="ulysses"`` handles every zoo type. With ``sp == 1`` this is the
+    plain local kernel.
+    """
+    sp = mesh.shape[sp_axis]
+    if sp == 1:
+        return zoo_attention(q, k, v, attn_type=attn_type, text_len=text_len,
+                             grid=grid, conv_kernel=conv_kernel)
+    b, t, h, d = q.shape
+    tp = mesh.shape[tp_axis]
+    dbatch = 1
+    for ax in BATCH_AXES:
+        dbatch *= mesh.shape[ax]
+    if b % dbatch:
+        raise ValueError(f"batch {b} not divisible by dp*fsdp={dbatch}")
+    if t % sp:
+        raise ValueError(f"sequence {t} not divisible by sp={sp}")
+    if h % tp:
+        raise ValueError(f"heads {h} not divisible by tp={tp}")
+
+    spec = P(BATCH_AXES, sp_axis, tp_axis, None)
+    if mode == SP_RING:
+        if attn_type != ATTN_FULL:
+            raise ValueError(
+                f"ring sequence parallelism requires 'full' attention "
+                f"layers, got {attn_type!r} (use mode='ulysses')")
+        body = functools.partial(ring_attention, axis_name=sp_axis,
+                                 n_shards=sp, vary_axes=mesh.axis_names)
+    elif mode == SP_ULYSSES:
+        if (h // tp) % sp:
+            raise ValueError(
+                f"ulysses needs heads/tp ({h}/{tp}={h // tp}) divisible "
+                f"by sp={sp}")
+        body = functools.partial(ulysses_attention, axis_name=sp_axis,
+                                 attn_type=attn_type, text_len=text_len,
+                                 grid=grid, conv_kernel=conv_kernel)
+    else:
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)
