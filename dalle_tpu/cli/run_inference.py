@@ -49,6 +49,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--out", type=str, default="generated.npz")
     parser.add_argument("--platform", type=str, default=None)
     parser.add_argument("--log-level", type=str, default="INFO")
+    parser.add_argument(
+        "--vqgan-checkpoint", type=str, default=None,
+        help="taming-transformers f8 VQGAN .ckpt; decodes code grids to "
+             "RGB pixels (reference inference/run_inference.py:122-124)")
+    parser.add_argument(
+        "--clip-checkpoint", type=str, default=None,
+        help="openai CLIP ViT-B/32 .pt; reranks decoded images against the "
+             "query (reference :126,135-138; requires --vqgan-checkpoint "
+             "and --clip-bpe)")
+    parser.add_argument(
+        "--clip-bpe", type=str, default=None,
+        help="path to bpe_simple_vocab_16e6.txt.gz for CLIP tokenization")
     add_dataclass_args(parser, ModelConfig)
     return parser
 
@@ -90,6 +102,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     gen = jax.jit(lambda t, r: generate_images(
         params, cfg, t, r, sampling))
 
+    # Optional pixel decoding + CLIP reranking (the reference's full
+    # pipeline: generate -> VQGAN decode -> CLIP score, run_inference.py
+    # :87-138). Both stages are plain JAX models fed by torch-deserialized
+    # public checkpoints (models/vqgan.py, models/clip.py).
+    vqgan = clip_bundle = None
+    if args.vqgan_checkpoint:
+        from dalle_tpu.models.vqgan import (VQGANConfig, decode_codes,
+                                            load_taming_checkpoint)
+        # f8 decoder: 8px per code in both axes, so the output resolution
+        # follows the model's code grid (32 -> 256px, 64 -> 512px)
+        vq_cfg = VQGANConfig(n_embed=cfg.vocab_image,
+                             resolution=cfg.image_grid * 8)
+        vqgan = (jax.jit(lambda p, c: decode_codes(p, vq_cfg, c)),
+                 load_taming_checkpoint(args.vqgan_checkpoint, vq_cfg))
+    if args.clip_checkpoint:
+        if not (vqgan and args.clip_bpe):
+            logger.error("--clip-checkpoint requires --vqgan-checkpoint "
+                         "and --clip-bpe")
+            return 1
+        from dalle_tpu.models.clip import (CLIPConfig, CLIPTokenizer,
+                                           clip_scores,
+                                           load_openai_checkpoint,
+                                           resize_for_clip)
+        cl_cfg = CLIPConfig()
+        clip_bundle = (
+            jax.jit(lambda p, im, tok: clip_scores(
+                p, cl_cfg, resize_for_clip(im, cl_cfg), tok)),
+            load_openai_checkpoint(args.clip_checkpoint, cl_cfg),
+            CLIPTokenizer(args.clip_bpe, cl_cfg.context_length))
+
     rng = jax.random.PRNGKey(args.seed)
     results = {}
     for qi, query in enumerate(args.query):
@@ -103,6 +145,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         logger.info("query %r -> %d code grids (%dx%d, vocab %d)",
                     query, grids.shape[0], cfg.image_grid, cfg.image_grid,
                     cfg.vocab_image)
+        if vqgan is not None:
+            decode, vq_params = vqgan
+            images = np.asarray(decode(vq_params, jax.numpy.asarray(
+                grids.reshape(grids.shape[0], -1))))
+            if clip_bundle is not None:
+                score_fn, cl_params, cl_tok = clip_bundle
+                tok = cl_tok.encode(query)[None]
+                scores = np.asarray(score_fn(
+                    cl_params, jax.numpy.asarray(images),
+                    jax.numpy.asarray(tok)))[:, 0]
+                order = np.argsort(-scores)
+                images, grids = images[order], grids[order]
+                results[f"query_{qi}_codes"] = grids
+                results[f"query_{qi}_clip_scores"] = scores[order]
+                logger.info("query %r best CLIP score %.4f",
+                            query, float(scores[order][0]))
+            results[f"query_{qi}_images"] = images
     np.savez(args.out, **results)
     logger.info("saved %s", args.out)
     return 0

@@ -1,0 +1,357 @@
+"""CLIP (ViT-B/32-shaped) text/image scorer for generation reranking, in Flax.
+
+The reference reranks its 16 generated images per query with OpenAI CLIP
+ViT-B/32 (``inference/run_inference.py:126,135-138`` of
+learning-at-home/dalle: ``clip.load("ViT-B/32")`` then cosine scores between
+the text query and each decoded image). This is the TPU-native equivalent:
+the dual-encoder architecture in Flax with shapes matching the released
+ViT-B/32 weights, a torch-checkpoint mapper so those weights run on TPU, and
+the byte-level BPE tokenizer CLIP text inputs require (pure Python, reads
+the public ``bpe_simple_vocab_16e6.txt.gz`` merges file from disk — no
+network).
+
+Architecture (matching openai/CLIP ``model.py`` so weights map 1:1):
+- image: 32x32-patch conv embed -> [CLS] + learned positions -> pre-LN ViT
+  (QuickGELU MLP) -> post-LN on CLS -> linear projection.
+- text: token + position embeddings -> causal transformer -> LN -> take the
+  EOT position -> linear projection.
+- score: cosine similarity of L2-normalized embeddings (the learned
+  ``logit_scale`` only matters for training; ranking is scale-invariant).
+"""
+
+from __future__ import annotations
+
+import gzip
+import html
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    """ViT-B/32 shapes (openai/CLIP released model)."""
+
+    image_size: int = 224
+    patch_size: int = 32
+    vision_width: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    text_width: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    context_length: int = 77
+    vocab_size: int = 49408
+    embed_dim: int = 512         # joint embedding dim
+
+
+def tiny_clip_config(**overrides: Any) -> CLIPConfig:
+    base = dict(image_size=16, patch_size=8, vision_width=32,
+                vision_layers=2, vision_heads=2, text_width=32,
+                text_layers=2, text_heads=2, context_length=12,
+                vocab_size=64, embed_dim=16)
+    base.update(overrides)
+    return CLIPConfig(**base)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class ResidualAttentionBlock(nn.Module):
+    width: int
+    heads: int
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_1")(x)
+        mask = None
+        if self.causal:
+            t = x.shape[1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.width,
+            out_features=self.width, name="attn")(
+                h, h, mask=mask[None, None] if mask is not None else None)
+        x = x + h
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
+        h = nn.Dense(self.width * 4, name="mlp_fc")(h)
+        h = _quick_gelu(h)
+        h = nn.Dense(self.width, name="mlp_proj")(h)
+        return x + h
+
+
+class CLIPModel(nn.Module):
+    cfg: CLIPConfig
+
+    def setup(self):
+        cfg = self.cfg
+        n_patches = (cfg.image_size // cfg.patch_size) ** 2
+        scale = cfg.vision_width ** -0.5
+        self.patch_embed = nn.Conv(
+            cfg.vision_width, (cfg.patch_size, cfg.patch_size),
+            strides=cfg.patch_size, use_bias=False, name="patch_embed")
+        self.class_embedding = self.param(
+            "class_embedding", nn.initializers.normal(scale),
+            (cfg.vision_width,), jnp.float32)
+        self.vision_pos = self.param(
+            "vision_pos", nn.initializers.normal(scale),
+            (n_patches + 1, cfg.vision_width), jnp.float32)
+        self.ln_pre = nn.LayerNorm(epsilon=1e-5, name="ln_pre")
+        self.vision_blocks = [
+            ResidualAttentionBlock(cfg.vision_width, cfg.vision_heads,
+                                   name=f"vision_block_{i}")
+            for i in range(cfg.vision_layers)]
+        self.ln_post = nn.LayerNorm(epsilon=1e-5, name="ln_post")
+        self.vision_proj = self.param(
+            "vision_proj", nn.initializers.normal(scale),
+            (cfg.vision_width, cfg.embed_dim), jnp.float32)
+
+        self.token_embedding = self.param(
+            "token_embedding", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.text_width), jnp.float32)
+        self.text_pos = self.param(
+            "text_pos", nn.initializers.normal(0.01),
+            (cfg.context_length, cfg.text_width), jnp.float32)
+        self.text_blocks = [
+            ResidualAttentionBlock(cfg.text_width, cfg.text_heads,
+                                   causal=True, name=f"text_block_{i}")
+            for i in range(cfg.text_layers)]
+        self.ln_final = nn.LayerNorm(epsilon=1e-5, name="ln_final")
+        self.text_proj = self.param(
+            "text_proj", nn.initializers.normal(cfg.text_width ** -0.5),
+            (cfg.text_width, cfg.embed_dim), jnp.float32)
+        self.logit_scale = self.param(
+            "logit_scale", nn.initializers.constant(np.log(1 / 0.07)),
+            (), jnp.float32)
+
+    def encode_image(self, images: jax.Array) -> jax.Array:
+        """images: (B, H, W, 3) float in [0, 1] -> (B, embed_dim)."""
+        mean = jnp.asarray([0.48145466, 0.4578275, 0.40821073])
+        std = jnp.asarray([0.26862954, 0.26130258, 0.27577711])
+        x = (images - mean) / std
+        x = self.patch_embed(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = jnp.broadcast_to(self.class_embedding, (b, 1, c))
+        x = jnp.concatenate([cls, x], axis=1) + self.vision_pos[None]
+        x = self.ln_pre(x)
+        for blk in self.vision_blocks:
+            x = blk(x)
+        return self.ln_post(x[:, 0]) @ self.vision_proj
+
+    def encode_text(self, tokens: jax.Array) -> jax.Array:
+        """tokens: (B, context_length) int32 -> (B, embed_dim). The text
+        embedding is read at each sequence's highest token id position (the
+        EOT token is the largest id in CLIP's vocabulary)."""
+        x = jnp.take(self.token_embedding, tokens, axis=0)
+        x = x + self.text_pos[None]
+        for blk in self.text_blocks:
+            x = blk(x)
+        x = self.ln_final(x)
+        eot = jnp.argmax(tokens, axis=-1)
+        x = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        return x @ self.text_proj
+
+    def __call__(self, images: jax.Array, tokens: jax.Array) -> jax.Array:
+        """Cosine-similarity score matrix (B_images, B_texts)."""
+        ie = self.encode_image(images)
+        te = self.encode_text(tokens)
+        ie = ie / jnp.linalg.norm(ie, axis=-1, keepdims=True)
+        te = te / jnp.linalg.norm(te, axis=-1, keepdims=True)
+        return ie @ te.T
+
+
+def clip_scores(params, cfg: CLIPConfig, images: jax.Array,
+                tokens: jax.Array) -> jax.Array:
+    """(B_images, B_texts) cosine scores — the reranking signal the
+    reference computes at ``inference/run_inference.py:135-138``."""
+    return CLIPModel(cfg).apply(params, images, tokens)
+
+
+def resize_for_clip(images: jax.Array, cfg: CLIPConfig) -> jax.Array:
+    """uint8 (B, H, W, 3) -> float resized (B, image_size, image_size, 3)."""
+    b = images.shape[0]
+    x = images.astype(jnp.float32) / 255.0
+    return jax.image.resize(
+        x, (b, cfg.image_size, cfg.image_size, 3), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# OpenAI checkpoint mapping
+# ---------------------------------------------------------------------------
+
+def map_openai_state_dict(sd: Dict[str, Any],
+                          cfg: CLIPConfig) -> Dict[str, Any]:
+    """Map the openai/CLIP torch state dict onto :class:`CLIPModel` params.
+
+    torch ``nn.MultiheadAttention`` packs qkv as ``in_proj_weight`` (3W, W);
+    flax ``MultiHeadDotProductAttention`` wants per-head (W, heads, hd)
+    kernels for query/key/value and (heads, hd, W) for the output.
+    """
+    def get(name):
+        t = sd[name]
+        return np.asarray(getattr(t, "detach", lambda: t)(), np.float32)
+
+    def ln(prefix):
+        return {"scale": get(f"{prefix}.weight"), "bias": get(f"{prefix}.bias")}
+
+    def block(torch_prefix, width, heads):
+        hd = width // heads
+        in_w = get(f"{torch_prefix}.attn.in_proj_weight")   # (3W, W)
+        in_b = get(f"{torch_prefix}.attn.in_proj_bias")     # (3W,)
+        out_w = get(f"{torch_prefix}.attn.out_proj.weight")  # (W, W)
+        out_b = get(f"{torch_prefix}.attn.out_proj.bias")
+        qkv = {}
+        for i, nm in enumerate(("query", "key", "value")):
+            w = in_w[i * width:(i + 1) * width]              # (W, W): y = W x
+            b = in_b[i * width:(i + 1) * width]
+            qkv[nm] = {"kernel": w.T.reshape(width, heads, hd),
+                       "bias": b.reshape(heads, hd)}
+        qkv["out"] = {"kernel": out_w.T.reshape(heads, hd, width),
+                      "bias": out_b}
+        return {
+            "ln_1": ln(f"{torch_prefix}.ln_1"),
+            "attn": qkv,
+            "ln_2": ln(f"{torch_prefix}.ln_2"),
+            "mlp_fc": {"kernel": get(f"{torch_prefix}.mlp.c_fc.weight").T,
+                       "bias": get(f"{torch_prefix}.mlp.c_fc.bias")},
+            "mlp_proj": {"kernel": get(f"{torch_prefix}.mlp.c_proj.weight").T,
+                         "bias": get(f"{torch_prefix}.mlp.c_proj.bias")},
+        }
+
+    p: Dict[str, Any] = {
+        "patch_embed": {"kernel": np.transpose(
+            get("visual.conv1.weight"), (2, 3, 1, 0))},
+        "class_embedding": get("visual.class_embedding"),
+        "vision_pos": get("visual.positional_embedding"),
+        "ln_pre": ln("visual.ln_pre"),
+        "ln_post": ln("visual.ln_post"),
+        "vision_proj": get("visual.proj"),
+        "token_embedding": get("token_embedding.weight"),
+        "text_pos": get("positional_embedding"),
+        "ln_final": ln("ln_final"),
+        "text_proj": get("text_projection"),
+        "logit_scale": get("logit_scale"),
+    }
+    for i in range(cfg.vision_layers):
+        p[f"vision_block_{i}"] = block(
+            f"visual.transformer.resblocks.{i}", cfg.vision_width,
+            cfg.vision_heads)
+    for i in range(cfg.text_layers):
+        p[f"text_block_{i}"] = block(
+            f"transformer.resblocks.{i}", cfg.text_width, cfg.text_heads)
+    return {"params": p}
+
+
+def load_openai_checkpoint(path: str, cfg: CLIPConfig) -> Dict[str, Any]:
+    """Read an openai/CLIP checkpoint (torch .pt, jit archive or plain state
+    dict) and return Flax params (``clip.load("ViT-B/32")`` parity)."""
+    import torch
+
+    try:
+        model = torch.jit.load(path, map_location="cpu")
+        sd = model.state_dict()
+    except RuntimeError:
+        ckpt = torch.load(path, map_location="cpu", weights_only=False)
+        sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else (
+            ckpt.state_dict())
+    params = map_openai_state_dict(sd, cfg)
+    return jax.tree.map(jnp.asarray, params)
+
+
+# ---------------------------------------------------------------------------
+# CLIP byte-level BPE tokenizer (pure Python, offline)
+# ---------------------------------------------------------------------------
+
+def _bytes_to_unicode() -> Dict[int, str]:
+    bs = (list(range(ord("!"), ord("~") + 1)) +
+          list(range(ord("\xa1"), ord("\xac") + 1)) +
+          list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+class CLIPTokenizer:
+    """The byte-level BPE CLIP text encoders expect, reading the public
+    ``bpe_simple_vocab_16e6.txt.gz`` merges file from disk (the file the
+    reference's ``clip.tokenize`` uses internally)."""
+
+    def __init__(self, bpe_path: str, context_length: int = 77):
+        import re
+        self._re = re
+        self.context_length = context_length
+        self.byte_encoder = _bytes_to_unicode()
+        with gzip.open(bpe_path, "rt", encoding="utf-8") as f:
+            merges = f.read().split("\n")
+        merges = [tuple(m.split()) for m in merges[1:48894 + 1] if m]
+        vocab = list(self.byte_encoder.values())
+        vocab = vocab + [v + "</w>" for v in vocab]
+        vocab.extend("".join(m) for m in merges)
+        vocab.extend(["<|startoftext|>", "<|endoftext|>"])
+        self.encoder = {tok: i for i, tok in enumerate(vocab)}
+        self.bpe_ranks = {m: i for i, m in enumerate(merges)}
+        # CLIP's original pattern uses \p{L}/\p{N} (regex module); stdlib
+        # `re` has no Unicode property classes, so letters are [^\W\d_]+
+        # and the punctuation run [^\s\p{L}\p{N}]+ becomes (?:[^\s\w]|_)+
+        # (underscore is \w in Python but punctuation to CLIP) — identical
+        # on ASCII captions, which is what the LAION-en captions here are.
+        self.pat = re.compile(
+            r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|"
+            r"[^\W\d_]+|[0-9]|(?:[^\s\w]|_)+", re.IGNORECASE | re.UNICODE)
+        self.cache: Dict[str, str] = {}
+
+    def _bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word: Tuple[str, ...] = tuple(token[:-1]) + (token[-1] + "</w>",)
+        while len(word) > 1:
+            pairs = set(zip(word[:-1], word[1:]))
+            bigram = min(pairs,
+                         key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            out: List[str] = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    out.append(first + second)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            word = tuple(out)
+        result = " ".join(word)
+        self.cache[token] = result
+        return result
+
+    def encode(self, text: str) -> np.ndarray:
+        text = html.unescape(html.unescape(text)).strip().lower()
+        text = self._re.sub(r"\s+", " ", text)
+        ids: List[int] = [self.encoder["<|startoftext|>"]]
+        for token in self._re.findall(self.pat, text):
+            token = "".join(self.byte_encoder[b]
+                            for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
+        ids.append(self.encoder["<|endoftext|>"])
+        if len(ids) > self.context_length:
+            # keep EOT at the end: encode_text locates the sequence
+            # embedding via argmax over ids, which must find EOT
+            ids = ids[: self.context_length]
+            ids[-1] = self.encoder["<|endoftext|>"]
+        out = np.zeros(self.context_length, np.int32)
+        out[: len(ids)] = ids
+        return out

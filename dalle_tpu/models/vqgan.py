@@ -1,0 +1,254 @@
+"""VQGAN f8 decoder: image codes -> pixels, in Flax.
+
+Training never needs VQGAN weights (the dataset ships pre-encoded codes;
+the reference stubs the VAE to a param-only shell, ``task.py:25-32`` of
+learning-at-home/dalle). Inference does: the reference loads a real taming-
+transformers checkpoint to decode sampled codes into images
+(``inference/run_inference.py:122-124``). This module is the TPU-native
+equivalent: the decoder half of the f8 VQGAN (8192-entry codebook,
+32x32 codes -> 256x256 RGB) as a Flax module, plus a loader that maps a
+taming-transformers torch checkpoint (the publicly released weights) onto
+the Flax parameter tree so real decoders run on TPU.
+
+Architecture (matches taming-transformers' ``Decoder`` so released weights
+map 1:1): codebook lookup -> post_quant_conv 1x1 -> conv_in 3x3 -> mid
+(ResnetBlock, AttnBlock, ResnetBlock) -> per-level [ResnetBlock x (n+1),
+optional AttnBlock, nearest-2x upsample + conv] -> GroupNorm -> swish ->
+conv_out 3x3. All convs NHWC (TPU-native layout; torch OIHW kernels are
+transposed on load).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class VQGANConfig:
+    """f8 Gumbel-VQGAN shape (the reference's ``VQGanParams(image_size=256,
+    num_layers=3)``, ``task.py:26-32``: 3 upsamplings = f8)."""
+
+    n_embed: int = 8192          # codebook entries (vocab_image)
+    embed_dim: int = 256         # codebook vector dim
+    z_channels: int = 256
+    ch: int = 128                # base channel count
+    ch_mult: Tuple[int, ...] = (1, 1, 2, 4)   # len-1 = num upsamplings (f8)
+    num_res_blocks: int = 2
+    attn_resolutions: Tuple[int, ...] = (32,)
+    resolution: int = 256        # output image size
+    dropout: float = 0.0
+
+    @property
+    def code_grid(self) -> int:
+        return self.resolution // (2 ** (len(self.ch_mult) - 1))
+
+
+def tiny_vqgan_config(**overrides: Any) -> VQGANConfig:
+    """CPU-test shape: 4x4 codes -> 16x16 pixels."""
+    base = dict(n_embed=64, embed_dim=16, z_channels=16, ch=16,
+                ch_mult=(1, 2, 4), num_res_blocks=1, attn_resolutions=(4,),
+                resolution=16)
+    base.update(overrides)
+    return VQGANConfig(**base)
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+class ResnetBlock(nn.Module):
+    out_ch: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h = nn.GroupNorm(num_groups=32 if x.shape[-1] % 32 == 0 else 1,
+                         epsilon=1e-6, name="norm1")(x)
+        h = _swish(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, name="conv1")(h)
+        h = nn.GroupNorm(num_groups=32 if self.out_ch % 32 == 0 else 1,
+                         epsilon=1e-6, name="norm2")(h)
+        h = _swish(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, name="conv2")(h)
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), name="nin_shortcut")(x)
+        return x + h
+
+
+class AttnBlock(nn.Module):
+    """Single-head spatial self-attention over the (H*W) grid."""
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        y = nn.GroupNorm(num_groups=32 if c % 32 == 0 else 1,
+                         epsilon=1e-6, name="norm")(x)
+        q = nn.Conv(c, (1, 1), name="q")(y).reshape(b, h * w, c)
+        k = nn.Conv(c, (1, 1), name="k")(y).reshape(b, h * w, c)
+        v = nn.Conv(c, (1, 1), name="v")(y).reshape(b, h * w, c)
+        scores = jnp.einsum("bqc,bkc->bqk", q, k,
+                            preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(scores * (c ** -0.5), axis=-1)
+        out = jnp.einsum("bqk,bkc->bqc", probs.astype(v.dtype), v)
+        out = out.reshape(b, h, w, c)
+        out = nn.Conv(c, (1, 1), name="proj_out")(out)
+        return x + out
+
+
+class VQGANDecoder(nn.Module):
+    """Codes (B, grid*grid) int32 -> images (B, res, res, 3) in [-1, 1]."""
+
+    cfg: VQGANConfig
+
+    @nn.compact
+    def __call__(self, codes: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        grid = cfg.code_grid
+        b = codes.shape[0]
+
+        codebook = self.param(
+            "codebook", nn.initializers.normal(0.02),
+            (cfg.n_embed, cfg.embed_dim), jnp.float32)
+        z = jnp.take(codebook, codes, axis=0).reshape(
+            b, grid, grid, cfg.embed_dim)
+        z = nn.Conv(cfg.z_channels, (1, 1), name="post_quant_conv")(z)
+
+        block_in = cfg.ch * cfg.ch_mult[-1]
+        h = nn.Conv(block_in, (3, 3), padding=1, name="conv_in")(z)
+
+        h = ResnetBlock(block_in, cfg.dropout, name="mid_block_1")(h)
+        h = AttnBlock(name="mid_attn_1")(h)
+        h = ResnetBlock(block_in, cfg.dropout, name="mid_block_2")(h)
+
+        curr_res = grid
+        n_levels = len(cfg.ch_mult)
+        for i_level in reversed(range(n_levels)):
+            block_out = cfg.ch * cfg.ch_mult[i_level]
+            for i_block in range(cfg.num_res_blocks + 1):
+                h = ResnetBlock(
+                    block_out, cfg.dropout,
+                    name=f"up_{i_level}_block_{i_block}")(h)
+                if curr_res in cfg.attn_resolutions:
+                    h = AttnBlock(name=f"up_{i_level}_attn_{i_block}")(h)
+            if i_level != 0:
+                # nearest-neighbour 2x upsample + 3x3 conv (taming Upsample)
+                bh, hh, wh, ch = h.shape
+                h = jax.image.resize(h, (bh, hh * 2, wh * 2, ch),
+                                     method="nearest")
+                h = nn.Conv(ch, (3, 3), padding=1,
+                            name=f"up_{i_level}_upsample")(h)
+                curr_res *= 2
+
+        h = nn.GroupNorm(num_groups=32 if h.shape[-1] % 32 == 0 else 1,
+                         epsilon=1e-6, name="norm_out")(h)
+        h = _swish(h)
+        return nn.Conv(3, (3, 3), padding=1, name="conv_out")(h)
+
+
+def decode_codes(params, cfg: VQGANConfig, codes: jax.Array) -> jax.Array:
+    """Codes -> uint8 RGB images (B, res, res, 3); the pixel-space step the
+    reference runs via dalle-pytorch's ``VQGanVAE.decode``."""
+    imgs = VQGANDecoder(cfg).apply(params, codes)
+    imgs = (jnp.clip(imgs, -1.0, 1.0) + 1.0) * 127.5
+    return imgs.astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# taming-transformers checkpoint mapping
+# ---------------------------------------------------------------------------
+
+def _conv(t) -> np.ndarray:
+    """torch conv kernel (O, I, kh, kw) -> flax (kh, kw, I, O)."""
+    return np.transpose(np.asarray(t, np.float32), (2, 3, 1, 0))
+
+
+def map_taming_state_dict(sd: Dict[str, Any],
+                          cfg: VQGANConfig) -> Dict[str, Any]:
+    """Map a taming-transformers ``VQModel``/``GumbelVQ`` torch state dict
+    (decoder half) onto the :class:`VQGANDecoder` parameter tree.
+
+    Handles both codebook key spellings (``quantize.embedding.weight`` for
+    VQ, ``quantize.embed.weight`` for Gumbel — the reference's f8-8192 model
+    is the Gumbel one).
+    """
+    def get(name):
+        t = sd[name]
+        return np.asarray(getattr(t, "detach", lambda: t)(), np.float32)
+
+    p: Dict[str, Any] = {}
+    if "quantize.embedding.weight" in sd:
+        p["codebook"] = get("quantize.embedding.weight")
+    else:
+        p["codebook"] = get("quantize.embed.weight")
+
+    def conv_params(torch_prefix):
+        return {"kernel": _conv(sd[f"{torch_prefix}.weight"]),
+                "bias": get(f"{torch_prefix}.bias")}
+
+    def norm_params(torch_prefix):
+        return {"scale": get(f"{torch_prefix}.weight"),
+                "bias": get(f"{torch_prefix}.bias")}
+
+    def resnet(flax_name, torch_prefix, has_shortcut):
+        blk = {"norm1": norm_params(f"{torch_prefix}.norm1"),
+               "conv1": conv_params(f"{torch_prefix}.conv1"),
+               "norm2": norm_params(f"{torch_prefix}.norm2"),
+               "conv2": conv_params(f"{torch_prefix}.conv2")}
+        if has_shortcut:
+            blk["nin_shortcut"] = conv_params(f"{torch_prefix}.nin_shortcut")
+        p[flax_name] = blk
+
+    def attn(flax_name, torch_prefix):
+        p[flax_name] = {
+            "norm": norm_params(f"{torch_prefix}.norm"),
+            "q": conv_params(f"{torch_prefix}.q"),
+            "k": conv_params(f"{torch_prefix}.k"),
+            "v": conv_params(f"{torch_prefix}.v"),
+            "proj_out": conv_params(f"{torch_prefix}.proj_out")}
+
+    p["post_quant_conv"] = conv_params("post_quant_conv")
+    p["conv_in"] = conv_params("decoder.conv_in")
+    resnet("mid_block_1", "decoder.mid.block_1", False)
+    attn("mid_attn_1", "decoder.mid.attn_1")
+    resnet("mid_block_2", "decoder.mid.block_2", False)
+
+    n_levels = len(cfg.ch_mult)
+    for i_level in reversed(range(n_levels)):
+        for i_block in range(cfg.num_res_blocks + 1):
+            tp = f"decoder.up.{i_level}.block.{i_block}"
+            resnet(f"up_{i_level}_block_{i_block}", tp,
+                   f"{tp}.nin_shortcut.weight" in sd)
+            ta = f"decoder.up.{i_level}.attn.{i_block}"
+            if f"{ta}.norm.weight" in sd:
+                attn(f"up_{i_level}_attn_{i_block}", ta)
+        tu = f"decoder.up.{i_level}.upsample.conv"
+        if f"{tu}.weight" in sd:
+            p[f"up_{i_level}_upsample"] = conv_params(tu)
+
+    p["norm_out"] = norm_params("decoder.norm_out")
+    p["conv_out"] = conv_params("decoder.conv_out")
+    return {"params": p}
+
+
+def load_taming_checkpoint(path: str, cfg: VQGANConfig) -> Dict[str, Any]:
+    """Read a taming-transformers ``.ckpt`` (torch) and return Flax params.
+
+    Parity with ``inference/run_inference.py:122-124`` (``VQGanVAE(
+    vqgan_model_path, vqgan_config_path)``). torch is used only as a
+    deserializer on the host; all compute stays in JAX.
+    """
+    import torch  # cpu torch is available in the image; host-only use
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = ckpt.get("state_dict", ckpt)
+    params = map_taming_state_dict(sd, cfg)
+    return jax.tree.map(jnp.asarray, params)
