@@ -67,13 +67,18 @@ class CollaborativeOptimizer:
                  apply_step: Callable[[Any, Any], Any],
                  client_mode: bool = False,
                  serve_state: bool = True,
-                 matchmaking_min_group: int = 2):
+                 matchmaking_min_group: int = 2,
+                 authorizer=None):
         self.dht = dht
         self.cfg = cfg
         self.state = state
         self.apply_step = apply_step
         self.client_mode = client_mode
         self.matchmaking_min_group = matchmaking_min_group
+        # Optional access-token authorizer (swarm/auth.py): gates group
+        # membership the way the reference's HF authorizer gates the swarm
+        # (huggingface_auth.py:46-193, wired at task.py:95-99).
+        self.authorizer = authorizer
         self.local_epoch = 0
         self.local_samples = 0
         self.tracker = ProgressTracker(
@@ -153,7 +158,7 @@ class CollaborativeOptimizer:
             self.dht, f"{self.cfg.run_id}_grads", self.local_epoch,
             weight=weight, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
-            client_mode=self.client_mode)
+            client_mode=self.client_mode, authorizer=self.authorizer)
         if group is not None and group.size > 1:
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
@@ -203,7 +208,7 @@ class CollaborativeOptimizer:
             self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
             weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
-            client_mode=self.client_mode)
+            client_mode=self.client_mode, authorizer=self.authorizer)
         if group is None or group.size <= 1:
             return
         tree = (self.state.params, self.state.opt_state)

@@ -70,13 +70,22 @@ class TrainingTask:
         return dht
 
     @functools.cached_property
+    def authorizer(self):
+        """Optional experiment authorizer (reference ``task.py:95-99``:
+        the HF authorizer is built only when auth is configured)."""
+        from dalle_tpu.swarm.auth import make_authorizer
+        return make_authorizer(self.peer_cfg.auth_authority,
+                               self.peer_cfg.auth_token_path)
+
+    @functools.cached_property
     def collab_optimizer(self):
         """Swarm-synchronous optimizer owning the train state (reference
         ``task.py:121-135``)."""
         from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
         return CollaborativeOptimizer(
             self.dht, self.collab_cfg, self.train_state, self.apply_step,
-            client_mode=self.peer_cfg.client_mode)
+            client_mode=self.peer_cfg.client_mode,
+            authorizer=self.authorizer)
 
     # -- mesh / compute ---------------------------------------------------
 
@@ -89,7 +98,9 @@ class TrainingTask:
     @functools.cached_property
     def model(self):
         from dalle_tpu.models.dalle import DALLE
-        return DALLE(self.model_cfg)
+        mesh = (self.mesh
+                if self.model_cfg.sequence_parallel != "none" else None)
+        return DALLE(self.model_cfg, mesh=mesh)
 
     @functools.cached_property
     def tx(self):

@@ -1,0 +1,242 @@
+"""Access-token authorization (swarm/auth.py + matchmaking integration).
+
+Mirrors the reference's auth surface (``huggingface_auth.py:46-193``):
+authority-issued tokens bound to peer identities, expiry, refresh, and the
+swarm-side gate that keeps unauthorized peers out of averaging groups.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from dalle_tpu.cli.issue_token import main as issue_token_main
+from dalle_tpu.swarm.auth import (AccessToken, ExperimentAuthority,
+                                  ExperimentAuthorizer, make_authorizer,
+                                  retry_with_backoff)
+from dalle_tpu.swarm.dht import get_dht_time
+from dalle_tpu.swarm.identity import Identity
+
+
+@pytest.fixture
+def authority():
+    return ExperimentAuthority(Identity.generate())
+
+
+@pytest.fixture
+def peer():
+    return Identity.generate()
+
+
+def _authorizer(authority, token=None):
+    return ExperimentAuthorizer(
+        authority.public_key,
+        token_supplier=(lambda: token) if token is not None else None)
+
+
+def test_issue_and_validate(authority, peer):
+    token = authority.issue("alice", peer.public_bytes, ttl=600)
+    auth = _authorizer(authority, token)
+    assert auth.validate_token(token, peer.public_bytes) == "alice"
+    # serialization round trip
+    again = AccessToken.from_bytes(token.to_bytes())
+    assert auth.validate_token(again, peer.public_bytes) == "alice"
+
+
+def test_rejects_expired_forged_and_rebound(authority, peer):
+    auth = _authorizer(authority)
+    expired = authority.issue("bob", peer.public_bytes, ttl=-10)
+    assert auth.validate_token(expired, peer.public_bytes) is None
+
+    token = authority.issue("bob", peer.public_bytes, ttl=600)
+    # bound to a different peer key -> stolen token
+    other = Identity.generate()
+    assert auth.validate_token(token, other.public_bytes) is None
+    # forged signature
+    forged = dataclasses.replace(token, signature=b"\x00" * 64)
+    assert auth.validate_token(forged, peer.public_bytes) is None
+    # signed by a different authority
+    rogue = ExperimentAuthority(Identity.generate())
+    rogue_token = rogue.issue("bob", peer.public_bytes, ttl=600)
+    assert auth.validate_token(rogue_token, peer.public_bytes) is None
+    # garbage bytes
+    assert auth.validate_token_bytes(b"junk", peer.public_bytes) is None
+    assert auth.validate_token_bytes(None, peer.public_bytes) is None
+
+
+def test_refresh_on_expiry(authority, peer):
+    calls = []
+
+    def supplier():
+        calls.append(1)
+        ttl = 1.0 if len(calls) == 1 else 3600.0
+        return authority.issue("carol", peer.public_bytes, ttl=ttl)
+
+    auth = ExperimentAuthorizer(authority.public_key,
+                                token_supplier=supplier)
+    first = auth.get_token()
+    assert len(calls) == 1
+    # first token is inside the refresh margin -> next access re-acquires
+    second = auth.get_token()
+    assert len(calls) == 2
+    assert second.expiration_time > first.expiration_time
+    # fresh token is kept
+    auth.get_token()
+    assert len(calls) == 2
+
+
+def test_retry_with_backoff_retries_then_raises():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    t0 = time.monotonic()
+    assert retry_with_backoff(flaky, max_tries=5, initial_delay=0.01,
+                              factor=2.0) == "ok"
+    assert len(attempts) == 3
+    assert time.monotonic() - t0 < 2.0
+
+    def dead():
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError, match="permanent"):
+        retry_with_backoff(dead, max_tries=2, initial_delay=0.01)
+
+
+def test_issue_token_cli(tmp_path):
+    akey = tmp_path / "authority.pem"
+    pkey = tmp_path / "peer.pem"
+    out = tmp_path / "alice.token"
+    # authority key is created on demand; --print-public-key path
+    assert issue_token_main(["--authority-key", str(akey),
+                             "--print-public-key"]) == 0
+    # peer identity is load-only: a missing path must NOT silently mint a
+    # key the real peer does not hold
+    assert issue_token_main([
+        "--authority-key", str(akey), "--username", "alice",
+        "--peer-identity", str(pkey), "--out", str(out)]) == 2
+    Identity.load_or_create(str(pkey))  # the peer creates its own identity
+    assert issue_token_main([
+        "--authority-key", str(akey), "--username", "alice",
+        "--peer-identity", str(pkey), "--ttl", "600",
+        "--out", str(out)]) == 0
+
+    authority = ExperimentAuthority(Identity.load_or_create(str(akey)))
+    peer = Identity.load_or_create(str(pkey))
+    auth = make_authorizer(authority.public_key.hex(), str(out))
+    assert auth.get_token().username == "alice"
+    assert auth.validate_token(auth.get_token(),
+                               peer.public_bytes) == "alice"
+
+
+def test_matchmaking_drops_unauthorized(tmp_path):
+    """Two authorized peers + one unauthorized announcer: the group is the
+    two authorized ones on every member's view."""
+    from dalle_tpu.swarm.dht import DHT
+    from dalle_tpu.swarm.matchmaking import make_group
+    from dalle_tpu.swarm.metrics import make_validators
+    import threading
+
+    authority = ExperimentAuthority(Identity.generate())
+
+    def node():
+        ident = Identity.generate()
+        return DHT(host="127.0.0.1", port=0, identity=ident,
+                   record_validators=make_validators(ident, "authx"))
+
+    a, b, c = node(), node(), node()
+    try:
+        for n in (b, c):
+            assert n.bootstrap(a.visible_address)
+        auth_a = _authorizer(authority, authority.issue(
+            "a", a.identity.public_bytes, ttl=600))
+        auth_b = _authorizer(authority, authority.issue(
+            "b", b.identity.public_bytes, ttl=600))
+        # c has a token issued by a DIFFERENT authority -> unauthorized
+        rogue = ExperimentAuthority(Identity.generate())
+        auth_c = _authorizer(rogue, rogue.issue(
+            "c", c.identity.public_bytes, ttl=600))
+
+        results = {}
+
+        def run(name, dht, auth):
+            results[name] = make_group(
+                dht, "authx", 0, weight=1.0, matchmaking_time=4.0,
+                min_group_size=2, authorizer=auth)
+
+        threads = [threading.Thread(target=run, args=args) for args in
+                   (("a", a, auth_a), ("b", b, auth_b), ("c", c, auth_c))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        ga, gb = results["a"], results["b"]
+        assert ga is not None and gb is not None
+        assert [m.peer_id for m in ga.members] == \
+               [m.peer_id for m in gb.members]
+        ids = {m.peer_id for m in ga.members}
+        assert ids == {a.peer_id, b.peer_id}
+        assert c.peer_id not in ids
+    finally:
+        for n in (a, b, c):
+            n.shutdown()
+
+
+def test_confirmation_filters_unauthorized_members(authority, peer):
+    """A (possibly malicious) leader cannot confirm an unauthorized id
+    into an honest follower's roster: tokens ride the signed confirmation
+    and each is validated individually."""
+    from dalle_tpu.swarm.matchmaking import (GroupMember,
+                                             _signed_confirmation,
+                                             member_authorized,
+                                             verify_confirmation)
+
+    leader = Identity.generate()
+    good = Identity.generate()
+    bad = Identity.generate()
+    tok_leader = authority.issue("l", leader.public_bytes, ttl=600)
+    tok_good = authority.issue("g", good.public_bytes, ttl=600)
+    auth = _authorizer(authority, tok_leader)
+
+    def pid(ident):
+        return ident.node_id.hex()
+
+    members = [
+        GroupMember(pid(leader), "x:1", 1.0, tok_leader.to_bytes()),
+        GroupMember(pid(good), "x:2", 1.0, tok_good.to_bytes()),
+        GroupMember(pid(bad), "x:3", 1.0, b""),               # no token
+        # stolen token: good's token attached to bad's roster entry
+        GroupMember(pid(bad), "x:4", 1.0, tok_good.to_bytes()),
+    ]
+    assert member_authorized(members[0], auth)
+    assert member_authorized(members[1], auth)
+    assert not member_authorized(members[2], auth)
+    assert not member_authorized(members[3], auth)
+
+    raw = _signed_confirmation(leader, "p", 3, members)
+    confirmed = verify_confirmation(raw, "p", 3, pid(leader), auth)
+    assert confirmed is not None
+    assert {m.peer_id for m in confirmed} == {pid(leader), pid(good)}
+    # without an authorizer everything passes through
+    open_roster = verify_confirmation(raw, "p", 3, pid(leader))
+    assert len(open_roster) == 4
+
+
+def test_clip_tokenizer_truncation_keeps_eot(tmp_path):
+    import gzip
+
+    from dalle_tpu.models.clip import CLIPTokenizer
+
+    path = tmp_path / "merges.txt.gz"
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        f.write("#version: 0.2\n")
+    tok = CLIPTokenizer(str(path), context_length=6)
+    ids = tok.encode("a very long caption that overflows the context")
+    assert ids.shape == (6,)
+    assert ids[-1] == tok.encoder["<|endoftext|>"]
+    assert ids.max() == tok.encoder["<|endoftext|>"]
