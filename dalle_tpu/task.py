@@ -110,14 +110,19 @@ class TrainingTask:
     @functools.cached_property
     def train_state(self):
         """Initial sharded TrainState (fresh params; checkpoint restore is
-        the trainer loop's job, reference ``task.py:88-93``)."""
+        the trainer loop's job, reference ``task.py:88-93``). With
+        ``optimizer.offload`` the optimizer state is placed in host RAM
+        instead of on the mesh (reference ``offload.py``/``task.py:130``)."""
         from dalle_tpu.models.dalle import init_params
         from dalle_tpu.parallel.sharding import shard_train_state
         from dalle_tpu.training.steps import TrainState
         params = init_params(self.model,
                              jax.random.PRNGKey(self.trainer_cfg.seed))
-        return shard_train_state(self.mesh,
-                                 TrainState.create(params, self.tx))
+        state = TrainState.create(params, self.tx)
+        if self.opt_cfg.offload:
+            from dalle_tpu.training.offload import offload_train_state
+            return offload_train_state(self.mesh, state)
+        return shard_train_state(self.mesh, state)
 
     @functools.cached_property
     def grad_step(self):
@@ -129,7 +134,12 @@ class TrainingTask:
     @functools.cached_property
     def apply_step(self):
         """Jitted (state, averaged_grads) -> state; the once-per-epoch
-        optimizer update (reference ``run_trainer_tpu.py:85-88`` seam)."""
+        optimizer update (reference ``run_trainer_tpu.py:85-88`` seam).
+        With ``optimizer.offload`` the update runs on the host against the
+        host-resident optimizer state."""
+        if self.opt_cfg.offload:
+            from dalle_tpu.training.offload import make_offloaded_apply_step
+            return make_offloaded_apply_step(self.tx, self.mesh)
         from dalle_tpu.training.steps import make_apply_step
         return jax.jit(make_apply_step(self.tx), donate_argnums=0)
 
