@@ -1,0 +1,145 @@
+"""Provision a fleet of preemptible TPU-VM trainer peers on GCP.
+
+Capability parity with the reference's Azure VMSS fleet
+(``manage_scaleset.py:84-236`` of learning-at-home/dalle: create/delete a
+scale set of 4 spot GPU VMs whose cloud-init installs the stack and joins
+the swarm pointing at a hard-coded initial peer, with ``spot_restore_policy``
+re-creating evicted VMs). TPU-native redesign: each worker is a *queued
+resource* TPU VM — GCP's preemptible/spot TPU primitive — created through
+the ``gcloud`` CLI (no cloud SDK dependency to pin), with a startup script
+that installs this package and launches ``run_trainer`` into the swarm.
+Preemption is already a graceful peer departure (the swarm's elasticity,
+``swarm/matchmaking.py``), and re-issuing the queued-resource request is the
+``spot_restore_policy`` analogue.
+
+Every gcloud invocation is also printed, and ``--dry-run`` prints without
+executing — the fleet logic is testable with no cloud account.
+
+Usage::
+
+    python -m dalle_tpu.cli.manage_fleet create \
+        --project my-proj --zone us-central2-b --accelerator-type v4-8 \
+        --swarm-size 4 --initial-peer 10.0.0.2:31334 [--dry-run]
+    python -m dalle_tpu.cli.manage_fleet delete --project ... --zone ...
+    python -m dalle_tpu.cli.manage_fleet list --project ... --zone ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+FLEET_PREFIX = "dalle-tpu-worker"
+
+# The reference bakes worker bootstrap into cloud-init
+# (manage_scaleset.py:24-81); same idea as a TPU-VM startup script. The
+# swarm address and experiment knobs are interpolated, credentials come
+# from the instance metadata/environment, never from the script text (the
+# reference's inline github/wandb tokens are exactly what not to copy).
+STARTUP_SCRIPT = """#!/bin/bash
+set -ex
+cd /opt
+if [ ! -d dalle-tpu ]; then
+  git clone {repo_url} dalle-tpu
+fi
+cd dalle-tpu
+python3 -m pip install -e . || true
+ulimit -n 8192
+exec python3 -m dalle_tpu.cli.run_trainer \\
+    --preset {preset} \\
+    --experiment-prefix {experiment_prefix} \\
+    --run-id {experiment_prefix} \\
+    {initial_peer_flag} \\
+    --identity-path /var/lib/dalle-tpu/identity.pem \\
+    >> /var/log/dalle-tpu-trainer.log 2>&1
+"""
+
+
+def worker_name(index: int) -> str:
+    return f"{FLEET_PREFIX}-{index}"
+
+
+def build_create_command(args, index: int) -> List[str]:
+    initial_peer_flag = (
+        f"--initial-peers {args.initial_peer}" if args.initial_peer else "")
+    script = STARTUP_SCRIPT.format(
+        repo_url=args.repo_url, preset=args.preset,
+        experiment_prefix=args.experiment_prefix,
+        initial_peer_flag=initial_peer_flag)
+    name = worker_name(index)
+    cmd = [
+        "gcloud", "compute", "tpus", "queued-resources", "create", name,
+        f"--project={args.project}", f"--zone={args.zone}",
+        f"--node-id={name}",
+        f"--accelerator-type={args.accelerator_type}",
+        f"--runtime-version={args.runtime_version}",
+        "--spot",                      # preemptible: the reference's spot VMs
+        f"--metadata=startup-script={script}",
+    ]
+    return cmd
+
+
+def build_delete_commands(args, index: int) -> List[List[str]]:
+    name = worker_name(index)
+    common = [f"--project={args.project}", f"--zone={args.zone}", "--quiet"]
+    return [
+        ["gcloud", "compute", "tpus", "queued-resources", "delete", name,
+         "--force"] + common,
+    ]
+
+
+def build_list_command(args) -> List[str]:
+    return ["gcloud", "compute", "tpus", "queued-resources", "list",
+            f"--project={args.project}", f"--zone={args.zone}",
+            f"--filter=name:{FLEET_PREFIX}"]
+
+
+def run(cmd: List[str], dry_run: bool) -> int:
+    print("+ " + " ".join(shlex.quote(c) for c in cmd))
+    if dry_run:
+        return 0
+    return subprocess.run(cmd, check=False).returncode
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-manage-fleet", description=__doc__.splitlines()[0])
+    parser.add_argument("command", choices=("create", "delete", "list"))
+    parser.add_argument("--project", required=True)
+    parser.add_argument("--zone", default="us-central2-b")
+    parser.add_argument("--accelerator-type", default="v4-8")
+    parser.add_argument("--runtime-version", default="tpu-ubuntu2204-base")
+    parser.add_argument("--swarm-size", type=int, default=4,
+                        help="number of worker TPU VMs (reference "
+                             "SWARM_SIZE=4, manage_scaleset.py:22)")
+    parser.add_argument("--initial-peer", default=None,
+                        help="host:port of a bootstrap peer (the aux peer)")
+    parser.add_argument("--repo-url", default="https://example.com/dalle-tpu.git",
+                        help="where workers clone the framework from")
+    parser.add_argument("--preset", default="flagship")
+    parser.add_argument("--experiment-prefix", default="dalle-tpu")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print gcloud commands without executing")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rc = 0
+    if args.command == "create":
+        for i in range(args.swarm_size):
+            rc |= run(build_create_command(args, i), args.dry_run)
+    elif args.command == "delete":
+        for i in range(args.swarm_size):
+            for cmd in build_delete_commands(args, i):
+                rc |= run(cmd, args.dry_run)
+    else:
+        rc = run(build_list_command(args), args.dry_run)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

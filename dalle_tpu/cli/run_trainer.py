@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--keep-checkpoints", type=int, default=3)
     parser.add_argument("--platform", type=str, default=None,
                         help="force a jax platform (cpu/tpu) before init")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="capture a JAX profiler trace of a few early "
+                             "steps into this directory")
     parser.add_argument("--log-level", type=str, default="INFO")
     for cls in CONFIG_CLASSES:
         add_dataclass_args(parser, cls)
@@ -115,6 +118,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "loss": report.loss,
                     "mini_steps": report.mini_steps,
                     "samples_per_second": report.samples_per_second,
+                    "timings": task.collab_optimizer.last_timings,
                 }) + "\n")
 
     with task:
@@ -127,7 +131,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              checkpoint_dir=args.checkpoint_dir,
                              save_every=args.save_every_epochs,
                              backup_every=args.backup_every_epochs,
-                             keep_checkpoints=args.keep_checkpoints)
+                             keep_checkpoints=args.keep_checkpoints,
+                             profile_dir=args.profile_dir)
     if reports:
         logger.info("done: %d epochs, final mean loss %.4f",
                     len(reports), reports[-1].loss)

@@ -93,6 +93,7 @@ class CollaborativeOptimizer:
             lambda acc, g, s: jax.tree.map(
                 lambda a, b: a + b.astype(jnp.float32) * s, acc, g))
         self._next_resync = 0.0
+        self.last_timings: dict = {}
         self._server: Optional[StateServer] = None
         if serve_state and not client_mode:
             self._server = StateServer(
@@ -153,12 +154,14 @@ class CollaborativeOptimizer:
         grads_host = [np.asarray(g) / weight for g in
                       jax.tree_util.tree_leaves(self._grad_acc)]
         treedef = jax.tree_util.tree_structure(self._grad_acc)
+        t_pull = time.monotonic()
 
         group = make_group(
             self.dht, f"{self.cfg.run_id}_grads", self.local_epoch,
             weight=weight, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
             client_mode=self.client_mode, authorizer=self.authorizer)
+        t_match = time.monotonic()
         if group is not None and group.size > 1:
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
@@ -170,10 +173,20 @@ class CollaborativeOptimizer:
                 adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
             averaged = grads_host  # alone this epoch
+        t_reduce = time.monotonic()
 
         grads_tree = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(a) for a in averaged])
         self.state = self.apply_step(self.state, grads_tree)
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.state.params)[0])
+        # per-phase timing of the collective path (SURVEY.md §5 calls for
+        # per-collective timing; the reference only ever had wall-clock sps)
+        self.last_timings = {
+            "grad_pull_s": round(t_pull - t0, 4),
+            "matchmaking_s": round(t_match - t_pull, 4),
+            "allreduce_s": round(t_reduce - t_match, 4),
+            "apply_s": round(time.monotonic() - t_reduce, 4),
+        }
 
         self.local_epoch += 1
         self.local_samples = 0
@@ -186,9 +199,9 @@ class CollaborativeOptimizer:
 
         for cb in self.on_after_global_step:
             cb()
-        logger.info("global step -> epoch %d (%.2fs, group=%s)",
+        logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",
                     self.local_epoch, time.monotonic() - t0,
-                    group.size if group else 1)
+                    group.size if group else 1, self.last_timings)
 
     # -- drift control / recovery ----------------------------------------
 

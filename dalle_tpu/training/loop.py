@@ -68,7 +68,9 @@ def train_loop(task: TrainingTask,
                checkpoint_dir: Optional[str] = None,
                save_every: int = 10,
                backup_every: int = 1,
-               keep_checkpoints: int = 3
+               keep_checkpoints: int = 3,
+               profile_dir: Optional[str] = None,
+               profile_steps: tuple = (2, 6)
                ) -> List[EpochReport]:
     """Run the peer until ``max_epochs`` global steps (None = forever).
 
@@ -78,6 +80,11 @@ def train_loop(task: TrainingTask,
     ``save_every`` (``callback.py:102-113``), sweep the params for
     NaN/Inf after every global step and roll back to the backup on
     corruption (``callback.py:95-100,50-54``).
+
+    With ``profile_dir``: capture a JAX profiler trace (TensorBoard /
+    Perfetto readable) of local steps ``profile_steps[0]..[1]`` — the
+    instrumentation the reference never had (SURVEY.md §5 "Tracing:
+    none in-repo"; its only signal was wall-clock sps).
 
     Returns the per-epoch reports (for tests and the CLI's summary).
     """
@@ -102,65 +109,102 @@ def train_loop(task: TrainingTask,
 
     reports: List[EpochReport] = []
     loss_sum, mini_steps, local_steps = 0.0, 0, 0
+    profiler = _StepProfiler(profile_dir, profile_steps)
     batches = task.batches()
-    while ((max_epochs is None or collab.local_epoch < max_epochs)
-           and (max_steps is None or local_steps < max_steps)):
-        batch = next(batches)
-        grads, metrics = task.grad_step(collab.state.params, batch)
-        loss = float(metrics["loss"])
-        loss_sum += loss
-        mini_steps += 1
-        local_steps += 1
-        if on_step is not None:
-            on_step(local_steps, loss)
+    try:
+        while ((max_epochs is None or collab.local_epoch < max_epochs)
+               and (max_steps is None or local_steps < max_steps)):
+            profiler.tick(local_steps)
+            batch = next(batches)
+            grads, metrics = task.grad_step(collab.state.params, batch)
+            loss = float(metrics["loss"])
+            loss_sum += loss
+            mini_steps += 1
+            local_steps += 1
+            if on_step is not None:
+                on_step(local_steps, loss)
 
-        epoch_before = collab.local_epoch
-        did_global = collab.step(grads, batch_size=task.local_batch_size)
-        if did_global and ckpt is not None:
-            epoch = collab.local_epoch
-            if not params_are_finite(collab.state.params):
-                logger.warning(
-                    "non-finite params after epoch %d: rolling back to "
-                    "the local backup", epoch)
-                restored = ckpt.restore_backup(collab.state)
-                if restored is None:
-                    restored = ckpt.restore_latest(collab.state)
-                if restored is None:
-                    raise RuntimeError(
-                        "params corrupted and no backup to restore")
-                collab.state, backup_epoch = restored
-                collab.local_epoch = backup_epoch
-                collab.tracker.reset_epoch(backup_epoch)
-            else:
-                do_backup = backup_every and epoch % backup_every == 0
-                if save_every and epoch % save_every == 0:
-                    ckpt.save(collab.state, epoch, backup=do_backup)
-                elif do_backup:
-                    ckpt.save_backup(collab.state, epoch)
-        if collab.local_epoch != epoch_before:
-            # global step OR resync-from-peers: either way a new epoch
-            report = EpochReport(
-                epoch=collab.local_epoch,
-                loss=loss_sum / max(mini_steps, 1),
-                mini_steps=mini_steps,
-                samples_per_second=(
-                    collab.tracker.performance_ema.samples_per_second))
-            reports.append(report)
-            if did_global and publish_metrics_records:
-                publish_metrics(
-                    task.dht, task.peer_cfg.experiment_prefix,
-                    LocalMetrics(
-                        peer_id=task.dht.peer_id,
-                        epoch=report.epoch,
-                        samples_per_second=report.samples_per_second,
-                        samples_accumulated=0,
-                        loss=report.loss,
-                        mini_steps=report.mini_steps),
-                    expiration=task.collab_cfg.metrics_expiration)
-            logger.info("epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",
-                        report.epoch, report.loss, report.mini_steps,
-                        report.samples_per_second)
-            if on_epoch is not None:
-                on_epoch(report)
-            loss_sum, mini_steps = 0.0, 0
+            epoch_before = collab.local_epoch
+            did_global = collab.step(grads,
+                                     batch_size=task.local_batch_size)
+            if did_global and ckpt is not None:
+                epoch = collab.local_epoch
+                if not params_are_finite(collab.state.params):
+                    logger.warning(
+                        "non-finite params after epoch %d: rolling back to "
+                        "the local backup", epoch)
+                    restored = ckpt.restore_backup(collab.state)
+                    if restored is None:
+                        restored = ckpt.restore_latest(collab.state)
+                    if restored is None:
+                        raise RuntimeError(
+                            "params corrupted and no backup to restore")
+                    collab.state, backup_epoch = restored
+                    collab.local_epoch = backup_epoch
+                    collab.tracker.reset_epoch(backup_epoch)
+                else:
+                    do_backup = backup_every and epoch % backup_every == 0
+                    if save_every and epoch % save_every == 0:
+                        ckpt.save(collab.state, epoch, backup=do_backup)
+                    elif do_backup:
+                        ckpt.save_backup(collab.state, epoch)
+            if collab.local_epoch != epoch_before:
+                # global step OR resync-from-peers: either way a new epoch
+                report = EpochReport(
+                    epoch=collab.local_epoch,
+                    loss=loss_sum / max(mini_steps, 1),
+                    mini_steps=mini_steps,
+                    samples_per_second=(
+                        collab.tracker.performance_ema.samples_per_second))
+                reports.append(report)
+                if did_global and publish_metrics_records:
+                    publish_metrics(
+                        task.dht, task.peer_cfg.experiment_prefix,
+                        LocalMetrics(
+                            peer_id=task.dht.peer_id,
+                            epoch=report.epoch,
+                            samples_per_second=report.samples_per_second,
+                            samples_accumulated=0,
+                            loss=report.loss,
+                            mini_steps=report.mini_steps),
+                        expiration=task.collab_cfg.metrics_expiration)
+                logger.info(
+                    "epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",
+                    report.epoch, report.loss, report.mini_steps,
+                    report.samples_per_second)
+                if on_epoch is not None:
+                    on_epoch(report)
+                loss_sum, mini_steps = 0.0, 0
+    finally:
+        # the trace from a crashed run is the artifact you want most
+        profiler.close()
     return reports
+
+
+class _StepProfiler:
+    """Start/stop a JAX profiler trace over a window of local steps; a
+    close() in the loop's ``finally`` finalizes the trace even when the
+    run dies mid-window."""
+
+    def __init__(self, profile_dir: Optional[str], steps: tuple):
+        self.dir = profile_dir
+        self.first, self.last = steps
+        self.active = False
+
+    def tick(self, local_step: int) -> None:
+        if self.dir is None:
+            return
+        if local_step == self.first and not self.active:
+            jax.profiler.start_trace(self.dir)
+            self.active = True
+        elif local_step >= self.last and self.active:
+            self._stop()
+
+    def close(self) -> None:
+        if self.active:
+            self._stop()
+
+    def _stop(self) -> None:
+        jax.profiler.stop_trace()
+        self.active = False
+        logger.info("profiler trace written to %s", self.dir)

@@ -146,3 +146,62 @@ class TestTrainerCLI:
         assert any(r["mean_loss"] is not None for r in live)
         # ...and archived at least one swarm checkpoint
         assert any(archive.glob("ckpt_*.msgpack")), out_aux[-4000:]
+
+
+class TestFleetCLI:
+    def test_dry_run_prints_gcloud_commands(self, capsys):
+        from dalle_tpu.cli.manage_fleet import main
+
+        rc = main(["create", "--project", "p", "--zone", "z",
+                   "--swarm-size", "2", "--initial-peer", "10.0.0.2:31334",
+                   "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("queued-resources create") == 2
+        assert "--spot" in out
+        assert "dalle-tpu-worker-0" in out and "dalle-tpu-worker-1" in out
+        assert "--initial-peers 10.0.0.2:31334" in out
+        assert "run_trainer" in out
+
+        rc = main(["delete", "--project", "p", "--swarm-size", "2",
+                   "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("queued-resources delete") == 2
+
+        rc = main(["list", "--project", "p", "--dry-run"])
+        assert rc == 0
+        assert "queued-resources list" in capsys.readouterr().out
+
+    def test_startup_script_has_no_secrets(self):
+        """The reference's cloud-init embedded live github/wandb tokens
+        (manage_scaleset.py:70,76); ours must never inline credentials."""
+        from dalle_tpu.cli.manage_fleet import STARTUP_SCRIPT
+
+        lowered = STARTUP_SCRIPT.lower()
+        for needle in ("ghp_", "api_key=", "token=", "password"):
+            assert needle not in lowered
+
+
+class TestProfiler:
+    def test_profile_dir_gets_a_trace(self, tmp_path):
+        """--profile-dir writes a JAX profiler trace during early steps
+        (single-peer run, no swarm partner needed)."""
+        port = free_port()
+        metrics = tmp_path / "m.jsonl"
+        profile = tmp_path / "trace"
+        proc = launch_trainer(port, metrics, "--profile-dir", str(profile),
+                              "--matchmaking-time", "1", max_epochs=2)
+        try:
+            out, _ = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            raise AssertionError(f"trainer hung:\n{out[-3000:]}")
+        assert proc.returncode == 0, out[-3000:]
+        traces = list(profile.rglob("*.xplane.pb"))
+        assert traces, f"no xplane trace under {profile}: {out[-2000:]}"
+        # per-phase swarm timings made it into the metrics file
+        entries = read_metrics(metrics)
+        assert entries and "timings" in entries[-1]
+        assert "allreduce_s" in entries[-1]["timings"]
