@@ -91,10 +91,16 @@ def main() -> None:
     backend = jax.default_backend()
     result = None
     if backend == "tpu":
-        cfg = flagship_model_config()
-        # Walk the microbatch down on OOM so the harness always emits a
+        # Walk configurations down on OOM so the harness always emits a
         # line; anything that is not an OOM is a real bug and propagates.
-        for micro, accum in ((8, 16), (4, 16), (2, 16), (1, 8)):
+        # Best measured (PERF.md): partial remat (1 of 4 shared blocks
+        # un-rematerialized) at microbatch 4 — the un-rematted block's
+        # activations fit in HBM at micro 4 and remove 1/4 of the remat
+        # recompute (micro 8 + skip OOMs; micro 8 without skip is next).
+        for micro, accum, overrides in ((4, 32, {"remat_skip_blocks": 1}),
+                                        (8, 16, {}), (4, 16, {}),
+                                        (2, 16, {}), (1, 8, {})):
+            cfg = flagship_model_config(**overrides)
             try:
                 ips = _bench(cfg, micro, accum, warmup=1, iters=3)
                 result = ("dalle-1.3b train images/sec/chip (tpu)", ips,
@@ -103,8 +109,8 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 - re-raised unless OOM
                 if not _is_oom(e):
                     raise
-                print(f"# micro {micro} OOM: {type(e).__name__}",
-                      file=sys.stderr)
+                print(f"# micro {micro} {overrides} OOM: "
+                      f"{type(e).__name__}", file=sys.stderr)
     if result is None:
         # Tiny-model numbers are not comparable to the 1.3B baseline:
         # report them honestly with vs_baseline 0.

@@ -36,7 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="authority Ed25519 PEM (created if missing)")
     parser.add_argument("--print-public-key", action="store_true",
                         help="print the authority public key (hex) and exit")
-    parser.add_argument("--username", type=str, default=None)
+    parser.add_argument("--username", type=str, default=None,
+                        help="defaults to DALLE_TPU_USERNAME / USER from "
+                             "the environment")
     parser.add_argument("--peer-identity", type=str, default=None,
                         help="peer identity PEM (its public key is bound "
                              "into the token)")
@@ -50,7 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
-    from dalle_tpu.swarm.auth import ExperimentAuthority
+    from dalle_tpu.swarm.auth import (ExperimentAuthority,
+                                      credentials_from_env)
     from dalle_tpu.swarm.identity import Identity
 
     authority = ExperimentAuthority(
@@ -59,9 +62,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(authority.public_key.hex())
         return 0
 
-    if not args.username or not args.peer_identity:
-        print("--username and --peer-identity are required to issue",
-              file=sys.stderr)
+    username = args.username or credentials_from_env()
+    if not username or not args.peer_identity:
+        print("--username (or DALLE_TPU_USERNAME/USER in the environment) "
+              "and --peer-identity are required to issue", file=sys.stderr)
         return 2
     if not Path(args.peer_identity).exists():
         # load-only: silently minting a fresh keypair here would bind the
@@ -70,10 +74,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
     peer = Identity.load_or_create(args.peer_identity)
-    token = authority.issue(args.username, peer.public_bytes, ttl=args.ttl)
-    out = Path(args.out or f"{args.username}.token")
+    token = authority.issue(username, peer.public_bytes, ttl=args.ttl)
+    out = Path(args.out or f"{username}.token")
     out.write_bytes(token.to_bytes())
-    print(f"issued token for {args.username!r} -> {out} "
+    print(f"issued token for {username!r} -> {out} "
           f"(peer {peer.node_id.hex()[:16]}, "
           f"authority {authority.public_key.hex()[:16]}...)")
     return 0

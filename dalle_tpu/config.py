@@ -76,6 +76,11 @@ class ModelConfig:
     # skips recomputing projections and attention (more memory, less
     # compute).
     remat_policy: Optional[str] = None
+    # Partial remat: leave this many of the unique weight-shared blocks
+    # un-rematerialized (their activations are saved instead of recomputed
+    # in backward). Trades HBM for the remat recompute — each skipped
+    # block removes 1/cycle of the extra forward pass.
+    remat_skip_blocks: int = 0
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
     # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"
@@ -123,6 +128,11 @@ class ModelConfig:
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
                 "expected None or 'save_attn'")
+        if not (0 <= self.remat_skip_blocks
+                <= max(self.shared_block_cycle, 0)):
+            raise ValueError(
+                f"remat_skip_blocks {self.remat_skip_blocks} outside "
+                f"[0, shared_block_cycle={self.shared_block_cycle}]")
         if self.sequence_parallel not in VALID_SP_MODES:
             raise ValueError(
                 f"unknown sequence_parallel {self.sequence_parallel!r}; "

@@ -150,6 +150,9 @@ class BlockCycle(nn.Module):
     block_cls: Any
     n_body: int
     mesh: Any = None
+    # blocks with uid >= cycle - remat_skip_blocks use this class instead
+    # (plain, no remat) — partial remat, cfg.remat_skip_blocks
+    plain_cls: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, it: jax.Array) -> jax.Array:
@@ -157,10 +160,14 @@ class BlockCycle(nn.Module):
         rot = _make_rot(cfg)
         cycle = cfg.shared_block_cycle
         exact = self.n_body % cycle == 0
+        first_plain = cycle - cfg.remat_skip_blocks
         for uid in range(cycle):
             attn_type = cfg.attn_types[uid % len(cfg.attn_types)]
-            y = self.block_cls(cfg, attn_type, mesh=self.mesh,
-                               name=f"block_{uid}")(x, rot)
+            cls = (self.plain_cls
+                   if self.plain_cls is not None and uid >= first_plain
+                   else self.block_cls)
+            y = cls(cfg, attn_type, mesh=self.mesh,
+                    name=f"block_{uid}")(x, rot)
             if exact:
                 x = y
             else:
@@ -213,18 +220,26 @@ class Transformer(nn.Module):
                            variable_broadcast="params",
                            split_rngs={"params": False})
             x, _ = scan(cfg, block_cls, body, mesh=self.mesh,
+                        plain_cls=(TransformerBlock if cfg.remat
+                                   and cfg.remat_skip_blocks else None),
                         name="cycle")(x, jnp.arange(reps))
             rest = sched[body:]
         else:
             rest = sched
 
         rot = _make_rot(cfg)
+        # partial remat must also apply on the unrolled path (cycle == 0 or
+        # a single repetition): the highest `remat_skip_blocks` unique body
+        # uids keep their activations (w_conv stays rematted)
+        body_uids = sorted({u for u, _ in rest if u != -1})
+        plain_uids = set(body_uids[len(body_uids) - cfg.remat_skip_blocks:]
+                         if cfg.remat and cfg.remat_skip_blocks else [])
         blocks = {}
         for uid, attn_type in rest:
             if uid not in blocks:
                 name = "block_wconv" if uid == -1 else f"block_{uid}"
-                blocks[uid] = block_cls(cfg, attn_type, mesh=self.mesh,
-                                        name=name)
+                cls = TransformerBlock if uid in plain_uids else block_cls
+                blocks[uid] = cls(cfg, attn_type, mesh=self.mesh, name=name)
             x = blocks[uid](x, rot)
 
         return nn.LayerNorm(dtype=_dtype(cfg),

@@ -226,17 +226,3 @@ def test_confirmation_filters_unauthorized_members(authority, peer):
     open_roster = verify_confirmation(raw, "p", 3, pid(leader))
     assert len(open_roster) == 4
 
-
-def test_clip_tokenizer_truncation_keeps_eot(tmp_path):
-    import gzip
-
-    from dalle_tpu.models.clip import CLIPTokenizer
-
-    path = tmp_path / "merges.txt.gz"
-    with gzip.open(path, "wt", encoding="utf-8") as f:
-        f.write("#version: 0.2\n")
-    tok = CLIPTokenizer(str(path), context_length=6)
-    ids = tok.encode("a very long caption that overflows the context")
-    assert ids.shape == (6,)
-    assert ids[-1] == tok.encoder["<|endoftext|>"]
-    assert ids.max() == tok.encoder["<|endoftext|>"]

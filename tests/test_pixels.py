@@ -263,3 +263,14 @@ def test_clip_bpe_eot_is_argmax(tmp_path):
     tok = CLIPTokenizer(path, context_length=8)
     ids = tok.encode("lo x")
     assert ids.max() == tok.encoder["<|endoftext|>"]
+
+
+def test_clip_tokenizer_truncation_keeps_eot(tmp_path):
+    """encode_text locates the EOT embedding via argmax over ids, so
+    truncation must keep EOT as the final token."""
+    path = _write_merges(tmp_path, [])
+    tok = CLIPTokenizer(str(path), context_length=6)
+    ids = tok.encode("a very long caption that overflows the context")
+    assert ids.shape == (6,)
+    assert ids[-1] == tok.encoder["<|endoftext|>"]
+    assert ids.max() == tok.encoder["<|endoftext|>"]
