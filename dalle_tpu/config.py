@@ -206,9 +206,18 @@ class CollabConfig:
     average_state_every: int = 10
     # Compression: tensors with <= threshold elems -> fp16, else uniform 8-bit
     # (SizeAdaptiveCompression(threshold=2**16+1, ...), task.py:125-126).
+    # "power_sgd" instead exchanges rank-r low-rank factors with error
+    # feedback (swarm/powersgd.py; hivemind carries PowerSGD upstream,
+    # SURVEY.md §2 component 15).
     size_adaptive_threshold: int = 2 ** 16 + 1
     grad_compression: str = "size_adaptive"
     state_compression: str = "size_adaptive"
+    powersgd_rank: int = 4
+    # AEAD-encrypt the all-reduce data plane under a per-round group key
+    # distributed through the signed matchmaking confirmation
+    # (swarm/crypto.py). The reference gets transport encryption from
+    # libp2p's security handshake; ours is framing-level.
+    encrypt_data_plane: bool = True
     delay_optimizer_step: bool = True  # task.py:129
     reuse_grad_buffers: bool = True    # task.py:133
     metrics_expiration: float = 600.0  # statistics_expiration, arguments.py:129-131

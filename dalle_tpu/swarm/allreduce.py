@@ -119,8 +119,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   codec: Optional[int] = None,
                   adaptive_threshold: int =
                   compression.SIZE_ADAPTIVE_THRESHOLD,
-                  sender_timeout: Optional[float] = None) -> List[np.ndarray]:
+                  sender_timeout: Optional[float] = None,
+                  report: Optional[dict] = None) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
+
+    ``report`` (optional dict) receives ``{"complete": bool}``: True iff
+    every expected reduce chunk and every gather part arrived — i.e. this
+    peer's result reflects the full roster. PowerSGD needs this to detect
+    rounds whose averaged bytes may diverge across survivors.
 
     ``weight`` is this peer's contribution weight (its accumulated sample
     count, hivemind's per-peer weighting). ``codec=None`` selects
@@ -129,7 +135,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     long the reduce phase waits without receiving any new chunk before
     banning the missing senders (default: a quarter of the round budget),
     so one dead peer cannot burn the whole round's budget.
+
+    When the group carries a ``group_key`` (matchmaking with
+    ``encrypt=True``), every chunk on the wire — pushes and mailbox posts
+    alike — is AEAD-wrapped with it (crypto.py), so gradients are opaque to
+    anyone outside the round's membership.
     """
+    from dalle_tpu.swarm.crypto import maybe_decrypt, maybe_encrypt
+    gkey = group.group_key
+    if report is not None:
+        report["complete"] = True  # falsified below on any missing chunk
     flat = flatten_tensors(tensors)
     owners = [m for m in group.members if m.addr]  # part owners
     if group.size <= 1 or not owners or flat.size == 0:
@@ -160,9 +175,18 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             return compression.adaptive_codec(n, adaptive_threshold)
         return codec
 
-    def send_chunk(addr: str, tag: int, body: bytes) -> bool:
+    def send_raw(addr: str, tag: int, wire_body: bytes) -> bool:
         remaining = max(0.1, deadline - time.monotonic())
-        return dht.send(addr, tag, body, timeout=remaining)
+        return dht.send(addr, tag, wire_body, timeout=remaining)
+
+    def send_chunk(addr: str, tag: int, body: bytes) -> bool:
+        return send_raw(addr, tag, maybe_encrypt(gkey, body))
+
+    def recv_chunk(tag: int, timeout: float) -> Optional[bytes]:
+        return maybe_decrypt(gkey, dht.recv(tag, timeout=timeout))
+
+    def fetch_chunk(addr: str, tag: int, timeout: float) -> Optional[bytes]:
+        return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
     # --- scatter: my data for part k -> owner k -------------------------
     with concurrent.futures.ThreadPoolExecutor(
@@ -201,7 +225,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     break  # gather keeps the remaining budget
                 if now - last_progress >= sender_timeout:
                     break  # no chunk for a while: remaining senders banned
-                raw = dht.recv(my_tag, timeout=min(
+                raw = recv_chunk(my_tag, timeout=min(
                     0.5, max(0.05, reduce_deadline - now)))
                 if raw is None:
                     continue
@@ -217,6 +241,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 acc += data * w
                 total_w += w
                 last_progress = time.monotonic()
+            if expected and report is not None:
+                report["complete"] = False
             averaged_mine = acc / total_w
 
         concurrent.futures.wait(futures)
@@ -237,16 +263,21 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             body = _make_frame(dht.identity, gather_ctx, group.group_hash,
                                group.my_index, 1.0, averaged_mine.size, c,
                                wire)
+            # the gather body is receiver-independent: encrypt ONCE, not
+            # once per recipient (the scatter path must stay per-receiver,
+            # its bodies differ)
+            wire_body = maybe_encrypt(gkey, body)
             for m in group.members:
                 if m.peer_id == me.peer_id or not m.addr:
                     continue
                 futures.append(pool.submit(
-                    send_chunk, m.addr,
-                    _tag(prefix, epoch, "gather", m.peer_id), body))
+                    send_raw, m.addr,
+                    _tag(prefix, epoch, "gather", m.peer_id), wire_body))
             if any(not m.addr for m in group.members):
                 # client-mode members can't receive pushes: publish the
                 # averaged part in this owner's mailbox for them to pull
-                dht.post(_tag(prefix, epoch, "mailbox", me.peer_id), body,
+                dht.post(_tag(prefix, epoch, "mailbox", me.peer_id),
+                         wire_body,
                          expiration_time=time.time()
                          + 2 * allreduce_timeout)
 
@@ -263,7 +294,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 now = time.monotonic()
                 if now >= deadline or now - last_progress >= sender_timeout:
                     break  # dead owners: their parts keep local values
-                raw = dht.recv(gather_tag, timeout=min(
+                raw = recv_chunk(gather_tag, timeout=min(
                     0.5, max(0.05, deadline - now)))
                 if raw is None:
                     continue
@@ -284,6 +315,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 last_progress = time.monotonic()
             # parts never received keep this peer's local values (owner
             # died mid-round): degraded but well-defined
+            if pending and report is not None:
+                report["complete"] = False
         else:
             # client mode: pull each averaged part from its owner's mailbox
             pending = {k: m for k, m in enumerate(owners)}
@@ -293,7 +326,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 if now >= deadline or now - last_progress >= sender_timeout:
                     break
                 for k, owner in list(pending.items()):
-                    raw = dht.fetch(
+                    raw = fetch_chunk(
                         owner.addr, _tag(prefix, epoch, "mailbox",
                                          owner.peer_id),
                         timeout=min(2.0, max(
@@ -310,6 +343,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     last_progress = time.monotonic()
                 if pending:
                     time.sleep(0.1)
+            if pending and report is not None:
+                report["complete"] = False
 
     return unflatten_tensors(out, tensors)
 

@@ -200,6 +200,11 @@ class DHT:
                  record_validators: Sequence[RecordValidatorBase] = (),
                  rpc_timeout: float = 5.0):
         self.identity = identity or Identity.load_or_create(identity_path)
+        # per-process X25519 key-agreement keypair for data-plane
+        # confidentiality (swarm/crypto.py); its public half rides this
+        # peer's signed announces/requests
+        from dalle_tpu.swarm.crypto import KxKeypair
+        self.kx = KxKeypair()
         self.client_mode = client_mode
         self.validators = list(record_validators)
         self._lib = _native.load()

@@ -86,7 +86,15 @@ class CollaborativeOptimizer:
             client_mode=client_mode)
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
-        self._grad_codec = _CODECS[cfg.grad_compression]
+        if cfg.grad_compression == "power_sgd":
+            # rank-r low-rank factor exchange (swarm/powersgd.py); the
+            # factors themselves ride the wire as fp16
+            from dalle_tpu.swarm.powersgd import PowerSGDCompressor
+            self._powersgd = PowerSGDCompressor(cfg.powersgd_rank)
+            self._grad_codec = compression.FLOAT16
+        else:
+            self._powersgd = None
+            self._grad_codec = _CODECS[cfg.grad_compression]
         self._state_codec = _CODECS[cfg.state_compression]
         self._grad_acc = None
         self._accumulate = jax.jit(
@@ -160,17 +168,49 @@ class CollaborativeOptimizer:
             self.dht, f"{self.cfg.run_id}_grads", self.local_epoch,
             weight=weight, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
-            client_mode=self.client_mode, authorizer=self.authorizer)
+            client_mode=self.client_mode, authorizer=self.authorizer,
+            encrypt=self.cfg.encrypt_data_plane)
         t_match = time.monotonic()
         if group is not None and group.size > 1:
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
                              - (time.monotonic() - t0)))
-            averaged = run_allreduce(
-                self.dht, group, f"{self.cfg.run_id}_grads",
-                self.local_epoch, grads_host, weight=weight,
-                allreduce_timeout=budget, codec=self._grad_codec,
-                adaptive_threshold=self.cfg.size_adaptive_threshold)
+            if self._powersgd is not None:
+                from dalle_tpu.swarm.powersgd import (IncompleteRound,
+                                                      average_with_powersgd)
+
+                def reduce_fn(tensors, phase):
+                    # two factor rounds per epoch (P then Q+raw), each
+                    # with half the round budget. An incomplete round
+                    # (member died mid-exchange) means this peer's
+                    # averaged factor bytes may diverge from other
+                    # survivors' orthogonal bases — reconstructing from
+                    # them corrupts gradients, so the epoch falls back to
+                    # local grads instead (the elasticity story).
+                    rep: dict = {}
+                    out = run_allreduce(
+                        self.dht, group,
+                        f"{self.cfg.run_id}_grads_{phase}",
+                        self.local_epoch, tensors, weight=weight,
+                        allreduce_timeout=budget / 2,
+                        codec=self._grad_codec,
+                        adaptive_threshold=self.cfg.size_adaptive_threshold,
+                        report=rep)
+                    if not rep.get("complete", False):
+                        raise IncompleteRound(phase)
+                    return out
+
+                # an IncompleteRound raised by reduce_fn is handled inside:
+                # the round is abandoned and local gradients come back
+                averaged = average_with_powersgd(
+                    self._powersgd, grads_host, reduce_fn,
+                    epoch=self.local_epoch)
+            else:
+                averaged = run_allreduce(
+                    self.dht, group, f"{self.cfg.run_id}_grads",
+                    self.local_epoch, grads_host, weight=weight,
+                    allreduce_timeout=budget, codec=self._grad_codec,
+                    adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
             averaged = grads_host  # alone this epoch
         t_reduce = time.monotonic()
@@ -221,7 +261,8 @@ class CollaborativeOptimizer:
             self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
             weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
-            client_mode=self.client_mode, authorizer=self.authorizer)
+            client_mode=self.client_mode, authorizer=self.authorizer,
+            encrypt=self.cfg.encrypt_data_plane)
         if group is None or group.size <= 1:
             return
         tree = (self.state.params, self.state.opt_state)

@@ -33,6 +33,25 @@ from dalle_tpu.swarm.identity import Identity, open_frame, signed_frame
 _CHUNK = 8 << 20  # 8 MB frames (native transport caps at 64 MB)
 
 
+def _seal_maybe(req_kx: bytes, frame: bytes) -> bytes:
+    """Seal a chunk to the requester's kx key when it supplied one, so the
+    state stream is confidential to the requester (the signed frame stays
+    inside the sealed box — authenticity AND confidentiality)."""
+    if not req_kx:
+        return frame
+    from dalle_tpu.swarm.crypto import seal_to
+    return seal_to(req_kx, frame)
+
+
+def _unseal(dht: DHT, raw: bytes) -> bytes:
+    """Open a sealed chunk with this peer's kx key; passthrough for
+    plaintext frames (sealed blobs never parse as valid signed frames, so
+    a failed guess is harmless)."""
+    from dalle_tpu.swarm.crypto import open_sealed
+    opened = open_sealed(dht.kx, bytes(raw))
+    return opened if opened is not None else bytes(raw)
+
+
 def _chunk_frame(identity: Identity, prefix: str, nonce: bytes, i: int,
                  n: int, part: bytes) -> bytes:
     """Signed state chunk: an unsigned stream would let any peer that
@@ -209,45 +228,51 @@ class StateServer:
             try:
                 req = msgpack.unpackb(raw, raw=False)
                 reply_addr, nonce = str(req["addr"]), bytes(req["nonce"])
+                req_kx = bytes(req.get("kx") or b"")
             except Exception:  # noqa: BLE001 - malformed request
                 continue
             if not self._stream_slots.acquire(blocking=False):
                 continue  # at capacity: requester retries another server
             threading.Thread(target=self._stream, daemon=True,
-                             args=(reply_addr, nonce)).start()
+                             args=(reply_addr, nonce, req_kx)).start()
 
-    def _stream(self, reply_addr: str, nonce: bytes) -> None:
+    def _stream(self, reply_addr: str, nonce: bytes,
+                req_kx: bytes = b"") -> None:
         try:
             epoch, arrays = self.provider()
             blob = serialize_state(epoch, arrays, self.codec,
                                    self.adaptive_threshold)
             if reply_addr:
-                self._send_chunks(reply_addr, nonce, blob)
+                self._send_chunks(reply_addr, nonce, blob, req_kx)
             else:
                 # client-mode requester (no listener): park the chunks in
                 # this server's mailbox for the requester to pull
-                self._post_chunks(nonce, blob)
+                self._post_chunks(nonce, blob, req_kx)
         except Exception:  # noqa: BLE001 - peer vanished mid-stream
             pass
         finally:
             self._stream_slots.release()
 
-    def _post_chunks(self, nonce: bytes, blob: bytes) -> None:
+    def _post_chunks(self, nonce: bytes, blob: bytes,
+                     req_kx: bytes = b"") -> None:
         n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
         exp = time.time() + 300.0
         for i in range(n):
             part = blob[i * _CHUNK:(i + 1) * _CHUNK]
             frame = _chunk_frame(self.dht.identity, self.prefix, nonce,
                                  i, n, part)
+            frame = _seal_maybe(req_kx, frame)
             self.dht.post(_chunk_tag(self.prefix, nonce, i), frame, exp)
 
-    def _send_chunks(self, addr: str, nonce: bytes, blob: bytes) -> None:
+    def _send_chunks(self, addr: str, nonce: bytes, blob: bytes,
+                     req_kx: bytes = b"") -> None:
         tag = _rsp_tag(self.prefix, nonce)
         n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
         for i in range(n):
             part = blob[i * _CHUNK:(i + 1) * _CHUNK]
             frame = _chunk_frame(self.dht.identity, self.prefix, nonce,
                                  i, n, part)
+            frame = _seal_maybe(req_kx, frame)
             if not self.dht.send(addr, tag, frame, timeout=30.0):
                 return
 
@@ -293,7 +318,10 @@ def load_state_from_peers(dht: DHT, prefix: str,
                 break
         nonce = os.urandom(16)  # CSPRNG: the nonce is the freshness binding
         reply_addr = "" if dht.client_mode else dht.visible_address
-        req = msgpack.packb({"addr": reply_addr, "nonce": nonce},
+        # the kx public key lets the server seal chunks so only this
+        # requester can read the state stream (swarm/crypto.py)
+        req = msgpack.packb({"addr": reply_addr, "nonce": nonce,
+                             "kx": dht.kx.public_bytes},
                             use_bin_type=True)
         if not dht.send(addr, _req_tag(prefix, pid), req,
                         timeout=min(10.0, remaining)):
@@ -329,7 +357,8 @@ def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
         if raw is None:
             time.sleep(0.2)  # server still serializing/posting
             continue
-        opened = _open_chunk(raw, prefix, nonce, expected_pid)
+        opened = _open_chunk(_unseal(dht, raw), prefix, nonce,
+                             expected_pid)
         if opened is None:
             return None
         idx, n, part = opened
@@ -354,7 +383,8 @@ def _collect_chunks(dht: DHT, tag: int, deadline: float, prefix: str,
             if total is not None and len(chunks) == total:
                 break
             continue
-        opened = _open_chunk(raw, prefix, nonce, expected_pid)
+        opened = _open_chunk(_unseal(dht, raw), prefix, nonce,
+                             expected_pid)
         if opened is None:
             continue
         i, n, part = opened

@@ -219,10 +219,11 @@ def test_confirmation_filters_unauthorized_members(authority, peer):
     assert not member_authorized(members[3], auth)
 
     raw = _signed_confirmation(leader, "p", 3, members)
-    confirmed = verify_confirmation(raw, "p", 3, pid(leader), auth)
-    assert confirmed is not None
+    verified = verify_confirmation(raw, "p", 3, pid(leader), auth)
+    assert verified is not None
+    confirmed, _keys = verified
     assert {m.peer_id for m in confirmed} == {pid(leader), pid(good)}
     # without an authorizer everything passes through
-    open_roster = verify_confirmation(raw, "p", 3, pid(leader))
+    open_roster, _ = verify_confirmation(raw, "p", 3, pid(leader))
     assert len(open_roster) == 4
 

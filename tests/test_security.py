@@ -38,8 +38,9 @@ class TestConfirmationSigning:
         leader_id = hashlib.sha256(leader.public_bytes).hexdigest()
         members = self._members([leader_id, "b" * 64])
         raw = _signed_confirmation(leader, "p", 3, members)
-        got = verify_confirmation(raw, "p", 3, leader_id)
-        assert got is not None
+        verified = verify_confirmation(raw, "p", 3, leader_id)
+        assert verified is not None
+        got, _keys = verified
         assert [m.peer_id for m in got] == [m.peer_id for m in members]
 
     def test_forged_signer_rejected(self):
