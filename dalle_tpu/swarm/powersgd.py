@@ -1,0 +1,239 @@
+"""PowerSGD gradient compression for swarm averaging.
+
+Low-rank gradient compression (Vogels et al., NeurIPS 2019) as an alternate
+``grad_compression`` mode. The reference's hivemind fork carries PowerSGD
+as an upstream averager variant (SURVEY.md §2 component 15: "blockwise/
+PowerSGD exist upstream as alternates"; §7 build plan item 6 names it for
+this build); the dalle app itself ships with size-adaptive fp16/8-bit.
+
+Algorithm, per 2D-reshapable gradient M (m x n), rank r:
+
+1. error feedback: ``M += e`` (the residual from last round);
+2. ``P = M @ Q`` with the warm-started projection Q (n x r);
+3. **average P across the group** (the existing butterfly all-reduce);
+4. orthogonalize the averaged P (Gram-Schmidt / reduced QR) — every peer
+   runs the same deterministic step on the same averaged bytes, so all
+   peers hold the identical orthonormal basis;
+5. ``Q = M^T @ P_orth`` and **average Q across the group**;
+6. reconstruct ``G = P_orth @ Q^T``; store ``e = M - G`` locally.
+
+Cross-peer correctness hinges on every peer holding the identical Q basis
+in phase 2 and the identical averaged-P bytes in phase 4. Two design
+choices guarantee the first by construction under elastic membership:
+
+- Q is seeded deterministically from ``(seed, tensor index, epoch)`` and
+  **never** warm-started from a previous round's average — a peer that
+  joins at epoch N derives exactly the veterans' Q without communication,
+  and a peer that missed a round cannot drift. (The PowerSGD paper's
+  warm start is a per-round quality optimization; under swarm elasticity
+  it is a cross-peer consistency hazard, so it is deliberately absent.
+  Error feedback recovers the approximation quality over rounds.)
+- The butterfly all-reduce reports whether the round was *complete* (every
+  expected chunk arrived); an incomplete factor round means this peer's
+  averaged bytes may differ from other survivors', so the caller falls
+  back to its local gradients for the epoch (exactly the "divergent peer
+  falls out of the round" elasticity the plain codecs have) instead of
+  reconstructing from mismatched bases.
+
+Tensors too small to win from rank-r factorization travel uncompressed
+through the same all-reduce rounds (appended to the Q phase).
+
+Compression: a (m x n) tensor costs r*(m+n) floats on the wire instead of
+m*n — at the flagship's 1024x1024 blocks and rank 4 that is 128x less
+gradient traffic per round, at the cost of a rank-r approximation whose
+error re-enters via feedback next round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: tensors compress only if rank-r factors are at most this fraction of
+#: the raw payload (hivemind's min_compression_rate idea)
+MIN_COMPRESSION_RATIO = 0.5
+
+
+class IncompleteRound(Exception):
+    """A factor all-reduce did not receive every expected chunk: this
+    peer's averaged bytes may differ from other survivors', so the caller
+    must not reconstruct from them (mismatched orthogonal bases corrupt
+    the gradients on every peer)."""
+
+
+@dataclasses.dataclass
+class _TensorPlan:
+    index: int                   # position in the gradient leaf list
+    shape: Tuple[int, ...]       # original shape
+    m: int                       # rows after 2D reshape
+    n: int                       # cols after 2D reshape
+
+
+def _as_matrix(shape: Sequence[int]) -> Tuple[int, int]:
+    """Collapse a >=2D shape to (leading, trailing) — first axis vs rest,
+    the standard PowerSGD matricization."""
+    m = int(shape[0])
+    n = 1
+    for s in shape[1:]:
+        n *= int(s)
+    return m, n
+
+
+def orthogonalize(p: np.ndarray, eps: float = 1e-8) -> np.ndarray:
+    """Orthonormalize columns via modified Gram-Schmidt (deterministic,
+    identical on every peer for identical input bytes)."""
+    p = np.array(p, np.float32, copy=True)
+    for i in range(p.shape[1]):
+        col = p[:, i]
+        for j in range(i):
+            col -= (col @ p[:, j]) * p[:, j]
+        norm = float(np.linalg.norm(col))
+        p[:, i] = col / (norm + eps)
+    return p
+
+
+class PowerSGDCompressor:
+    """Per-peer PowerSGD state: warm-started Qs + local error feedback.
+
+    One instance per CollaborativeOptimizer; its lifetime spans epochs so
+    warm starts and error feedback accumulate.
+    """
+
+    def __init__(self, rank: int = 4, seed: int = 0,
+                 min_ratio: float = MIN_COMPRESSION_RATIO):
+        self.rank = rank
+        self.seed = seed
+        self.min_ratio = min_ratio
+        self._qs: Dict[int, np.ndarray] = {}
+        self._errors: Dict[int, np.ndarray] = {}
+        self._mat_cache: Dict[int, np.ndarray] = {}
+        self._p_orth: Dict[int, np.ndarray] = {}
+
+    # -- planning ---------------------------------------------------------
+
+    def plan(self, leaves: Sequence[np.ndarray]) -> List[_TensorPlan]:
+        plans = []
+        for i, leaf in enumerate(leaves):
+            if leaf.ndim < 2:
+                continue
+            m, n = _as_matrix(leaf.shape)
+            if min(m, n) < self.rank:
+                continue  # factorization cannot even express the tensor
+            if self.rank * (m + n) > self.min_ratio * m * n:
+                continue
+            plans.append(_TensorPlan(i, tuple(leaf.shape), m, n))
+        return plans
+
+    def _q_for(self, plan: _TensorPlan, epoch: int) -> np.ndarray:
+        key = (plan.index, epoch)
+        q = self._qs.get(key)
+        if q is None:
+            # seeded by (seed, tensor index, epoch) ONLY — every peer,
+            # including one that just joined, derives the identical Q
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003 + plan.index * 7919 + epoch)
+                % (2 ** 31 - 1))
+            q = orthogonalize(
+                rng.randn(plan.n, self.rank).astype(np.float32))
+            self._qs = {key: q}  # keep only the current epoch's bases
+        return q
+
+    # -- the two communication phases ------------------------------------
+
+    def phase1_ps(self, leaves: Sequence[np.ndarray],
+                  plans: List[_TensorPlan],
+                  epoch: int = 0) -> List[np.ndarray]:
+        """Error-compensated P factors to be averaged across the group."""
+        ps = []
+        for plan in plans:
+            mat = np.asarray(leaves[plan.index], np.float32).reshape(
+                plan.m, plan.n)
+            err = self._errors.get(plan.index)
+            if err is not None and err.shape == mat.shape:
+                mat = mat + err
+            self._mat_cache[plan.index] = mat
+            ps.append(mat @ self._q_for(plan, epoch))
+        return ps
+
+    def phase2_qs(self, plans: List[_TensorPlan],
+                  averaged_ps: List[np.ndarray]) -> List[np.ndarray]:
+        """Orthogonalize averaged Ps, compute the Q factors to average."""
+        qs = []
+        self._p_orth = {}
+        for plan, p_avg in zip(plans, averaged_ps):
+            p_orth = orthogonalize(p_avg.reshape(plan.m, self.rank))
+            self._p_orth[plan.index] = p_orth
+            mat = self._mat_cache[plan.index]
+            qs.append(mat.T @ p_orth)
+        return qs
+
+    def reconstruct(self, leaves: List[np.ndarray],
+                    plans: List[_TensorPlan],
+                    averaged_qs: List[np.ndarray]) -> List[np.ndarray]:
+        """Replace planned leaves with the rank-r group average and update
+        error feedback. (Q is NOT warm-started from the average — see the
+        module docstring's elasticity argument.)"""
+        out = list(leaves)
+        for plan, q_avg in zip(plans, averaged_qs):
+            q_avg = q_avg.reshape(plan.n, self.rank)
+            p_orth = self._p_orth[plan.index]
+            approx = p_orth @ q_avg.T
+            mat = self._mat_cache.pop(plan.index)
+            self._errors[plan.index] = mat - approx
+            out[plan.index] = approx.reshape(plan.shape)
+        self._p_orth = {}
+        return out
+
+    def abandon_round(self) -> None:
+        """Discard this round's in-flight state after an incomplete factor
+        exchange: the caller keeps its local gradients, so error feedback
+        for the round must not be recorded (the local grads ARE exact) and
+        cached matrices are dropped."""
+        self._mat_cache.clear()
+        self._p_orth = {}
+
+
+def average_with_powersgd(
+        compressor: PowerSGDCompressor,
+        leaves: Sequence[np.ndarray],
+        reduce_fn,
+        epoch: int = 0,
+) -> List[np.ndarray]:
+    """Run the full PowerSGD exchange.
+
+    ``reduce_fn(tensors: List[np.ndarray], phase: str) -> List[np.ndarray]``
+    performs the group averaging for one phase ("p" or "q") — in
+    production the butterfly all-reduce (swarm/allreduce.py) with the phase
+    folded into the tag prefix, in tests a plain mean across peers. It may
+    raise :class:`IncompleteRound` to signal that this peer's averaged
+    bytes may diverge from other survivors' (a member died mid-round);
+    the caller then keeps its exact local gradients for the epoch.
+
+    Small/1D tensors that the plan skips are averaged exactly in their own
+    round, so the result is: rank-r approximate mean for big matrices,
+    exact mean for everything else.
+    """
+    leaves = [np.asarray(x, np.float32) for x in leaves]
+    plans = compressor.plan(leaves)
+    planned = {p.index for p in plans}
+
+    try:
+        ps = compressor.phase1_ps(leaves, plans, epoch)
+        averaged_ps = reduce_fn(ps, "p") if ps else []
+        qs = compressor.phase2_qs(plans, averaged_ps)
+        raw = [leaves[i] for i in range(len(leaves)) if i not in planned]
+        averaged_tail = reduce_fn(qs + raw, "q") if (qs or raw) else []
+    except IncompleteRound:
+        compressor.abandon_round()
+        return [x.copy() for x in leaves]
+    averaged_qs = averaged_tail[:len(qs)]
+    averaged_raw = averaged_tail[len(qs):]
+
+    out = compressor.reconstruct(leaves, plans, averaged_qs)
+    it = iter(averaged_raw)
+    for i in range(len(out)):
+        if i not in planned:
+            out[i] = next(it).reshape(leaves[i].shape)
+    return out

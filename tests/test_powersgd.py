@@ -1,0 +1,216 @@
+"""PowerSGD low-rank gradient compression (swarm/powersgd.py).
+
+Hivemind carries PowerSGD as an upstream averager alternate (SURVEY.md §2
+component 15); here it is a ``grad_compression="power_sgd"`` mode over the
+same butterfly all-reduce. Tests: exactness at full rank, cross-peer Q
+agreement without communication, error-feedback accumulation, wire-size
+reduction, and a real two-peer convergence run through the collaborative
+optimizer.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dalle_tpu.swarm.powersgd import (PowerSGDCompressor,
+                                      average_with_powersgd, orthogonalize)
+
+
+def test_orthogonalize():
+    rng = np.random.RandomState(0)
+    p = orthogonalize(rng.randn(32, 4).astype(np.float32))
+    np.testing.assert_allclose(p.T @ p, np.eye(4), atol=1e-4)
+
+
+def test_full_rank_is_exact_mean():
+    """With rank >= min(m, n), PowerSGD reproduces the exact mean, and
+    both peers reconstruct identical tensors (lockstep phase emulation,
+    the way the group all-reduce synchronizes real peers)."""
+    rng = np.random.RandomState(0)
+    g_a = [rng.randn(16, 6).astype(np.float32),
+           rng.randn(8).astype(np.float32)]
+    g_b = [rng.randn(16, 6).astype(np.float32),
+           rng.randn(8).astype(np.float32)]
+    want = [(a + b) / 2 for a, b in zip(g_a, g_b)]
+
+    comp_a = PowerSGDCompressor(rank=6, min_ratio=10.0)
+    comp_b = PowerSGDCompressor(rank=6, min_ratio=10.0)
+    plans_a = comp_a.plan(g_a)
+    plans_b = comp_b.plan(g_b)
+    assert [p.index for p in plans_a] == [p.index for p in plans_b] == [0]
+
+    ps_a = comp_a.phase1_ps(g_a, plans_a, epoch=0)
+    ps_b = comp_b.phase1_ps(g_b, plans_b, epoch=0)
+    avg_ps = [(x + y) / 2 for x, y in zip(ps_a, ps_b)]
+    qs_a = comp_a.phase2_qs(plans_a, avg_ps)
+    qs_b = comp_b.phase2_qs(plans_b, avg_ps)
+    avg_qs = [(x + y) / 2 for x, y in zip(qs_a, qs_b)]
+    out_a = comp_a.reconstruct(list(g_a), plans_a, avg_qs)
+    out_b = comp_b.reconstruct(list(g_b), plans_b, avg_qs)
+
+    np.testing.assert_allclose(out_a[0], want[0], rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(out_a[0], out_b[0])
+
+
+def test_qs_agree_across_peers_without_communication():
+    """Epoch-seeded Q: a peer that joins at epoch N derives the identical
+    basis without communication (and different epochs get fresh bases)."""
+    comp_a = PowerSGDCompressor(rank=4, seed=0)
+    comp_b = PowerSGDCompressor(rank=4, seed=0)
+    leaves = [np.zeros((32, 16), np.float32)]
+    plan_a = comp_a.plan(leaves)
+    plan_b = comp_b.plan(leaves)
+    np.testing.assert_array_equal(comp_a._q_for(plan_a[0], epoch=7),
+                                  comp_b._q_for(plan_b[0], epoch=7))
+    assert not np.array_equal(comp_a._q_for(plan_a[0], epoch=7),
+                              comp_a._q_for(plan_a[0], epoch=8))
+
+
+def test_incomplete_round_falls_back_to_local_grads():
+    """A factor round that cannot guarantee identical averaged bytes
+    across survivors must NOT be reconstructed from: the peer keeps its
+    exact local gradients and records no (wrong) error feedback."""
+    from dalle_tpu.swarm.powersgd import IncompleteRound
+
+    rng = np.random.RandomState(3)
+    grad = rng.randn(32, 24).astype(np.float32)
+    comp = PowerSGDCompressor(rank=2, min_ratio=10.0)
+
+    def dying(tensors, phase):
+        raise IncompleteRound(phase)
+
+    out = average_with_powersgd(comp, [grad], dying, epoch=0)
+    np.testing.assert_array_equal(out[0], grad)
+    assert not comp._errors and not comp._mat_cache
+
+
+def test_error_feedback_recovers_lost_mass():
+    """A rank-1 compressor on a rank-2 gradient loses mass in round 1 but
+    error feedback injects it in round 2: the two-round SUM approaches the
+    two-round true gradient sum."""
+    rng = np.random.RandomState(1)
+    u1, v1 = rng.randn(32, 1), rng.randn(1, 24)
+    u2, v2 = rng.randn(32, 1), rng.randn(1, 24)
+    grad = (u1 @ v1 + 0.3 * u2 @ v2).astype(np.float32)
+
+    comp = PowerSGDCompressor(rank=1, min_ratio=10.0)
+    ident = lambda tensors, phase: [t.copy() for t in tensors]  # noqa: E731
+
+    # advancing epochs rotate the (epoch-seeded) basis, as in production
+    # where the optimizer passes its local_epoch
+    out1 = average_with_powersgd(comp, [grad], ident, epoch=0)[0]
+    err1 = float(np.linalg.norm(grad - out1))
+    assert err1 > 0.1  # rank-1 cannot be exact on a rank-2 matrix
+
+    # Error feedback is an asymptotic guarantee: individual rounds
+    # oscillate (mass accumulates in e then dumps as the basis rotates),
+    # but the CUMULATIVE average of compressed outputs converges to the
+    # true gradient — which is what matters, since the optimizer consumes
+    # the running sum of updates.
+    outs = [out1]
+    for r in range(1, 12):
+        outs.append(average_with_powersgd(comp, [grad], ident, epoch=r)[0])
+    cum_err = float(np.linalg.norm(np.mean(outs, axis=0) - grad))
+    assert cum_err < 0.25 * err1
+    # without feedback the cumulative error stays large:
+    comp_nofb = PowerSGDCompressor(rank=1, min_ratio=10.0)
+    outs_nofb = []
+    for r in range(12):
+        comp_nofb._errors.clear()  # ablate the feedback
+        outs_nofb.append(
+            average_with_powersgd(comp_nofb, [grad], ident, epoch=r)[0])
+    nofb_err = float(np.linalg.norm(np.mean(outs_nofb, axis=0) - grad))
+    assert cum_err < 0.5 * nofb_err
+
+
+def test_wire_size_reduction():
+    comp = PowerSGDCompressor(rank=4)
+    leaves = [np.zeros((256, 128), np.float32), np.zeros(64, np.float32)]
+    plans = comp.plan(leaves)
+    assert [p.index for p in plans] == [0]
+    ps = comp.phase1_ps(leaves, plans, epoch=0)
+    qs = comp.phase2_qs(plans, ps)
+    factor_elems = sum(p.size for p in ps) + sum(q.size for q in qs)
+    assert factor_elems < 0.05 * leaves[0].size
+    # small tensor stays raw
+    assert plans[0].index == 0 and len(plans) == 1
+
+
+def test_two_peer_collab_with_powersgd():
+    """Two real peers over loopback co-train with power_sgd compression:
+    both end bit-in-sync at the same epoch with finite params."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_tpu.config import CollabConfig
+    from dalle_tpu.swarm.dht import DHT
+    from dalle_tpu.swarm.identity import Identity
+    from dalle_tpu.swarm.metrics import make_validators
+    from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+    from dalle_tpu.training.steps import TrainState
+    import optax
+
+    def node(prefix):
+        ident = Identity.generate()
+        return DHT(host="127.0.0.1", port=0, identity=ident,
+                   record_validators=make_validators(ident, prefix))
+
+    a = node("psgd")
+    b = node("psgd")
+    assert b.bootstrap(a.visible_address)
+
+    cfg = CollabConfig(run_id="psgd", target_batch_size=32,
+                       matchmaking_time=2.0, allreduce_timeout=10.0,
+                       averaging_timeout=20.0, grad_compression="power_sgd",
+                       powersgd_rank=2, average_state_every=0)
+    tx = optax.sgd(0.1)
+
+    from dalle_tpu.training.steps import make_apply_step
+    opts = []
+    for dht in (a, b):
+        params = {"w": jnp.ones((16, 8), jnp.float32),
+                  "b": jnp.zeros((8,), jnp.float32)}
+        state = TrainState.create(params, tx)
+        opt = CollaborativeOptimizer(
+            dht, cfg, state, jax.jit(make_apply_step(tx)))
+        opt.tracker.min_refresh_period = 0.05
+        opts.append(opt)
+
+    import time as _time
+
+    def grads(scale):
+        return {"w": jnp.full((16, 8), scale, jnp.float32),
+                "b": jnp.full((8,), scale, jnp.float32)}
+
+    def run(opt, scale):
+        deadline = _time.monotonic() + 30
+        while opt.local_epoch < 1 and _time.monotonic() < deadline:
+            opt.step(grads(scale), batch_size=8)
+            _time.sleep(0.05)
+        return opt.local_epoch
+
+    results = []
+    t1 = threading.Thread(target=lambda: results.append(run(opts[0], 1.0)))
+    t2 = threading.Thread(target=lambda: results.append(run(opts[1], 3.0)))
+    t1.start(); t2.start()
+    t1.join(60); t2.join(60)
+    try:
+        assert len(results) == 2 and all(e >= 1 for e in results), results
+        assert opts[0].local_epoch == opts[1].local_epoch
+        wa = np.asarray(opts[0].state.params["w"])
+        wb = np.asarray(opts[1].state.params["w"])
+        assert np.isfinite(wa).all() and np.isfinite(wb).all()
+        # identical wire bytes -> identical params on both peers
+        np.testing.assert_array_equal(wa, wb)
+        # each peer accumulates several local microbatches of its constant
+        # per-sample gradient (1.0 vs 3.0); the weighted average is between
+        # the two and rank-2 on a rank-1 (constant) matrix is exact, so
+        # w = 1 - 0.1 * avg lies in [1 - 0.3, 1 - 0.1]
+        assert 0.65 <= float(wa.mean()) <= 0.95, float(wa.mean())
+        assert np.ptp(wa) < 1e-3  # constant gradient -> uniform update
+    finally:
+        for opt in opts:
+            opt.shutdown()
+        a.shutdown()
+        b.shutdown()
