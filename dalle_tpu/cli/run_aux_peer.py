@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dalle-tpu-aux-peer", description=__doc__.splitlines()[0])
     parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
                         default="flagship")
+    parser.add_argument("--wandb-project", type=str, default=None,
+                        help="log aggregated swarm stats to this wandb "
+                             "project (reference run_aux_peer.py:92-93); "
+                             "requires wandb to be installed")
     parser.add_argument("--max-rounds", type=int, default=None,
                         help="stop after this many refresh rounds")
     parser.add_argument("--save-every-epochs", type=int, default=2,
@@ -101,6 +105,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from dalle_tpu.training.checkpoint import CheckpointManager
         ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
 
+    wandb_run = None
+    if args.wandb_project:
+        # the reference's aux peer is the swarm's single wandb writer
+        # (run_aux_peer.py:92-93,135-144); optional here — the JSON
+        # metrics file is the always-on sink
+        try:
+            import wandb
+            wandb_run = wandb.init(project=args.wandb_project,
+                                   name=f"aux-{peer.experiment_prefix}")
+        except Exception:  # noqa: BLE001 - wandb is strictly optional:
+            # missing install, auth failure, or no network must not take
+            # the monitoring peer down with it
+            logger.warning("wandb unavailable (--wandb-project %s); "
+                           "continuing with the metrics file",
+                           args.wandb_project, exc_info=True)
+
     last_archived = -1
     rounds = 0
     with task:
@@ -117,6 +137,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if args.metrics_file:
                 with open(args.metrics_file, "a") as f:
                     f.write(json.dumps({"round": rounds, **stats}) + "\n")
+            if wandb_run is not None:
+                wandb_run.log({k: v for k, v in stats.items()
+                               if v is not None})
 
             if (ckpt_mgr is not None and aux.store_checkpoints
                     and stats["epoch"] >= 0
@@ -132,6 +155,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     logger.info("archived swarm state at epoch %d", epoch)
                 else:
                     logger.warning("state archive pull failed this round")
+    if wandb_run is not None:
+        wandb_run.finish()
     return 0
 
 
