@@ -94,12 +94,17 @@ def main() -> None:
         # Walk configurations down on OOM so the harness always emits a
         # line; anything that is not an OOM is a real bug and propagates.
         # Best measured (PERF.md): partial remat (1 of 4 shared blocks
-        # un-rematerialized) at microbatch 4 — the un-rematted block's
-        # activations fit in HBM at micro 4 and remove 1/4 of the remat
-        # recompute (micro 8 + skip OOMs; micro 8 without skip is next).
-        for micro, accum, overrides in ((4, 32, {"remat_skip_blocks": 1}),
-                                        (8, 16, {}), (4, 16, {}),
-                                        (2, 16, {}), (1, 8, {})):
+        # un-rematerialized) + streaming cross-entropy at microbatch 4 —
+        # the un-rematted block's activations fit in HBM at micro 4 and
+        # remove 1/4 of the remat recompute, and the chunked-logsumexp
+        # head never materializes the (B, T, 8192) logits (micro 8 + skip
+        # OOMs even with the streamed head; plain micro 8 is next).
+        # the streamed head rides every fallback too: it is essentially
+        # free and only ever lowers peak memory
+        for micro, accum, overrides in (
+                (4, 32, {"remat_skip_blocks": 1, "head_chunk": 2048}),
+                (8, 16, {"head_chunk": 2048}), (4, 16, {"head_chunk": 2048}),
+                (2, 16, {"head_chunk": 2048}), (1, 8, {"head_chunk": 2048})):
             cfg = flagship_model_config(**overrides)
             try:
                 ips = _bench(cfg, micro, accum, warmup=1, iters=3)

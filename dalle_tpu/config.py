@@ -81,6 +81,11 @@ class ModelConfig:
     # in backward). Trades HBM for the remat recompute — each skipped
     # block removes 1/cycle of the extra forward pass.
     remat_skip_blocks: int = 0
+    # Streaming cross-entropy: compute the image-segment head loss as a
+    # chunked logsumexp over the vocabulary (chunks of this many ids)
+    # instead of materializing the full (B, T, vocab) logits in HBM.
+    # 0 = off (dense head). Identical losses either way.
+    head_chunk: int = 0
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
     # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"

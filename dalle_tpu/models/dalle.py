@@ -32,6 +32,58 @@ from dalle_tpu.config import ModelConfig
 from dalle_tpu.models.transformer import Transformer
 
 
+def _segment_nll(h: jax.Array, table: jax.Array, targets: jax.Array,
+                 head_chunk: int = 0) -> jax.Array:
+    """Per-token negative log-likelihood of ``targets`` under the tied-head
+    logits ``h @ table^T``, (B, T) out.
+
+    ``head_chunk > 0`` streams the logsumexp over vocabulary chunks so the
+    (B, T, V) logits tensor never materializes in HBM (the chunk body is
+    rematerialized in backward, trading one extra head-matmul pass for the
+    logits' round-trips). Identical values either way.
+    """
+    v = table.shape[0]
+    if head_chunk <= 0 or v <= head_chunk:
+        logits = jnp.einsum("btd,vd->btv", h, table.astype(h.dtype),
+                            preferred_element_type=jnp.float32)
+        return -jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            targets[..., None], axis=-1)[..., 0]
+
+    # the target logit, without the full logits tensor: gather the target
+    # rows of the table and contract against h
+    tgt_rows = jnp.take(table, targets, axis=0).astype(h.dtype)  # (B,T,D)
+    target_logit = jnp.einsum("btd,btd->bt", h, tgt_rows,
+                              preferred_element_type=jnp.float32)
+
+    pad = (-v) % head_chunk
+    tbl = jnp.pad(table, ((0, pad), (0, 0))) if pad else table
+    chunks = tbl.reshape(-1, head_chunk, tbl.shape[1]).astype(h.dtype)
+    n_chunks = chunks.shape[0]
+    # padded rows are all-zero -> logit 0; mask them out of the logsumexp
+    valid0 = jnp.arange(head_chunk)[None, :] < (
+        v - jnp.arange(n_chunks)[:, None] * head_chunk)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        m, l = carry
+        chunk, valid = xs
+        s = jnp.einsum("btd,vd->btv", h, chunk,
+                       preferred_element_type=jnp.float32)
+        s = jnp.where(valid[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l), None
+
+    b, t = h.shape[0], h.shape[1]
+    m0 = jnp.full((b, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, t), jnp.float32)
+    (m, l), _ = jax.lax.scan(body, (m0, l0), (chunks, valid0))
+    lse = m + jnp.log(l)
+    return lse - target_logit
+
+
 class DALLE(nn.Module):
     cfg: ModelConfig
     # Device mesh, needed only when cfg.sequence_parallel != "none": the
@@ -145,20 +197,12 @@ class DALLE(nn.Module):
             table = self.token_emb
             h_text = h[:, : cfg.text_seq_len]
             h_img = h[:, cfg.text_seq_len:]
-            logits_t = jnp.einsum(
-                "btd,vd->btv", h_text,
-                table[: cfg.vocab_text].astype(h.dtype),
-                preferred_element_type=jnp.float32)
-            logits_i = jnp.einsum(
-                "btd,vd->btv", h_img,
-                table[cfg.vocab_text: cfg.vocab_total].astype(h.dtype),
-                preferred_element_type=jnp.float32)
-            nll_text = -jnp.take_along_axis(
-                jax.nn.log_softmax(logits_t, axis=-1),
-                text_tokens[..., None], axis=-1)[..., 0]
-            nll_img = -jnp.take_along_axis(
-                jax.nn.log_softmax(logits_i, axis=-1),
-                image_tokens[..., None], axis=-1)[..., 0]
+            nll_text = _segment_nll(
+                h_text, table[: cfg.vocab_text], text_tokens,
+                cfg.head_chunk)
+            nll_img = _segment_nll(
+                h_img, table[cfg.vocab_text: cfg.vocab_total],
+                image_tokens, cfg.head_chunk)
 
         if loss_mask is not None:
             mask_text = loss_mask[:, : cfg.text_seq_len]

@@ -356,3 +356,37 @@ def test_partial_remat_applies_on_unrolled_path():
     jp0 = str(jax.make_jaxpr(lambda p: m0.apply(p, text, img)[0])(params))
     jp1 = str(jax.make_jaxpr(lambda p: m1.apply(p, text, img)[0])(params))
     assert jp0.count("remat") != jp1.count("remat")
+
+
+def test_streaming_head_matches_dense():
+    """head_chunk streams the logsumexp over vocab chunks; losses and
+    grads must equal the dense head exactly (incl. masked padding rows)."""
+    import numpy as np
+
+    from dalle_tpu.config import tiny_model_config
+    from dalle_tpu.models.dalle import DALLE, init_params
+
+    # vocab sizes deliberately NOT multiples of the chunk: exercises the
+    # padded-row masking in the chunked logsumexp
+    cfg0 = tiny_model_config(vocab_text=150, vocab_image=70)
+    cfg1 = type(cfg0)(**{**cfg0.__dict__, "head_chunk": 64})
+    m0, m1 = DALLE(cfg0), DALLE(cfg1)
+    params = init_params(m0, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(0, cfg0.vocab_text,
+                                   (3, cfg0.text_seq_len)), jnp.int32)
+    img = jnp.asarray(rng.randint(0, cfg0.vocab_image,
+                                  (3, cfg0.image_seq_len)), jnp.int32)
+    mask = jnp.asarray(rng.rand(3, cfg0.total_seq_len) > 0.2, jnp.float32)
+
+    def loss_and_grads(m):
+        def f(p):
+            loss, _ = m.apply(p, text, img, loss_mask=mask)
+            return loss
+        return jax.jit(jax.value_and_grad(f))(params)
+
+    (l0, g0), (l1, g1) = loss_and_grads(m0), loss_and_grads(m1)
+    assert abs(float(l0) - float(l1)) < 1e-5
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
