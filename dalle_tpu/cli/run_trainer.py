@@ -85,6 +85,8 @@ def configs_from_args(args: argparse.Namespace):
 
 def banner(task) -> None:
     """Connection banner with the copyable joiner line (utils.py:39-56)."""
+    if not task.slice_role.swarm_enabled:
+        return  # followers of a multi-host slice have no DHT to advertise
     addr = task.dht.visible_address
     logger.info("=" * 60)
     logger.info("peer %s listening on %s", task.dht.peer_id[:16], addr)

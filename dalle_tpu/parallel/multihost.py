@@ -1,0 +1,100 @@
+"""Multi-host pod slices as single swarm peers.
+
+The north-star deployment (SURVEY.md §2 parallelism table, §5 comm
+backend): "a whole pod slice presents as one high-throughput volunteer" —
+intra-slice communication is XLA collectives over ICI/DCN inside the jitted
+step (inserted by GSPMD over the global mesh), and exactly ONE process per
+slice speaks the swarm wire protocol. The reference's analogue is the
+TPU-VM peer whose 8 cores all-reduce locally while one host process talks
+to hivemind (``run_trainer_tpu.py:78-91``).
+
+Under ``jax.distributed`` (``process_count() > 1``):
+
+- the **coordinator** (process 0) opens the DHT, tracks swarm progress,
+  matchmakes, and runs the butterfly all-reduce over DCN/Internet;
+- **followers** run the same jitted grad step (their devices already
+  participate in the global-mesh collectives XLA inserts) and learn the
+  coordinator's decisions through host-level broadcasts:
+  :func:`broadcast_decision` (run a global step now? resync?) and
+  :func:`broadcast_arrays` (the averaged gradients), so every process
+  applies the identical update and parameters stay bit-synchronized
+  across the slice.
+
+Single-process runs (``process_count() == 1``) take none of these paths —
+every helper degenerates to a no-op passthrough, so the swarm layer is
+byte-identical to the single-host behavior it is tested under.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that speaks the swarm protocol for this slice."""
+    return jax.process_index() == 0
+
+
+def broadcast_decision(value: int) -> int:
+    """Broadcast a small integer decision from the coordinator to every
+    process (followers pass any value; the coordinator's wins). No-op in
+    single-process runs."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    out = multihost_utils.broadcast_one_to_all(
+        np.asarray([value], np.int64))
+    return int(out[0])
+
+
+def broadcast_arrays(arrays: Optional[List[np.ndarray]],
+                     like: List[np.ndarray]) -> List[np.ndarray]:
+    """Broadcast a list of host arrays from the coordinator.
+
+    Followers pass ``arrays=None`` and supply ``like`` (same shapes/
+    dtypes — their own local copies) as the structure template. No-op in
+    single-process runs (returns ``arrays`` as-is).
+    """
+    if jax.process_count() == 1:
+        return arrays if arrays is not None else like
+    from jax.experimental import multihost_utils
+    src = arrays if arrays is not None else like
+    src = [np.asarray(a) for a in src]  # dtypes preserved (codes, steps)
+    out = multihost_utils.broadcast_one_to_all(tuple(src))
+    return [np.asarray(a) for a in out]
+
+
+def sync() -> None:
+    """Barrier across processes (used around checkpoint writes so hosts
+    don't race each other's filesystem views). No-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dalle_tpu_sync")
+
+
+class SliceRole:
+    """The per-process role in a multi-host slice, resolved once.
+
+    ``swarm_enabled`` gates everything that talks to the wire (DHT,
+    tracker, matchmaking, state server); decision/array broadcasts carry
+    the results to followers.
+    """
+
+    def __init__(self) -> None:
+        self.n_processes = jax.process_count()
+        self.coordinator = is_coordinator()
+
+    @property
+    def swarm_enabled(self) -> bool:
+        return self.coordinator
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"SliceRole(processes={self.n_processes}, "
+                f"coordinator={self.coordinator})")

@@ -49,6 +49,30 @@ _CODECS = {"none": compression.NONE, "float16": compression.FLOAT16,
            "uniform8bit": compression.UNIFORM8BIT, "size_adaptive": None}
 
 
+class _FollowerEMA:
+    samples_per_second = 0.0
+
+    def reset_timer(self) -> None:
+        pass
+
+
+class _FollowerTracker:
+    """Tracker stand-in for non-coordinator processes of a multi-host
+    slice: the loop's bookkeeping surface with no wire behind it (the
+    coordinator's tracker is authoritative for the whole slice)."""
+
+    min_refresh_period = 0.0
+
+    def __init__(self) -> None:
+        self.performance_ema = _FollowerEMA()
+
+    def report_local_progress(self, *a, **k) -> None:
+        pass
+
+    def reset_epoch(self, *a, **k) -> None:
+        pass
+
+
 class CollaborativeOptimizer:
     """Owns the train state and drives swarm-synchronous updates.
 
@@ -63,12 +87,17 @@ class CollaborativeOptimizer:
         this peer (reference callback.py:41 semantics).
     """
 
-    def __init__(self, dht: DHT, cfg: CollabConfig, state: Any,
+    def __init__(self, dht: Optional[DHT], cfg: CollabConfig, state: Any,
                  apply_step: Callable[[Any, Any], Any],
                  client_mode: bool = False,
                  serve_state: bool = True,
                  matchmaking_min_group: int = 2,
-                 authorizer=None):
+                 authorizer=None,
+                 role=None):
+        from dalle_tpu.parallel.multihost import SliceRole
+        self.role = role or SliceRole()
+        if self.role.swarm_enabled and dht is None:
+            raise ValueError("the slice coordinator needs a DHT")
         self.dht = dht
         self.cfg = cfg
         self.state = state
@@ -81,9 +110,16 @@ class CollaborativeOptimizer:
         self.authorizer = authorizer
         self.local_epoch = 0
         self.local_samples = 0
-        self.tracker = ProgressTracker(
-            dht, cfg.run_id, cfg.target_batch_size,
-            client_mode=client_mode)
+        # Multi-host slices (parallel/multihost.py): exactly one process —
+        # the coordinator — speaks the swarm protocol; followers run the
+        # same jitted steps (their devices already join the global-mesh
+        # collectives) and receive decisions/averages via broadcasts.
+        if self.role.swarm_enabled:
+            self.tracker = ProgressTracker(
+                dht, cfg.run_id, cfg.target_batch_size,
+                client_mode=client_mode)
+        else:
+            self.tracker = _FollowerTracker()
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
         if cfg.grad_compression == "power_sgd":
@@ -102,8 +138,9 @@ class CollaborativeOptimizer:
                 lambda a, b: a + b.astype(jnp.float32) * s, acc, g))
         self._next_resync = 0.0
         self.last_timings: dict = {}
+        self._apply_timings: dict = {}
         self._server: Optional[StateServer] = None
-        if serve_state and not client_mode:
+        if serve_state and not client_mode and self.role.swarm_enabled:
             self._server = StateServer(
                 dht, cfg.run_id, self._state_snapshot,
                 codec=self._state_codec,
@@ -127,9 +164,20 @@ class CollaborativeOptimizer:
 
     # -- the hot path ----------------------------------------------------
 
+    # step() decision codes, broadcast coordinator -> followers in
+    # multi-host slices (parallel/multihost.py)
+    _CONTINUE, _GLOBAL_STEP, _RESYNC = 0, 1, 2
+
     def step(self, grads: Any, batch_size: int) -> bool:
         """Record one local accumulation step; run a global step when the
-        swarm is ready. Returns True iff a global step happened."""
+        swarm is ready. Returns True iff a global step happened.
+
+        In a multi-host slice every process calls step() in lockstep (the
+        jitted grad step is itself a global collective); the coordinator's
+        decision is broadcast so followers run the identical control flow.
+        """
+        from dalle_tpu.parallel.multihost import broadcast_decision
+
         if self._grad_acc is None:
             self._grad_acc = jax.tree.map(
                 lambda g: jnp.zeros(g.shape, jnp.float32), grads)
@@ -139,29 +187,55 @@ class CollaborativeOptimizer:
         self.tracker.report_local_progress(
             self.local_epoch, self.local_samples)
 
-        progress = self.tracker.global_progress()
-        if progress.epoch > self.local_epoch:
-            # keep accumulating between throttled attempts: hammering
-            # load_state_from_peers starves the host (and the swarm's
-            # state servers) without helping us catch up any faster
-            if time.monotonic() >= self._next_resync:
+        decision = self._CONTINUE
+        min_epoch = 0
+        if self.role.swarm_enabled:
+            progress = self.tracker.global_progress()
+            if progress.epoch > self.local_epoch:
+                # keep accumulating between throttled attempts: hammering
+                # load_state_from_peers starves the host (and the swarm's
+                # state servers) without helping us catch up any faster
+                if time.monotonic() >= self._next_resync:
+                    decision = self._RESYNC
+                    min_epoch = progress.epoch
+                    self._next_resync = time.monotonic() + 1.0
+            elif progress.ready_to_update:
+                decision = self._GLOBAL_STEP
+        decision = broadcast_decision(decision)
+
+        if decision == self._RESYNC:
+            if self.role.swarm_enabled:
                 logger.info(
                     "behind the swarm (local %d < global %d): resyncing",
-                    self.local_epoch, progress.epoch)
-                self.load_state_from_peers(min_epoch=progress.epoch)
-                self._next_resync = time.monotonic() + 1.0
+                    self.local_epoch, min_epoch)
+            self.load_state_from_peers(min_epoch=min_epoch)
             return False
-        if not progress.ready_to_update:
-            return False
-        self._run_global_step()
-        return True
+        if decision == self._GLOBAL_STEP:
+            self._run_global_step()
+            return True
+        return False
 
     def _run_global_step(self) -> None:
+        from dalle_tpu.parallel.multihost import broadcast_arrays
+
         t0 = time.monotonic()
+        treedef = jax.tree_util.tree_structure(self._grad_acc)
+
+        if not self.role.swarm_enabled:
+            # follower of a multi-host slice: the coordinator runs the
+            # swarm exchange; receive its averaged gradients and apply
+            # the identical update. Only shapes/dtypes are needed as the
+            # broadcast template — no device-to-host gradient pull here.
+            like = [np.zeros(g.shape, np.float32) for g in
+                    jax.tree_util.tree_leaves(self._grad_acc)]
+            averaged = broadcast_arrays(None, like=like)
+            self._apply_averaged(treedef, averaged)
+            self.last_timings = dict(self._apply_timings)
+            return
+
         weight = float(max(self.local_samples, 1))
         grads_host = [np.asarray(g) / weight for g in
                       jax.tree_util.tree_leaves(self._grad_acc)]
-        treedef = jax.tree_util.tree_structure(self._grad_acc)
         t_pull = time.monotonic()
 
         group = make_group(
@@ -213,20 +287,38 @@ class CollaborativeOptimizer:
                     adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
             averaged = grads_host  # alone this epoch
+        # deliver the averaged gradients to this slice's followers (no-op
+        # in single-process runs)
+        averaged = broadcast_arrays(averaged, like=grads_host)
         t_reduce = time.monotonic()
 
-        grads_tree = jax.tree_util.tree_unflatten(
-            treedef, [jnp.asarray(a) for a in averaged])
-        self.state = self.apply_step(self.state, grads_tree)
-        jax.block_until_ready(jax.tree_util.tree_leaves(self.state.params)[0])
+        self._apply_averaged(treedef, averaged)
         # per-phase timing of the collective path (SURVEY.md §5 calls for
-        # per-collective timing; the reference only ever had wall-clock sps)
+        # per-collective timing; the reference only ever had wall-clock
+        # sps). apply/state-averaging split comes from _apply_averaged so
+        # state-averaging network time is not misattributed to compute.
         self.last_timings = {
             "grad_pull_s": round(t_pull - t0, 4),
             "matchmaking_s": round(t_match - t_pull, 4),
             "allreduce_s": round(t_reduce - t_match, 4),
-            "apply_s": round(time.monotonic() - t_reduce, 4),
+            **self._apply_timings,
         }
+        logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",
+                    self.local_epoch, time.monotonic() - t0,
+                    group.size if group else 1, self.last_timings)
+
+    def _apply_averaged(self, treedef, averaged) -> None:
+        """The post-exchange half of a global step, identical on every
+        process of a slice: apply the averaged gradients, advance the
+        epoch, and run the (broadcast-synchronized) state averaging.
+        Fills ``self._apply_timings`` with the apply/state-averaging
+        split."""
+        t0 = time.monotonic()
+        grads_tree = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in averaged])
+        self.state = self.apply_step(self.state, grads_tree)
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.state.params)[0])
+        t_applied = time.monotonic()
 
         self.local_epoch += 1
         self.local_samples = 0
@@ -236,12 +328,13 @@ class CollaborativeOptimizer:
         if (self.cfg.average_state_every > 0
                 and self.local_epoch % self.cfg.average_state_every == 0):
             self._average_state()
+        self._apply_timings = {
+            "apply_s": round(t_applied - t0, 4),
+            "state_avg_s": round(time.monotonic() - t_applied, 4),
+        }
 
         for cb in self.on_after_global_step:
             cb()
-        logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",
-                    self.local_epoch, time.monotonic() - t0,
-                    group.size if group else 1, self.last_timings)
 
     # -- drift control / recovery ----------------------------------------
 
@@ -256,34 +349,53 @@ class CollaborativeOptimizer:
         stay local (identical updates keep them synchronized)."""
         from dalle_tpu.ops.quant import (Quantized, dequantize_blockwise,
                                          quantize_blockwise)
+        from dalle_tpu.parallel.multihost import (broadcast_arrays,
+                                                  broadcast_decision)
 
-        group = make_group(
-            self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
-            weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
-            min_group_size=self.matchmaking_min_group,
-            client_mode=self.client_mode, authorizer=self.authorizer,
-            encrypt=self.cfg.encrypt_data_plane)
-        if group is None or group.size <= 1:
-            return
+        # the epoch condition that got us here is deterministic, so every
+        # process of a slice enters together; whether a swarm group formed
+        # is the coordinator's knowledge and must be broadcast
         tree = (self.state.params, self.state.opt_state)
         is_q = lambda x: isinstance(x, Quantized)  # noqa: E731
-        leaves = jax.tree_util.tree_leaves(tree, is_leaf=is_q)
-        float_idx, floats = [], []
-        for i, leaf in enumerate(leaves):
-            if is_q(leaf):
-                float_idx.append(i)
-                floats.append(np.asarray(dequantize_blockwise(leaf),
-                                         dtype=np.float32))
-            elif compression.is_float_dtype(
-                    getattr(leaf, "dtype", np.asarray(leaf).dtype)):
-                float_idx.append(i)
-                floats.append(np.asarray(leaf, dtype=np.float32))
-        averaged = run_allreduce(
-            self.dht, group, f"{self.cfg.run_id}_state", self.local_epoch,
-            floats, weight=1.0,
-            allreduce_timeout=self.cfg.allreduce_timeout,
-            codec=self._state_codec,
-            adaptive_threshold=self.cfg.size_adaptive_threshold)
+
+        def float_leaves():
+            # dequantizing every 8-bit moment + f32-copying every float
+            # leaf is model-sized host work: build it only on paths that
+            # will actually average (a lone peer skips it entirely)
+            leaves = jax.tree_util.tree_leaves(tree, is_leaf=is_q)
+            float_idx, floats = [], []
+            for i, leaf in enumerate(leaves):
+                if is_q(leaf):
+                    float_idx.append(i)
+                    floats.append(np.asarray(dequantize_blockwise(leaf),
+                                             dtype=np.float32))
+                elif compression.is_float_dtype(
+                        getattr(leaf, "dtype", np.asarray(leaf).dtype)):
+                    float_idx.append(i)
+                    floats.append(np.asarray(leaf, dtype=np.float32))
+            return leaves, float_idx, floats
+
+        averaged = leaves = float_idx = floats = None
+        if self.role.swarm_enabled:
+            group = make_group(
+                self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
+                weight=1.0, matchmaking_time=self.cfg.matchmaking_time,
+                min_group_size=self.matchmaking_min_group,
+                client_mode=self.client_mode, authorizer=self.authorizer,
+                encrypt=self.cfg.encrypt_data_plane)
+            if group is not None and group.size > 1:
+                leaves, float_idx, floats = float_leaves()
+                averaged = run_allreduce(
+                    self.dht, group, f"{self.cfg.run_id}_state",
+                    self.local_epoch, floats, weight=1.0,
+                    allreduce_timeout=self.cfg.allreduce_timeout,
+                    codec=self._state_codec,
+                    adaptive_threshold=self.cfg.size_adaptive_threshold)
+        if not broadcast_decision(0 if averaged is None else 1):
+            return
+        if floats is None:  # follower of a slice whose coordinator averaged
+            leaves, float_idx, floats = float_leaves()
+        averaged = broadcast_arrays(averaged, like=floats)
         new_leaves = list(leaves)
         for i, avg in zip(float_idx, averaged):
             old = leaves[i]
@@ -310,22 +422,48 @@ class CollaborativeOptimizer:
     def load_state_from_peers(self, min_epoch: int = 0,
                               timeout: Optional[float] = None) -> bool:
         """Bootstrap params+opt state from the freshest live peer
-        (reference callback.py:41, run_aux_peer.py:48)."""
-        result = load_state_from_peers(
-            self.dht, self.cfg.run_id, min_epoch=min_epoch,
-            timeout=timeout or self.cfg.averaging_timeout)
-        if result is None:
-            logger.warning("load_state_from_peers: nobody answered")
+        (reference callback.py:41, run_aux_peer.py:48). In a multi-host
+        slice the coordinator downloads and broadcasts; every process
+        adopts the identical state."""
+        from dalle_tpu.parallel.multihost import (broadcast_arrays,
+                                                  broadcast_decision)
+
+        epoch, arrays = -1, None
+        if self.role.swarm_enabled:
+            result = load_state_from_peers(
+                self.dht, self.cfg.run_id, min_epoch=min_epoch,
+                timeout=timeout or self.cfg.averaging_timeout)
+            if result is None:
+                logger.warning("load_state_from_peers: nobody answered")
+            else:
+                epoch, arrays = result
+                # accept only state that moves us forward; same-epoch
+                # state would wipe the gradient accumulator for nothing
+                # (except at epoch 0, where a fresh joiner synchronizes
+                # its random init with the swarm)
+                if epoch < self.local_epoch or (
+                        epoch == self.local_epoch and self.local_epoch > 0):
+                    logger.warning(
+                        "ignoring stale peer state (epoch %d <= local %d)",
+                        epoch, self.local_epoch)
+                    epoch, arrays = -1, None
+        # broadcast_one_to_all needs identical shapes/dtypes on every
+        # process: canonicalize the downloaded (wire-format) arrays to the
+        # local state's layout before the broadcast decision
+        like = self._state_leaves()
+        if arrays is not None:
+            try:
+                assert len(arrays) == len(like)
+                arrays = [np.asarray(a).reshape(np.asarray(l).shape)
+                          .astype(np.asarray(l).dtype)
+                          for a, l in zip(arrays, like)]
+            except Exception:  # noqa: BLE001 - structurally alien state
+                logger.warning("peer state does not match local structure")
+                epoch, arrays = -1, None
+        epoch = broadcast_decision(epoch if arrays is not None else -1)
+        if epoch < 0:
             return False
-        epoch, arrays = result
-        # accept only state that moves us forward; same-epoch state would
-        # wipe the gradient accumulator for nothing (except at epoch 0,
-        # where a fresh joiner synchronizes its random init with the swarm)
-        if epoch < self.local_epoch or (epoch == self.local_epoch
-                                        and self.local_epoch > 0):
-            logger.warning("ignoring stale peer state (epoch %d <= local %d)",
-                           epoch, self.local_epoch)
-            return False
+        arrays = broadcast_arrays(arrays, like=like)
         self._replace_state_leaves(arrays)
         self.local_epoch = max(epoch, self.local_epoch)
         self.local_samples = 0

@@ -78,14 +78,28 @@ class TrainingTask:
                                self.peer_cfg.auth_token_path)
 
     @functools.cached_property
+    def slice_role(self):
+        """This process's role in a (possibly multi-host) slice: exactly
+        one process per slice speaks the swarm protocol
+        (parallel/multihost.py; the reference's analogue is the one host
+        process of a TPU-VM talking to hivemind, run_trainer_tpu.py)."""
+        from dalle_tpu.parallel.multihost import SliceRole
+        return SliceRole()
+
+    @functools.cached_property
     def collab_optimizer(self):
         """Swarm-synchronous optimizer owning the train state (reference
-        ``task.py:121-135``)."""
+        ``task.py:121-135``). Followers of a multi-host slice never open
+        a DHT — the coordinator's averaged results reach them via
+        broadcasts."""
         from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+        dht = self.dht if self.slice_role.swarm_enabled else None
         return CollaborativeOptimizer(
-            self.dht, self.collab_cfg, self.train_state, self.apply_step,
+            dht, self.collab_cfg, self.train_state, self.apply_step,
             client_mode=self.peer_cfg.client_mode,
-            authorizer=self.authorizer)
+            authorizer=self.authorizer if self.slice_role.swarm_enabled
+            else None,
+            role=self.slice_role)
 
     # -- mesh / compute ---------------------------------------------------
 

@@ -91,9 +91,14 @@ def train_loop(task: TrainingTask,
     from dalle_tpu.training.checkpoint import (CheckpointManager,
                                                params_are_finite)
 
+    from dalle_tpu.parallel import multihost
+
     collab = task.collab_optimizer
+    coordinator = collab.role.swarm_enabled
     ckpt = None
-    if checkpoint_dir is not None:
+    if checkpoint_dir is not None and coordinator:
+        # multi-host slices: only the coordinator touches the checkpoint
+        # directory; its (restored or fresh) state is broadcast below
         ckpt = CheckpointManager(checkpoint_dir, keep=keep_checkpoints)
         restored = ckpt.restore_latest(collab.state)
         if restored is not None:
@@ -104,6 +109,17 @@ def train_loop(task: TrainingTask,
             logger.info("resumed from local checkpoint at epoch %d", epoch)
             # if the swarm is ahead, the straggler-resync path in
             # collab.step() will still pull fresher state from peers
+    if multihost.process_count() > 1:
+        # align every process of the slice on the coordinator's initial
+        # state (fresh init is seed-identical, but a checkpoint restore
+        # or prior swarm sync is the coordinator's alone)
+        leaves = collab._state_leaves()
+        leaves = multihost.broadcast_arrays(
+            leaves if coordinator else None, like=leaves)
+        collab._replace_state_leaves(leaves)
+        collab.local_epoch = multihost.broadcast_decision(
+            collab.local_epoch)
+        collab.tracker.reset_epoch(collab.local_epoch)
     if warmup_steps:
         warmup(task, warmup_steps)
 
@@ -127,27 +143,55 @@ def train_loop(task: TrainingTask,
             epoch_before = collab.local_epoch
             did_global = collab.step(grads,
                                      batch_size=task.local_batch_size)
+            rolled_back = False
             if did_global and ckpt is not None:
                 epoch = collab.local_epoch
-                if not params_are_finite(collab.state.params):
-                    logger.warning(
-                        "non-finite params after epoch %d: rolling back to "
-                        "the local backup", epoch)
-                    restored = ckpt.restore_backup(collab.state)
-                    if restored is None:
-                        restored = ckpt.restore_latest(collab.state)
-                    if restored is None:
-                        raise RuntimeError(
-                            "params corrupted and no backup to restore")
-                    collab.state, backup_epoch = restored
-                    collab.local_epoch = backup_epoch
-                    collab.tracker.reset_epoch(backup_epoch)
-                else:
-                    do_backup = backup_every and epoch % backup_every == 0
-                    if save_every and epoch % save_every == 0:
-                        ckpt.save(collab.state, epoch, backup=do_backup)
-                    elif do_backup:
-                        ckpt.save_backup(collab.state, epoch)
+                try:
+                    if not params_are_finite(collab.state.params):
+                        logger.warning(
+                            "non-finite params after epoch %d: rolling "
+                            "back to the local backup", epoch)
+                        restored = ckpt.restore_backup(collab.state)
+                        if restored is None:
+                            restored = ckpt.restore_latest(collab.state)
+                        if restored is None:
+                            raise RuntimeError(
+                                "params corrupted and no backup to restore")
+                        collab.state, backup_epoch = restored
+                        collab.local_epoch = backup_epoch
+                        collab.tracker.reset_epoch(backup_epoch)
+                        rolled_back = True
+                    else:
+                        do_backup = (backup_every
+                                     and epoch % backup_every == 0)
+                        if save_every and epoch % save_every == 0:
+                            ckpt.save(collab.state, epoch, backup=do_backup)
+                        elif do_backup:
+                            ckpt.save_backup(collab.state, epoch)
+                except BaseException:
+                    # a coordinator dying between the global step and the
+                    # rollback broadcast would wedge every follower inside
+                    # broadcast_decision forever: send the abort code
+                    # first, then re-raise
+                    if multihost.process_count() > 1:
+                        multihost.broadcast_decision(2)
+                    raise
+            if did_global and multihost.process_count() > 1:
+                # a coordinator-side NaN rollback must re-align followers;
+                # code 2 = the coordinator failed and is going down
+                rb = multihost.broadcast_decision(1 if rolled_back else 0)
+                if rb == 2:
+                    raise RuntimeError(
+                        "slice coordinator failed during checkpoint "
+                        "handling")
+                if rb == 1:
+                    leaves = collab._state_leaves()
+                    leaves = multihost.broadcast_arrays(
+                        leaves if coordinator else None, like=leaves)
+                    collab._replace_state_leaves(leaves)
+                    collab.local_epoch = multihost.broadcast_decision(
+                        collab.local_epoch)
+                    collab.tracker.reset_epoch(collab.local_epoch)
             if collab.local_epoch != epoch_before:
                 # global step OR resync-from-peers: either way a new epoch
                 report = EpochReport(
@@ -157,7 +201,7 @@ def train_loop(task: TrainingTask,
                     samples_per_second=(
                         collab.tracker.performance_ema.samples_per_second))
                 reports.append(report)
-                if did_global and publish_metrics_records:
+                if did_global and publish_metrics_records and coordinator:
                     publish_metrics(
                         task.dht, task.peer_cfg.experiment_prefix,
                         LocalMetrics(

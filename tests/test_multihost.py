@@ -1,0 +1,129 @@
+"""Multi-host slices: one swarm peer per pod slice (parallel/multihost.py).
+
+The north-star deployment: a whole pod slice presents as ONE volunteer —
+process 0 speaks the swarm protocol, followers receive decisions/averages
+via broadcasts (SURVEY.md §5 comm backend; the reference's analogue is the
+single host process of a TPU-VM talking to hivemind while 8 cores
+all-reduce locally, run_trainer_tpu.py:78-91).
+
+The integration test runs TWO real JAX processes joined through
+``jax.distributed.initialize`` on the CPU backend and checks both end a
+swarm epoch with byte-identical parameters.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]; dht_port = sys.argv[3]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_tpu.config import CollabConfig
+from dalle_tpu.parallel.multihost import SliceRole
+from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+from dalle_tpu.training.steps import TrainState, make_apply_step
+
+role = SliceRole()
+assert role.n_processes == 2
+dht = None
+if role.swarm_enabled:
+    from dalle_tpu.swarm.dht import DHT
+    from dalle_tpu.swarm.identity import Identity
+    dht = DHT(host="127.0.0.1", port=int(dht_port),
+              identity=Identity.generate())
+
+cfg = CollabConfig(run_id="mh", target_batch_size=16,
+                   matchmaking_time=1.0, allreduce_timeout=10.0,
+                   averaging_timeout=20.0, average_state_every=0,
+                   grad_compression="none")
+tx = optax.sgd(0.1)
+params = {"w": jnp.ones((8, 4), jnp.float32)}
+state = TrainState.create(params, tx)
+opt = CollaborativeOptimizer(dht, cfg, state, jax.jit(make_apply_step(tx)),
+                             serve_state=False, matchmaking_min_group=1,
+                             role=role)
+if role.swarm_enabled:
+    opt.tracker.min_refresh_period = 0.05
+
+grads = {"w": jnp.full((8, 4), 2.0, jnp.float32)}
+steps = 0
+while opt.local_epoch < 1 and steps < 50:
+    opt.step(grads, batch_size=8)
+    steps += 1
+
+w = np.asarray(opt.state.params["w"])
+print(json.dumps({"pid": pid, "epoch": opt.local_epoch,
+                  "steps": steps,
+                  "w0": float(w.flat[0]),
+                  "digest": __import__("hashlib").sha256(
+                      w.tobytes()).hexdigest()}))
+if dht is not None:
+    dht.shutdown()
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_slice_applies_identical_updates():
+    env = dict(os.environ)
+    # one cpu device per process; no TPU relay dialing
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    port, dht_port = _free_port(), _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(pid), str(port),
+             str(dht_port)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError(
+            "multihost children hung:\n" +
+            "\n".join(o[-2000:] for o in outs))
+
+    results = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        results.append(json.loads(line))
+    by_pid = {r["pid"]: r for r in results}
+    assert by_pid[0]["epoch"] == by_pid[1]["epoch"] == 1
+    # both processes applied the identical update: w = 1 - 0.1*2 = 0.8
+    assert abs(by_pid[0]["w0"] - 0.8) < 1e-5
+    assert by_pid[0]["digest"] == by_pid[1]["digest"]
+    # followers and coordinator ran the same number of lockstep steps
+    assert by_pid[0]["steps"] == by_pid[1]["steps"]
