@@ -26,7 +26,11 @@ import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 30.0
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
-                "OOM", "Allocation failure", "exceeds the limit")
+                "OOM", "Allocation failure", "exceeds the limit",
+                # the tunnel's remote compile service dies (HTTP 500) on
+                # configs whose compile exhausts its memory — walk the
+                # ladder down instead of crashing the harness
+                "remote_compile", "tpu_compile_helper")
 
 
 def _is_oom(err: Exception) -> bool:
@@ -101,10 +105,16 @@ def main() -> None:
         # OOMs even with the streamed head; plain micro 8 is next).
         # the streamed head rides every fallback too: it is essentially
         # free and only ever lowers peak memory
+        # flagship_model_config already carries the tuned knobs
+        # (config.FLAGSHIP_TUNED: remat_skip_blocks=1, head_chunk=2048) —
+        # the fallback rungs must explicitly drop the partial remat, which
+        # COSTS memory (the fallbacks exist because memory ran out).
         for micro, accum, overrides in (
-                (4, 32, {"remat_skip_blocks": 1, "head_chunk": 2048}),
-                (8, 16, {"head_chunk": 2048}), (4, 16, {"head_chunk": 2048}),
-                (2, 16, {"head_chunk": 2048}), (1, 8, {"head_chunk": 2048})):
+                (4, 32, {}),
+                (8, 16, {"remat_skip_blocks": 0}),
+                (4, 16, {"remat_skip_blocks": 0}),
+                (2, 16, {"remat_skip_blocks": 0}),
+                (1, 8, {"remat_skip_blocks": 0})):
             cfg = flagship_model_config(**overrides)
             try:
                 ips = _bench(cfg, micro, accum, warmup=1, iters=3)

@@ -23,14 +23,17 @@ import sys
 from typing import Optional, Sequence
 
 from dalle_tpu.config import (CollabConfig, ModelConfig, OptimizerConfig,
-                              PeerConfig, TrainerConfig, tiny_model_config)
+                              PeerConfig, TrainerConfig,
+                              flagship_model_config, tiny_model_config)
 from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
                                  dataclass_from_args)
 
 logger = logging.getLogger("dalle_tpu.trainer")
 
 MODEL_PRESETS = {
-    "flagship": ModelConfig,                  # the 1.3B (task.py:62-83)
+    # the 1.3B (task.py:62-83) WITH the measured-best v5e training knobs —
+    # the same object bench.py measures (config.FLAGSHIP_TUNED)
+    "flagship": flagship_model_config,
     "tiny": tiny_model_config,                # CPU smoke shape
 }
 

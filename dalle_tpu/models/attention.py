@@ -45,6 +45,15 @@ from dalle_tpu.config import (
 
 NEG_INF = -1e9  # softmax mask fill; safe in fp32 accumulation
 
+# Tests set this True to route the model through the fused Pallas kernels
+# in interpret mode on CPU (the dispatchers otherwise pick the kernels
+# only on a real TPU backend).
+_PALLAS_INTERPRET = False
+
+
+def _pallas_by_default() -> bool:
+    return jax.default_backend() == "tpu" or _PALLAS_INTERPRET
+
 
 # ---------------------------------------------------------------------------
 # Rotary position embeddings (reference: rotary_emb=True, task.py:80)
@@ -251,9 +260,10 @@ def axial_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``use_pallas=None`` auto-selects the fused VMEM kernel on TPU.
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = _pallas_by_default()
     if use_pallas:
-        return axial_attention_fused(q, k, v, attn_type, text_len, grid)
+        return axial_attention_fused(q, k, v, attn_type, text_len, grid,
+                                     interpret=_PALLAS_INTERPRET)
     b, t, h, d = q.shape
     q_t, k_t, v_t = (x[:, :text_len] for x in (q, k, v))
     out_t = _text_causal(q_t, k_t, v_t)
@@ -275,6 +285,46 @@ def axial_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.concatenate([out_t, out_i], axis=1)
 
 
+def _window_fits_vmem(qshape, text_len: int, grid: int,
+                      budget_bytes: int = 12 * 2 ** 20) -> bool:
+    """Whether the window kernel's per-grid-step VMEM footprint fits.
+
+    The backward kernel holds ~11 whole-(T, D) refs (q/k/v, o/do, dq/dk/dv,
+    prefix pairs) at 2 heads per step plus two (T, D) f32 scratch
+    accumulators; past ~2k image tokens (e.g. the long-context 64x64 grid)
+    that exceeds the ~16 MB VMEM budget and the dense XLA path — or, for
+    long contexts, ring/Ulysses sequence parallelism — is the right
+    lowering."""
+    _, t, h, d = qshape
+    img = grid * grid
+    hps = 2 if h % 2 == 0 else 1
+    per_step = (11 * hps * img * d + 2 * text_len * d * hps) * 2 \
+        + 2 * img * d * 4  # bf16 refs + f32 scratch
+    return per_step <= budget_bytes
+
+
+def window_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
+                           attn_type: str, text_len: int, grid: int,
+                           conv_kernel: int = 11,
+                           interpret: bool = False) -> jax.Array:
+    """Pallas fused conv_like/full attention (see axial_attention_fused for
+    the layout rationale): image queries attend to the text prefix plus the
+    exact conv window (or, for 'full', every earlier token) with scores in
+    VMEM only — the dense lowering materialized (B, H, T, T) f32 scores in
+    HBM for the flagship's final 'w_conv' layer (reference task.py:63-65)."""
+    from dalle_tpu.ops.pallas.attention_kernels import (line_attention,
+                                                        window_attention)
+
+    hw = conv_kernel // 2 if attn_type == ATTN_CONV_LIKE else None
+    q, k, v = (x.swapaxes(1, 2) for x in (q, k, v))
+    q_t, k_t, v_t = (x[:, :, :text_len] for x in (q, k, v))
+    q_i, k_i, v_i = (x[:, :, text_len:] for x in (q, k, v))
+    out_t = line_attention(q_t, k_t, v_t, None, None,
+                           text_len, 0, False, interpret)
+    out_i = window_attention(q_i, k_i, v_i, k_t, v_t, grid, hw, interpret)
+    return jnp.concatenate([out_t, out_i], axis=2).swapaxes(1, 2)
+
+
 # ---------------------------------------------------------------------------
 # Dispatcher
 # ---------------------------------------------------------------------------
@@ -285,6 +335,10 @@ def zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Train-time attention dispatch: fast paths where available."""
     if attn_type in (ATTN_AXIAL_ROW, ATTN_AXIAL_COL):
         return axial_attention(q, k, v, attn_type, text_len, grid)
+    if _pallas_by_default() and _window_fits_vmem(q.shape, text_len, grid):
+        return window_attention_fused(q, k, v, attn_type, text_len, grid,
+                                      conv_kernel,
+                                      interpret=_PALLAS_INTERPRET)
     return dense_zoo_attention(q, k, v, attn_type, text_len, grid, conv_kernel)
 
 

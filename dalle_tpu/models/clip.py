@@ -254,11 +254,13 @@ def load_openai_checkpoint(path: str, cfg: CLIPConfig) -> Dict[str, Any]:
     dict) and return Flax params (``clip.load("ViT-B/32")`` parity)."""
     import torch
 
+    from dalle_tpu.utils.torch_io import torch_load_trusted
+
     try:
         model = torch.jit.load(path, map_location="cpu")
         sd = model.state_dict()
     except RuntimeError:
-        ckpt = torch.load(path, map_location="cpu", weights_only=False)
+        ckpt = torch_load_trusted(path)
         sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else (
             ckpt.state_dict())
     params = map_openai_state_dict(sd, cfg)

@@ -73,8 +73,11 @@ class ZooAttention(nn.Module):
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
         # names for the optional remat save-policy (config.remat_policy):
-        # saving rotated q/k/v and the attention context lets the backward
-        # pass skip recomputing the projections and the attention kernel
+        # saving rotated q/k/v lets the backward pass skip recomputing the
+        # projections; the attention kernel's own outputs are named
+        # "attn_out"/"attn_stats" inside its custom_vjp fwd rule
+        # (ops/pallas/attention_kernels.py) so policies can prune the
+        # kernel replay too
         q = checkpoint_name(q, "attn_q")
         k = checkpoint_name(k, "attn_k")
         v = checkpoint_name(v, "attn_v")
@@ -205,9 +208,28 @@ class Transformer(nn.Module):
 
         block_cls = TransformerBlock
         if cfg.remat:
+            # The names to save depend on which attention lowering runs:
+            # the Pallas kernels name their own outputs ("attn_out" +
+            # "attn_stats") inside their custom_vjp fwd rules so backward
+            # never re-runs the forward kernel; the dense XLA path has no
+            # kernel stats — there "attn_ctx" (the zoo output, named in
+            # ZooAttention) is the value whose saving prunes the attention
+            # recompute. Saving BOTH on the Pallas path would store the
+            # attention output twice (attn_ctx is the concat of the saved
+            # attn_out residuals), hence the split.
+            from dalle_tpu.models.attention import _pallas_by_default
+            ctx_names = (("attn_out", "attn_stats")
+                         if _pallas_by_default() else ("attn_ctx",))
             if cfg.remat_policy == "save_attn":
                 policy = jax.checkpoint_policies.save_only_these_names(
-                    "attn_q", "attn_k", "attn_v", "attn_ctx")
+                    "attn_q", "attn_k", "attn_v", *ctx_names)
+            elif cfg.remat_policy == "save_ctx":
+                # Saves only the attention outputs: backward replays the
+                # cheap projections/rotary but never the attention itself.
+                # ~10 MB/layer at flagship micro 4 vs ~42 MB/layer for
+                # full save_attn.
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    *ctx_names)
             else:
                 policy = None  # blanket remat: save only block boundaries
             block_cls = nn.remat(TransformerBlock, policy=policy)

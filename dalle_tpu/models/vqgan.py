@@ -246,9 +246,9 @@ def load_taming_checkpoint(path: str, cfg: VQGANConfig) -> Dict[str, Any]:
     vqgan_model_path, vqgan_config_path)``). torch is used only as a
     deserializer on the host; all compute stays in JAX.
     """
-    import torch  # cpu torch is available in the image; host-only use
+    from dalle_tpu.utils.torch_io import torch_load_trusted
 
-    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    ckpt = torch_load_trusted(path)
     sd = ckpt.get("state_dict", ckpt)
     params = map_taming_state_dict(sd, cfg)
     return jax.tree.map(jnp.asarray, params)

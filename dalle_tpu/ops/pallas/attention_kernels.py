@@ -33,6 +33,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -47,122 +48,139 @@ def _line_mask(rows: int, n: int) -> jax.Array:
     return (qi // n == kj // n) & (kj % n <= qi % n)
 
 
+# Shared prefix-attention math (pure jnp on loaded VMEM values), used by
+# both the line kernels (axial/text) and the window kernels (conv/full):
+# every image query attends to the whole text prefix, so the prefix scores
+# and their gradients are single chunky whole-tile matmuls.
+
+def _prefix_scores(q_all, kp, scale):
+    """(T, S) prefix scores and their row maxima for the whole tile."""
+    s_p_all = jax.lax.dot_general(
+        q_all, kp, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    return s_p_all, jnp.max(s_p_all, axis=-1, keepdims=True)
+
+
+def _prefix_grads(q_all, kp, vp, o_all, do_all, lse_all, scale):
+    """Whole-tile prefix backward: returns (dq_prefix, dkp, dvp) values
+    (f32); the caller writes them to refs / adds dq_prefix per block."""
+    dd_all = jnp.sum(do_all * o_all, axis=-1, keepdims=True)
+    s_p_all, _ = _prefix_scores(q_all, kp, scale)
+    p_p_all = jnp.exp(s_p_all - lse_all)
+    dp_p_all = jax.lax.dot_general(
+        do_all.astype(vp.dtype), vp, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds_p_all = p_p_all * (dp_p_all - dd_all)
+    dq_pfx = jax.lax.dot_general(
+        ds_p_all.astype(kp.dtype), kp, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dkp = jax.lax.dot_general(
+        ds_p_all.astype(q_all.dtype), q_all, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    dvp = jax.lax.dot_general(
+        p_p_all.astype(do_all.dtype), do_all, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dq_pfx, dkp, dvp
+
+
 def _fwd_kernel(q_ref, kl_ref, vl_ref, kp_ref, vp_ref, out_ref, stats_ref,
-                *, scale: float, n: int, block_rows: int):
+                *, scale: float, n: int, block_rows: int, hps: int = 1):
     t = q_ref.shape[2]
     has_prefix = kp_ref is not None
     mask = _line_mask(block_rows, n)
 
-    if has_prefix:
-        # prefix scores for the whole (b, h) tile in one chunky matmul;
-        # only the tiny line blocks loop
-        q_all = q_ref[0, 0, :, :]
-        kp = kp_ref[0, 0, :, :]
-        vp = vp_ref[0, 0, :, :]
-        s_p_all = jax.lax.dot_general(
-            q_all, kp, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        m_p_all = jnp.max(s_p_all, axis=-1, keepdims=True)
+    # ``hps`` heads are packed into each grid step (halving the grid and
+    # its per-step pipeline overhead); the per-head math is unchanged.
+    for hh in range(hps):
+        if has_prefix:
+            # prefix scores for the whole (b, h) tile in one chunky matmul;
+            # only the tiny line blocks loop
+            vp = vp_ref[0, hh, :, :]
+            s_p_all, m_p_all = _prefix_scores(
+                q_ref[0, hh, :, :], kp_ref[0, hh, :, :], scale)
 
-    for g in range(t // block_rows):
-        lo = g * block_rows
-        qg = q_ref[0, 0, lo:lo + block_rows, :]
-        klg = kl_ref[0, 0, lo:lo + block_rows, :]
-        vlg = vl_ref[0, 0, lo:lo + block_rows, :]
-        s_l = jax.lax.dot_general(
-            qg, klg, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s_l = jnp.where(mask, s_l, NEG_INF)
-        m = jnp.max(s_l, axis=-1, keepdims=True)
-        if has_prefix:
-            m = jnp.maximum(m, m_p_all[lo:lo + block_rows])
-        e_l = jnp.exp(s_l - m)
-        denom = jnp.sum(e_l, axis=-1, keepdims=True)
-        o = jax.lax.dot_general(
-            e_l.astype(vlg.dtype), vlg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if has_prefix:
-            e_p = jnp.exp(s_p_all[lo:lo + block_rows] - m)
-            denom = denom + jnp.sum(e_p, axis=-1, keepdims=True)
-            o = o + jax.lax.dot_general(
-                e_p.astype(vp.dtype), vp, (((1,), (0,)), ((), ())),
+        for g in range(t // block_rows):
+            lo = g * block_rows
+            qg = q_ref[0, hh, lo:lo + block_rows, :]
+            klg = kl_ref[0, hh, lo:lo + block_rows, :]
+            vlg = vl_ref[0, hh, lo:lo + block_rows, :]
+            s_l = jax.lax.dot_general(
+                qg, klg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s_l = jnp.where(mask, s_l, NEG_INF)
+            m = jnp.max(s_l, axis=-1, keepdims=True)
+            if has_prefix:
+                m = jnp.maximum(m, m_p_all[lo:lo + block_rows])
+            e_l = jnp.exp(s_l - m)
+            denom = jnp.sum(e_l, axis=-1, keepdims=True)
+            o = jax.lax.dot_general(
+                e_l.astype(vlg.dtype), vlg, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-        out_ref[0, 0, lo:lo + block_rows, :] = (o / denom).astype(
-            out_ref.dtype)
-        stats_ref[0, 0, 0, lo:lo + block_rows] = \
-            (m + jnp.log(denom))[:, 0]
+            if has_prefix:
+                e_p = jnp.exp(s_p_all[lo:lo + block_rows] - m)
+                denom = denom + jnp.sum(e_p, axis=-1, keepdims=True)
+                o = o + jax.lax.dot_general(
+                    e_p.astype(vp.dtype), vp, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            out_ref[0, hh, lo:lo + block_rows, :] = (o / denom).astype(
+                out_ref.dtype)
+            stats_ref[0, hh, 0, lo:lo + block_rows] = \
+                (m + jnp.log(denom))[:, 0]
 
 
 def _bwd_kernel(q_ref, kl_ref, vl_ref, kp_ref, vp_ref, stats_ref, o_ref,
                 do_ref, dq_ref, dkl_ref, dvl_ref, dkp_ref, dvp_ref,
-                *, scale: float, n: int, block_rows: int):
+                *, scale: float, n: int, block_rows: int, hps: int = 1):
     t = q_ref.shape[2]
     has_prefix = kp_ref is not None
     mask = _line_mask(block_rows, n)
 
-    if has_prefix:
-        # whole-tile prefix math: p_p, dp_p, ds_p and the prefix grads are
-        # single chunky matmuls; only the line blocks loop
-        q_all = q_ref[0, 0, :, :]
-        o_all = o_ref[0, 0, :, :].astype(jnp.float32)
-        do_all = do_ref[0, 0, :, :].astype(jnp.float32)
-        lse_all = stats_ref[0, 0, 0, :][:, None]
-        dd_all = jnp.sum(do_all * o_all, axis=-1, keepdims=True)
-        kp = kp_ref[0, 0, :, :]
-        vp = vp_ref[0, 0, :, :]
-        s_p_all = jax.lax.dot_general(
-            q_all, kp, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p_p_all = jnp.exp(s_p_all - lse_all)
-        dp_p_all = jax.lax.dot_general(
-            do_all.astype(vp.dtype), vp, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds_p_all = p_p_all * (dp_p_all - dd_all)
-        dq_pfx = jax.lax.dot_general(
-            ds_p_all.astype(kp.dtype), kp, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dkp_ref[0, 0, :, :] = (jax.lax.dot_general(
-            ds_p_all.astype(q_all.dtype), q_all, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale).astype(
-                dkp_ref.dtype)
-        dvp_ref[0, 0, :, :] = jax.lax.dot_general(
-            p_p_all.astype(do_all.dtype), do_all, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
-
-    for g in range(t // block_rows):
-        lo = g * block_rows
-        qg = q_ref[0, 0, lo:lo + block_rows, :]
-        klg = kl_ref[0, 0, lo:lo + block_rows, :]
-        vlg = vl_ref[0, 0, lo:lo + block_rows, :]
-        og = o_ref[0, 0, lo:lo + block_rows, :].astype(jnp.float32)
-        dog = do_ref[0, 0, lo:lo + block_rows, :].astype(jnp.float32)
-        lse = stats_ref[0, 0, 0, lo:lo + block_rows][:, None]
-        dd = jnp.sum(dog * og, axis=-1, keepdims=True)
-        s_l = jax.lax.dot_general(
-            qg, klg, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s_l = jnp.where(mask, s_l, NEG_INF)
-        p_l = jnp.exp(s_l - lse)
-        dp_l = jax.lax.dot_general(
-            dog.astype(vlg.dtype), vlg, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds_l = p_l * (dp_l - dd)
-        dq_g = jax.lax.dot_general(
-            ds_l.astype(klg.dtype), klg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    for hh in range(hps):
         if has_prefix:
-            dq_g = dq_g + dq_pfx[lo:lo + block_rows]
-        dkl_g = jax.lax.dot_general(
-            ds_l.astype(qg.dtype), qg, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dvl_g = jax.lax.dot_general(
-            p_l.astype(dog.dtype), dog, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dq_ref[0, 0, lo:lo + block_rows, :] = \
-            (dq_g * scale).astype(dq_ref.dtype)
-        dkl_ref[0, 0, lo:lo + block_rows, :] = \
-            (dkl_g * scale).astype(dkl_ref.dtype)
-        dvl_ref[0, 0, lo:lo + block_rows, :] = dvl_g.astype(dvl_ref.dtype)
+            # whole-tile prefix grads; only the line blocks loop
+            dq_pfx, dkp, dvp = _prefix_grads(
+                q_ref[0, hh, :, :], kp_ref[0, hh, :, :], vp_ref[0, hh, :, :],
+                o_ref[0, hh, :, :].astype(jnp.float32),
+                do_ref[0, hh, :, :].astype(jnp.float32),
+                stats_ref[0, hh, 0, :][:, None], scale)
+            dkp_ref[0, hh, :, :] = dkp.astype(dkp_ref.dtype)
+            dvp_ref[0, hh, :, :] = dvp.astype(dvp_ref.dtype)
+
+        for g in range(t // block_rows):
+            lo = g * block_rows
+            qg = q_ref[0, hh, lo:lo + block_rows, :]
+            klg = kl_ref[0, hh, lo:lo + block_rows, :]
+            vlg = vl_ref[0, hh, lo:lo + block_rows, :]
+            og = o_ref[0, hh, lo:lo + block_rows, :].astype(jnp.float32)
+            dog = do_ref[0, hh, lo:lo + block_rows, :].astype(jnp.float32)
+            lse = stats_ref[0, hh, 0, lo:lo + block_rows][:, None]
+            dd = jnp.sum(dog * og, axis=-1, keepdims=True)
+            s_l = jax.lax.dot_general(
+                qg, klg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s_l = jnp.where(mask, s_l, NEG_INF)
+            p_l = jnp.exp(s_l - lse)
+            dp_l = jax.lax.dot_general(
+                dog.astype(vlg.dtype), vlg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds_l = p_l * (dp_l - dd)
+            dq_g = jax.lax.dot_general(
+                ds_l.astype(klg.dtype), klg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_prefix:
+                dq_g = dq_g + dq_pfx[lo:lo + block_rows]
+            dkl_g = jax.lax.dot_general(
+                ds_l.astype(qg.dtype), qg, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dvl_g = jax.lax.dot_general(
+                p_l.astype(dog.dtype), dog, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dq_ref[0, hh, lo:lo + block_rows, :] = \
+                (dq_g * scale).astype(dq_ref.dtype)
+            dkl_ref[0, hh, lo:lo + block_rows, :] = \
+                (dkl_g * scale).astype(dkl_ref.dtype)
+            dvl_ref[0, hh, lo:lo + block_rows, :] = \
+                dvl_g.astype(dvl_ref.dtype)
 
 
 def _bhtd(x, grid_side=0, transpose=False):
@@ -192,10 +210,17 @@ def _block_rows(t: int, n: int) -> int:
     return n * lines_per_block
 
 
-def _specs(b, t, h, d):
+def _heads_per_step(h: int) -> int:
+    """Heads packed per grid step (PERF.md headroom #2): halves the grid's
+    per-step pipeline overhead. VMEM per step stays far under budget (~1.2
+    MB fwd at the flagship shape), so 2 whenever the head count allows."""
+    return 2 if h % 2 == 0 else 1
+
+
+def _specs(b, t, h, d, hps):
     # operands arrive as (B, H, T, D): TPU requires the last two block dims
     # to be tiling-clean, so the heads axis must not sit second-to-last
-    blk = pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0))
+    blk = pl.BlockSpec((1, hps, t, d), lambda i, j: (i, j, 0, 0))
     return blk
 
 
@@ -205,24 +230,25 @@ def _line_attention_fwd(q, kl, vl, kp, vp, *, n, grid_side, transpose,
     block_rows = _block_rows(t, n)
     scale = d ** -0.5
     has_prefix = kp is not None
+    hps = _heads_per_step(h)
     kernel = functools.partial(
         _fwd_kernel if has_prefix else _fwd_nopfx_kernel,
-        scale=scale, n=n, block_rows=block_rows)
-    line_spec = _specs(b, t, h, d)
+        scale=scale, n=n, block_rows=block_rows, hps=hps)
+    line_spec = _specs(b, t, h, d, hps)
     in_specs = [line_spec, line_spec, line_spec]
     args = [_bhtd(q, grid_side, transpose), _bhtd(kl, grid_side, transpose),
             _bhtd(vl, grid_side, transpose)]
     if has_prefix:
         s = kp.shape[2]
-        pfx_spec = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+        pfx_spec = pl.BlockSpec((1, hps, s, d), lambda i, j: (i, j, 0, 0))
         in_specs += [pfx_spec, pfx_spec]
         args += [_bhtd(kp), _bhtd(vp)]
     out, stats = pl.pallas_call(
         kernel,
-        grid=(b, h),
+        grid=(b, h // hps),
         in_specs=in_specs,
         out_specs=[line_spec,
-                   pl.BlockSpec((1, 1, 1, t), lambda i, j: (i, j, 0, 0))],
+                   pl.BlockSpec((1, hps, 1, t), lambda i, j: (i, j, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
                    jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32)],
         interpret=interpret,
@@ -236,11 +262,12 @@ def _line_attention_bwd(q, kl, vl, kp, vp, stats, out, dout, *, n, grid_side,
     block_rows = _block_rows(t, n)
     scale = d ** -0.5
     has_prefix = kp is not None
+    hps = _heads_per_step(h)
     kernel = functools.partial(
         _bwd_kernel if has_prefix else _bwd_nopfx_kernel,
-        scale=scale, n=n, block_rows=block_rows)
-    line_spec = _specs(b, t, h, d)
-    stats_spec = pl.BlockSpec((1, 1, 1, t), lambda i, j: (i, j, 0, 0))
+        scale=scale, n=n, block_rows=block_rows, hps=hps)
+    line_spec = _specs(b, t, h, d, hps)
+    stats_spec = pl.BlockSpec((1, hps, 1, t), lambda i, j: (i, j, 0, 0))
     in_specs = [line_spec, line_spec, line_spec]
     args = [_bhtd(q, grid_side, transpose), _bhtd(kl, grid_side, transpose),
             _bhtd(vl, grid_side, transpose)]
@@ -248,7 +275,7 @@ def _line_attention_bwd(q, kl, vl, kp, vp, stats, out, dout, *, n, grid_side,
     out_shape = [jax.ShapeDtypeStruct((b, h, t, d), q.dtype)] * 3
     if has_prefix:
         s = kp.shape[2]
-        pfx_spec = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+        pfx_spec = pl.BlockSpec((1, hps, s, d), lambda i, j: (i, j, 0, 0))
         in_specs += [pfx_spec, pfx_spec]
         args += [_bhtd(kp), _bhtd(vp)]
         out_specs += [pfx_spec, pfx_spec]
@@ -258,7 +285,7 @@ def _line_attention_bwd(q, kl, vl, kp, vp, stats, out, dout, *, n, grid_side,
              _bhtd(dout, grid_side, transpose)]
     results = pl.pallas_call(
         kernel,
-        grid=(b, h),
+        grid=(b, h // hps),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -312,6 +339,15 @@ def _vjp_fwd(q, kl, vl, kp, vp, n, grid_side, transpose, interpret=False):
                                      grid_side=grid_side,
                                      transpose=transpose,
                                      interpret=interpret)
+    # Name the residuals the backward pass needs so a remat save-policy
+    # (config.remat_policy "save_ctx"/"save_attn") can keep them: without
+    # this, rematerialisation replays the forward Pallas kernel a second
+    # time in backward just to regenerate ``stats``/``out``. The names must
+    # be applied to the residual tracers themselves (naming the custom_vjp
+    # *output* downstream would leave the pre-name residual unsaved and the
+    # kernel re-run alive).
+    stats = checkpoint_name(stats, "attn_stats")
+    out = checkpoint_name(out, "attn_out")
     return out, (q, kl, vl, kp, vp, stats, out)
 
 
@@ -324,3 +360,259 @@ def _vjp_bwd(n, grid_side, transpose, interpret, res, dout):
 
 
 line_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Window attention: conv_like and full layers
+# ---------------------------------------------------------------------------
+#
+# The remaining zoo members (reference task.py:63-64: 'conv_like' — a k x k
+# raster window preceding the query — and plain-causal 'full') previously
+# lowered to the dense masked XLA path, materializing (B, H, T, T) f32
+# scores in HBM. Here image queries are processed in groups of ``gs`` rows;
+# each group's keys are the CONTIGUOUS raster slice covering every query's
+# window (conv_like: the group's raster lines +/- half the kernel; full:
+# everything up to the group's end), masked exactly. Scores live in VMEM
+# only; backward accumulates dk/dv across overlapping groups in VMEM
+# scratch.
+
+def _group_rows(t: int) -> int:
+    gs = min(128, t)
+    while t % gs:
+        gs -= 1
+    return gs
+
+
+def _win_bounds(g: int, gs: int, grid: int, hw, t: int):
+    """Static key-slice bounds [lo, hi) for query group ``g``."""
+    if hw is None:
+        return 0, min(t, (g + 1) * gs)
+    first_line = (g * gs) // grid
+    last_line = (g * gs + gs - 1) // grid
+    n_lines = t // grid
+    lo = max(0, first_line - hw) * grid
+    hi = (min(n_lines - 1, last_line + hw) + 1) * grid
+    return lo, hi
+
+
+def _win_mask(lo_q: int, rows: int, lo_k: int, cols: int, grid: int, hw):
+    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) + lo_q
+    ki = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) + lo_k
+    m = ki <= qi
+    if hw is not None:
+        qr, qc = qi // grid, qi % grid
+        kr, kc = ki // grid, ki % grid
+        m &= (jnp.abs(kr - qr) <= hw) & (jnp.abs(kc - qc) <= hw)
+    return m
+
+
+def _win_fwd_kernel(q_ref, k_ref, v_ref, kp_ref, vp_ref, out_ref, stats_ref,
+                    *, scale: float, grid: int, hw, gs: int, hps: int):
+    t = q_ref.shape[2]
+    has_prefix = kp_ref is not None
+    for hh in range(hps):
+        if has_prefix:
+            vp = vp_ref[0, hh, :, :]
+            s_p_all, m_p_all = _prefix_scores(
+                q_ref[0, hh, :, :], kp_ref[0, hh, :, :], scale)
+
+        for g in range(t // gs):
+            lo_q = g * gs
+            lo_k, hi_k = _win_bounds(g, gs, grid, hw, t)
+            qg = q_ref[0, hh, lo_q:lo_q + gs, :]
+            kg = k_ref[0, hh, lo_k:hi_k, :]
+            vg = v_ref[0, hh, lo_k:hi_k, :]
+            s = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(_win_mask(lo_q, gs, lo_k, hi_k - lo_k, grid, hw),
+                          s, NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            if has_prefix:
+                m = jnp.maximum(m, m_p_all[lo_q:lo_q + gs])
+            e = jnp.exp(s - m)
+            denom = jnp.sum(e, axis=-1, keepdims=True)
+            o = jax.lax.dot_general(
+                e.astype(vg.dtype), vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_prefix:
+                e_p = jnp.exp(s_p_all[lo_q:lo_q + gs] - m)
+                denom = denom + jnp.sum(e_p, axis=-1, keepdims=True)
+                o = o + jax.lax.dot_general(
+                    e_p.astype(vp.dtype), vp, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            out_ref[0, hh, lo_q:lo_q + gs, :] = (o / denom).astype(
+                out_ref.dtype)
+            stats_ref[0, hh, 0, lo_q:lo_q + gs] = (m + jnp.log(denom))[:, 0]
+
+
+def _win_bwd_kernel(q_ref, k_ref, v_ref, kp_ref, vp_ref, stats_ref, o_ref,
+                    do_ref, dq_ref, dk_ref, dv_ref, dkp_ref, dvp_ref,
+                    dk_acc, dv_acc,
+                    *, scale: float, grid: int, hw, gs: int, hps: int):
+    t = q_ref.shape[2]
+    has_prefix = kp_ref is not None
+    for hh in range(hps):
+        # dk/dv accumulate across overlapping query groups in f32 scratch
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+        if has_prefix:
+            # whole-tile prefix grads; only the window blocks loop
+            dq_pfx, dkp, dvp = _prefix_grads(
+                q_ref[0, hh, :, :], kp_ref[0, hh, :, :], vp_ref[0, hh, :, :],
+                o_ref[0, hh, :, :].astype(jnp.float32),
+                do_ref[0, hh, :, :].astype(jnp.float32),
+                stats_ref[0, hh, 0, :][:, None], scale)
+            dkp_ref[0, hh, :, :] = dkp.astype(dkp_ref.dtype)
+            dvp_ref[0, hh, :, :] = dvp.astype(dvp_ref.dtype)
+
+        for g in range(t // gs):
+            lo_q = g * gs
+            lo_k, hi_k = _win_bounds(g, gs, grid, hw, t)
+            qg = q_ref[0, hh, lo_q:lo_q + gs, :]
+            kg = k_ref[0, hh, lo_k:hi_k, :]
+            vg = v_ref[0, hh, lo_k:hi_k, :]
+            og = o_ref[0, hh, lo_q:lo_q + gs, :].astype(jnp.float32)
+            dog = do_ref[0, hh, lo_q:lo_q + gs, :].astype(jnp.float32)
+            lse = stats_ref[0, hh, 0, lo_q:lo_q + gs][:, None]
+            dd = jnp.sum(dog * og, axis=-1, keepdims=True)
+            s = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(_win_mask(lo_q, gs, lo_k, hi_k - lo_k, grid, hw),
+                          s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                dog.astype(vg.dtype), vg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - dd)
+            dq_g = jax.lax.dot_general(
+                ds.astype(kg.dtype), kg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if has_prefix:
+                dq_g = dq_g + dq_pfx[lo_q:lo_q + gs]
+            dq_ref[0, hh, lo_q:lo_q + gs, :] = \
+                (dq_g * scale).astype(dq_ref.dtype)
+            dk_acc[lo_k:hi_k, :] += jax.lax.dot_general(
+                ds.astype(qg.dtype), qg, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            dv_acc[lo_k:hi_k, :] += jax.lax.dot_general(
+                p.astype(dog.dtype), dog, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dk_ref[0, hh, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, hh, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _win_fwd_nopfx_kernel(q_ref, k_ref, v_ref, out_ref, stats_ref, **kw):
+    _win_fwd_kernel(q_ref, k_ref, v_ref, None, None, out_ref, stats_ref,
+                    **kw)
+
+
+def _win_bwd_nopfx_kernel(q_ref, k_ref, v_ref, stats_ref, o_ref, do_ref,
+                          dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, **kw):
+    _win_bwd_kernel(q_ref, k_ref, v_ref, None, None, stats_ref, o_ref,
+                    do_ref, dq_ref, dk_ref, dv_ref, None, None,
+                    dk_acc, dv_acc, **kw)
+
+
+def _window_attention_fwd(q, k, v, kp, vp, *, grid, hw, interpret):
+    b, h, t, d = q.shape
+    gs = _group_rows(t)
+    scale = d ** -0.5
+    has_prefix = kp is not None
+    hps = _heads_per_step(h)
+    kernel = functools.partial(
+        _win_fwd_kernel if has_prefix else _win_fwd_nopfx_kernel,
+        scale=scale, grid=grid, hw=hw, gs=gs, hps=hps)
+    spec = _specs(b, t, h, d, hps)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if has_prefix:
+        s = kp.shape[2]
+        pfx_spec = pl.BlockSpec((1, hps, s, d), lambda i, j: (i, j, 0, 0))
+        in_specs += [pfx_spec, pfx_spec]
+        args += [kp, vp]
+    out, stats = pl.pallas_call(
+        kernel,
+        grid=(b, h // hps),
+        in_specs=in_specs,
+        out_specs=[spec,
+                   pl.BlockSpec((1, hps, 1, t), lambda i, j: (i, j, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return out, stats
+
+
+def _window_attention_bwd(q, k, v, kp, vp, stats, out, dout, *, grid, hw,
+                          interpret):
+    b, h, t, d = q.shape
+    gs = _group_rows(t)
+    scale = d ** -0.5
+    has_prefix = kp is not None
+    hps = _heads_per_step(h)
+    kernel = functools.partial(
+        _win_bwd_kernel if has_prefix else _win_bwd_nopfx_kernel,
+        scale=scale, grid=grid, hw=hw, gs=gs, hps=hps)
+    spec = _specs(b, t, h, d, hps)
+    stats_spec = pl.BlockSpec((1, hps, 1, t), lambda i, j: (i, j, 0, 0))
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    out_specs = [spec, spec, spec]
+    out_shape = [jax.ShapeDtypeStruct((b, h, t, d), q.dtype)] * 3
+    if has_prefix:
+        s = kp.shape[2]
+        pfx_spec = pl.BlockSpec((1, hps, s, d), lambda i, j: (i, j, 0, 0))
+        in_specs += [pfx_spec, pfx_spec]
+        args += [kp, vp]
+        out_specs += [pfx_spec, pfx_spec]
+        out_shape += [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 2
+    in_specs += [stats_spec, spec, spec]
+    args += [stats, out, dout]
+    results = pl.pallas_call(
+        kernel,
+        grid=(b, h // hps),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
+                        pltpu.VMEM((t, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    if has_prefix:
+        return tuple(results)
+    return tuple(results) + (None, None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def window_attention(q, k, v, kp, vp, grid: int, hw,
+                     interpret: bool = False):
+    """Fused [prefix || raster-window causal] attention.
+
+    q/k/v: (B, H, T, D) image tokens in raster order (T = grid^2);
+    kp/vp: optional (B, H, S, D) text prefix every query attends to.
+    ``hw`` = half the conv_like kernel (reference conv window, task.py:63);
+    ``hw=None`` = plain causal ('full'). Returns (B, H, T, D).
+    """
+    out, _ = _window_attention_fwd(q, k, v, kp, vp, grid=grid, hw=hw,
+                                   interpret=interpret)
+    return out
+
+
+def _win_vjp_fwd(q, k, v, kp, vp, grid, hw, interpret=False):
+    out, stats = _window_attention_fwd(q, k, v, kp, vp, grid=grid, hw=hw,
+                                       interpret=interpret)
+    # named so remat policies can save them (see _vjp_fwd above)
+    stats = checkpoint_name(stats, "attn_stats")
+    out = checkpoint_name(out, "attn_out")
+    return out, (q, k, v, kp, vp, stats, out)
+
+
+def _win_vjp_bwd(grid, hw, interpret, res, dout):
+    q, k, v, kp, vp, stats, out = res
+    return _window_attention_bwd(q, k, v, kp, vp, stats, out, dout,
+                                 grid=grid, hw=hw, interpret=interpret)
+
+
+window_attention.defvjp(_win_vjp_fwd, _win_vjp_bwd)

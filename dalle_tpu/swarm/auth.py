@@ -184,6 +184,15 @@ class ExperimentAuthorizer(TokenAuthorizerBase):
         token = AccessToken.from_bytes(Path(self.token_path).read_bytes())
         if token is None:
             raise RuntimeError(f"unreadable access token {self.token_path}")
+        if token.expiration_time < get_dht_time():
+            # Without this check the peer would announce an expired token
+            # forever: every honest peer silently drops its announces and it
+            # trains solo with no diagnostic (the file never refreshes
+            # itself — a human must re-issue it).
+            raise RuntimeError(
+                f"access token {self.token_path} expired at "
+                f"{token.expiration_time:.0f} (now {get_dht_time():.0f}); "
+                "re-issue with `python -m dalle_tpu.cli.issue_token`")
         return token
 
     def validate_token(self, token: AccessToken,

@@ -126,7 +126,9 @@ class CollaborativeOptimizer:
             # rank-r low-rank factor exchange (swarm/powersgd.py); the
             # factors themselves ride the wire as fp16
             from dalle_tpu.swarm.powersgd import PowerSGDCompressor
-            self._powersgd = PowerSGDCompressor(cfg.powersgd_rank)
+            self._powersgd = PowerSGDCompressor(
+                cfg.powersgd_rank,
+                host_orthogonalize=cfg.powersgd_host_orthogonalize)
             self._grad_codec = compression.FLOAT16
         else:
             self._powersgd = None
@@ -234,8 +236,15 @@ class CollaborativeOptimizer:
             return
 
         weight = float(max(self.local_samples, 1))
-        grads_host = [np.asarray(g) / weight for g in
-                      jax.tree_util.tree_leaves(self._grad_acc)]
+        if self._powersgd is not None:
+            # device-side PowerSGD: the accumulated grads stay on device —
+            # phase1 projects them there and only rank-r factors (plus the
+            # small unplanned tail) are pulled for the wire
+            grads_local: List[Any] = [
+                g / weight for g in jax.tree_util.tree_leaves(self._grad_acc)]
+        else:
+            grads_local = [np.asarray(g) / weight for g in
+                           jax.tree_util.tree_leaves(self._grad_acc)]
         t_pull = time.monotonic()
 
         group = make_group(
@@ -277,19 +286,19 @@ class CollaborativeOptimizer:
                 # an IncompleteRound raised by reduce_fn is handled inside:
                 # the round is abandoned and local gradients come back
                 averaged = average_with_powersgd(
-                    self._powersgd, grads_host, reduce_fn,
+                    self._powersgd, grads_local, reduce_fn,
                     epoch=self.local_epoch)
             else:
                 averaged = run_allreduce(
                     self.dht, group, f"{self.cfg.run_id}_grads",
-                    self.local_epoch, grads_host, weight=weight,
+                    self.local_epoch, grads_local, weight=weight,
                     allreduce_timeout=budget, codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
-            averaged = grads_host  # alone this epoch
+            averaged = grads_local  # alone this epoch
         # deliver the averaged gradients to this slice's followers (no-op
         # in single-process runs)
-        averaged = broadcast_arrays(averaged, like=grads_host)
+        averaged = broadcast_arrays(averaged, like=grads_local)
         t_reduce = time.monotonic()
 
         self._apply_averaged(treedef, averaged)

@@ -1,4 +1,4 @@
-"""PowerSGD gradient compression for swarm averaging.
+"""PowerSGD gradient compression for swarm averaging — device-side math.
 
 Low-rank gradient compression (Vogels et al., NeurIPS 2019) as an alternate
 ``grad_compression`` mode. The reference's hivemind fork carries PowerSGD
@@ -9,13 +9,27 @@ this build); the dalle app itself ships with size-adaptive fp16/8-bit.
 Algorithm, per 2D-reshapable gradient M (m x n), rank r:
 
 1. error feedback: ``M += e`` (the residual from last round);
-2. ``P = M @ Q`` with the warm-started projection Q (n x r);
+2. ``P = M @ Q`` with the epoch-seeded projection Q (n x r);
 3. **average P across the group** (the existing butterfly all-reduce);
-4. orthogonalize the averaged P (Gram-Schmidt / reduced QR) — every peer
-   runs the same deterministic step on the same averaged bytes, so all
-   peers hold the identical orthonormal basis;
+4. orthogonalize the averaged P (modified Gram-Schmidt) — every peer runs
+   the same deterministic step on the same averaged bytes, so all peers
+   hold the identical orthonormal basis;
 5. ``Q = M^T @ P_orth`` and **average Q across the group**;
 6. reconstruct ``G = P_orth @ Q^T``; store ``e = M - G`` locally.
+
+**Where the work happens.** All O(m*n*r) math — the P/Q projections, the
+reconstruction, and the error-feedback update — runs as jitted device ops
+(the BASELINE.json north star names PowerSGD "reimplemented as XLA/Pallas
+kernels"); the error-feedback and M caches are device arrays, not host
+RAM. Only the rank-r factors (r*(m+n) floats per tensor, ~128x smaller
+than the gradients at the flagship's 1024x4096 blocks) cross to the host
+for the wire. Gram-Schmidt itself runs on device too (unrolled over the r
+columns); it is deterministic for identical input bytes on a given XLA
+backend, which is what cross-peer basis agreement needs — the butterfly's
+owner path makes the averaged-P bytes byte-identical across survivors
+(swarm/allreduce.py), and swarm peers run the same backend build. For a
+deliberately heterogeneous swarm, ``host_orthogonalize=True`` runs MGS on
+the host in plain IEEE f32 loop order instead.
 
 Cross-peer correctness hinges on every peer holding the identical Q basis
 in phase 2 and the identical averaged-P bytes in phase 4. Two design
@@ -47,8 +61,10 @@ error re-enters via feedback next round.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 #: tensors compress only if rank-r factors are at most this fraction of
@@ -82,8 +98,9 @@ def _as_matrix(shape: Sequence[int]) -> Tuple[int, int]:
 
 
 def orthogonalize(p: np.ndarray, eps: float = 1e-8) -> np.ndarray:
-    """Orthonormalize columns via modified Gram-Schmidt (deterministic,
-    identical on every peer for identical input bytes)."""
+    """Host-side modified Gram-Schmidt: plain IEEE f32 loop order,
+    bit-identical across x86 peers for identical input bytes. Used for
+    the epoch-seeded Q init and the ``host_orthogonalize`` mode."""
     p = np.array(p, np.float32, copy=True)
     for i in range(p.shape[1]):
         col = p[:, i]
@@ -94,26 +111,71 @@ def orthogonalize(p: np.ndarray, eps: float = 1e-8) -> np.ndarray:
     return p
 
 
+def _orthogonalize_dev(p: jax.Array, eps: float = 1e-8) -> jax.Array:
+    """Device MGS, unrolled over the (tiny, static) rank columns."""
+    cols: List[jax.Array] = []
+    for i in range(p.shape[1]):
+        c = p[:, i]
+        for q in cols:
+            c = c - jnp.dot(c, q) * q
+        cols.append(c / (jnp.linalg.norm(c) + eps))
+    return jnp.stack(cols, axis=1)
+
+
+# The three device phases. Lists of arrays are pytrees, so one jitted
+# program covers the whole planned gradient set; XLA fuses the per-tensor
+# error add into the projection matmul.
+
+@jax.jit
+def _dev_phase1(mats, errs, qs):
+    mats_e = [m.astype(jnp.float32) + e for m, e in zip(mats, errs)]
+    ps = [me @ q for me, q in zip(mats_e, qs)]
+    return mats_e, ps
+
+
+@jax.jit
+def _dev_phase2(mats_e, p_avgs):
+    p_orths = [_orthogonalize_dev(p) for p in p_avgs]
+    qs = [me.T @ po for me, po in zip(mats_e, p_orths)]
+    return p_orths, qs
+
+
+@jax.jit
+def _dev_phase2_preorth(mats_e, p_orths):
+    return [me.T @ po for me, po in zip(mats_e, p_orths)]
+
+
+@jax.jit
+def _dev_reconstruct(mats_e, p_orths, q_avgs):
+    approx = [po @ qa.T for po, qa in zip(p_orths, q_avgs)]
+    errs = [me - ap for me, ap in zip(mats_e, approx)]
+    return approx, errs
+
+
 class PowerSGDCompressor:
-    """Per-peer PowerSGD state: warm-started Qs + local error feedback.
+    """Per-peer PowerSGD state: device-resident error feedback + the
+    in-flight M caches. Qs are epoch-seeded, NOT warm-started (see the
+    module docstring's elasticity argument), so there is no cross-epoch
+    basis state to keep.
 
     One instance per CollaborativeOptimizer; its lifetime spans epochs so
-    warm starts and error feedback accumulate.
+    error feedback accumulates.
     """
 
     def __init__(self, rank: int = 4, seed: int = 0,
-                 min_ratio: float = MIN_COMPRESSION_RATIO):
+                 min_ratio: float = MIN_COMPRESSION_RATIO,
+                 host_orthogonalize: bool = False):
         self.rank = rank
         self.seed = seed
         self.min_ratio = min_ratio
-        self._qs: Dict[int, np.ndarray] = {}
-        self._errors: Dict[int, np.ndarray] = {}
-        self._mat_cache: Dict[int, np.ndarray] = {}
-        self._p_orth: Dict[int, np.ndarray] = {}
+        self.host_orthogonalize = host_orthogonalize
+        self._errors: Dict[int, jax.Array] = {}
+        self._mat_cache: Dict[int, jax.Array] = {}
+        self._p_orth: Dict[int, jax.Array] = {}
 
     # -- planning ---------------------------------------------------------
 
-    def plan(self, leaves: Sequence[np.ndarray]) -> List[_TensorPlan]:
+    def plan(self, leaves: Sequence[Any]) -> List[_TensorPlan]:
         plans = []
         for i, leaf in enumerate(leaves):
             if leaf.ndim < 2:
@@ -127,62 +189,72 @@ class PowerSGDCompressor:
         return plans
 
     def _q_for(self, plan: _TensorPlan, epoch: int) -> np.ndarray:
-        key = (plan.index, epoch)
-        q = self._qs.get(key)
-        if q is None:
-            # seeded by (seed, tensor index, epoch) ONLY — every peer,
-            # including one that just joined, derives the identical Q
-            rng = np.random.RandomState(
-                (self.seed * 1_000_003 + plan.index * 7919 + epoch)
-                % (2 ** 31 - 1))
-            q = orthogonalize(
-                rng.randn(plan.n, self.rank).astype(np.float32))
-            self._qs = {key: q}  # keep only the current epoch's bases
-        return q
+        # seeded by (seed, tensor index, epoch) ONLY — every peer,
+        # including one that just joined, derives the identical Q
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + plan.index * 7919 + epoch)
+            % (2 ** 31 - 1))
+        return orthogonalize(
+            rng.randn(plan.n, self.rank).astype(np.float32))
 
     # -- the two communication phases ------------------------------------
 
-    def phase1_ps(self, leaves: Sequence[np.ndarray],
+    def phase1_ps(self, leaves: Sequence[Any],
                   plans: List[_TensorPlan],
                   epoch: int = 0) -> List[np.ndarray]:
-        """Error-compensated P factors to be averaged across the group."""
-        ps = []
-        for plan in plans:
-            mat = np.asarray(leaves[plan.index], np.float32).reshape(
-                plan.m, plan.n)
-            err = self._errors.get(plan.index)
-            if err is not None and err.shape == mat.shape:
-                mat = mat + err
-            self._mat_cache[plan.index] = mat
-            ps.append(mat @ self._q_for(plan, epoch))
-        return ps
+        """Error-compensated P factors to be averaged across the group.
+        Projections run jitted on device; only the (m x r) factors are
+        pulled to the host for the wire."""
+        mats = [jnp.asarray(leaves[p.index]).reshape(p.m, p.n)
+                for p in plans]
+        errs = []
+        for p, mat in zip(plans, mats):
+            e = self._errors.get(p.index)
+            if e is None or e.shape != (p.m, p.n):
+                e = jnp.zeros((p.m, p.n), jnp.float32)
+            errs.append(e)
+        qs = [jnp.asarray(self._q_for(p, epoch)) for p in plans]
+        mats_e, ps = _dev_phase1(mats, errs, qs)
+        for p, me in zip(plans, mats_e):
+            self._mat_cache[p.index] = me
+        return [np.asarray(x) for x in ps]
 
     def phase2_qs(self, plans: List[_TensorPlan],
                   averaged_ps: List[np.ndarray]) -> List[np.ndarray]:
         """Orthogonalize averaged Ps, compute the Q factors to average."""
-        qs = []
         self._p_orth = {}
-        for plan, p_avg in zip(plans, averaged_ps):
-            p_orth = orthogonalize(p_avg.reshape(plan.m, self.rank))
-            self._p_orth[plan.index] = p_orth
-            mat = self._mat_cache[plan.index]
-            qs.append(mat.T @ p_orth)
-        return qs
+        mats_e = [self._mat_cache[p.index] for p in plans]
+        host_ps = [np.asarray(pa, np.float32).reshape(p.m, self.rank)
+                   for p, pa in zip(plans, averaged_ps)]
+        if self.host_orthogonalize:
+            # MGS on the wire's host bytes directly — one upload of the
+            # orthonormal basis, no device round-trip
+            p_orths = [jnp.asarray(orthogonalize(pa)) for pa in host_ps]
+            qs = _dev_phase2_preorth(mats_e, p_orths)
+        else:
+            p_orths, qs = _dev_phase2(mats_e,
+                                      [jnp.asarray(pa) for pa in host_ps])
+        for p, po in zip(plans, p_orths):
+            self._p_orth[p.index] = po
+        return [np.asarray(q) for q in qs]
 
-    def reconstruct(self, leaves: List[np.ndarray],
+    def reconstruct(self, leaves: List[Any],
                     plans: List[_TensorPlan],
-                    averaged_qs: List[np.ndarray]) -> List[np.ndarray]:
+                    averaged_qs: List[np.ndarray]) -> List[Any]:
         """Replace planned leaves with the rank-r group average and update
-        error feedback. (Q is NOT warm-started from the average — see the
-        module docstring's elasticity argument.)"""
+        the (device-resident) error feedback. Planned outputs are device
+        arrays — in the single-process trainer they flow straight into the
+        jitted optimizer apply with no host round-trip."""
         out = list(leaves)
-        for plan, q_avg in zip(plans, averaged_qs):
-            q_avg = q_avg.reshape(plan.n, self.rank)
-            p_orth = self._p_orth[plan.index]
-            approx = p_orth @ q_avg.T
-            mat = self._mat_cache.pop(plan.index)
-            self._errors[plan.index] = mat - approx
-            out[plan.index] = approx.reshape(plan.shape)
+        mats_e = [self._mat_cache[p.index] for p in plans]
+        p_orths = [self._p_orth[p.index] for p in plans]
+        q_avgs = [jnp.asarray(np.asarray(qa, np.float32).reshape(
+            p.n, self.rank)) for p, qa in zip(plans, averaged_qs)]
+        approx, errs = _dev_reconstruct(mats_e, p_orths, q_avgs)
+        for p, ap, e in zip(plans, approx, errs):
+            self._errors[p.index] = e
+            out[p.index] = ap.reshape(p.shape)
+            self._mat_cache.pop(p.index, None)
         self._p_orth = {}
         return out
 
@@ -197,12 +269,14 @@ class PowerSGDCompressor:
 
 def average_with_powersgd(
         compressor: PowerSGDCompressor,
-        leaves: Sequence[np.ndarray],
+        leaves: Sequence[Any],
         reduce_fn,
         epoch: int = 0,
-) -> List[np.ndarray]:
+) -> List[Any]:
     """Run the full PowerSGD exchange.
 
+    ``leaves`` may be jax device arrays (the trainer's accumulated grads —
+    no host pull happens for the planned tensors) or numpy arrays.
     ``reduce_fn(tensors: List[np.ndarray], phase: str) -> List[np.ndarray]``
     performs the group averaging for one phase ("p" or "q") — in
     production the butterfly all-reduce (swarm/allreduce.py) with the phase
@@ -212,10 +286,11 @@ def average_with_powersgd(
     the caller then keeps its exact local gradients for the epoch.
 
     Small/1D tensors that the plan skips are averaged exactly in their own
-    round, so the result is: rank-r approximate mean for big matrices,
-    exact mean for everything else.
+    round, so the result is: rank-r approximate mean for big matrices
+    (returned as device arrays), exact mean for everything else (returned
+    as the numpy arrays the wire produced).
     """
-    leaves = [np.asarray(x, np.float32) for x in leaves]
+    leaves = list(leaves)
     plans = compressor.plan(leaves)
     planned = {p.index for p in plans}
 
@@ -223,11 +298,13 @@ def average_with_powersgd(
         ps = compressor.phase1_ps(leaves, plans, epoch)
         averaged_ps = reduce_fn(ps, "p") if ps else []
         qs = compressor.phase2_qs(plans, averaged_ps)
-        raw = [leaves[i] for i in range(len(leaves)) if i not in planned]
+        raw = [np.asarray(leaves[i], np.float32)
+               for i in range(len(leaves)) if i not in planned]
         averaged_tail = reduce_fn(qs + raw, "q") if (qs or raw) else []
     except IncompleteRound:
         compressor.abandon_round()
-        return [x.copy() for x in leaves]
+        return [jnp.asarray(x, jnp.float32) if not isinstance(x, np.ndarray)
+                else np.array(x, np.float32) for x in leaves]
     averaged_qs = averaged_tail[:len(qs)]
     averaged_raw = averaged_tail[len(qs):]
 
@@ -235,5 +312,6 @@ def average_with_powersgd(
     it = iter(averaged_raw)
     for i in range(len(out)):
         if i not in planned:
-            out[i] = next(it).reshape(leaves[i].shape)
+            out[i] = np.asarray(next(it)).reshape(
+                np.asarray(leaves[i]).shape)
     return out

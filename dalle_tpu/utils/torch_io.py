@@ -1,0 +1,24 @@
+"""Host-side torch checkpoint deserialization (shared by the VQGAN and
+CLIP weight mappers — torch is only ever a pickle reader here; all compute
+stays in JAX)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def torch_load_trusted(path: str) -> Any:
+    """``torch.load`` preferring the safe tensor-only loader.
+
+    Falls back to the permissive pickle path only when the safe loader
+    rejects the archive (some published VQGAN/CLIP checkpoints carry
+    non-tensor pickles, e.g. pytorch-lightning wrappers). The permissive
+    path executes arbitrary pickled code: only call this on checkpoint
+    files you trust.
+    """
+    import torch
+
+    try:
+        return torch.load(path, map_location="cpu", weights_only=True)
+    except Exception:
+        return torch.load(path, map_location="cpu", weights_only=False)
