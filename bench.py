@@ -124,8 +124,11 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 - re-raised unless OOM
                 if not _is_oom(e):
                     raise
-                print(f"# micro {micro} {overrides} OOM: "
-                      f"{type(e).__name__}", file=sys.stderr)
+                # full first line of the error so a genuine compile bug
+                # misclassified as OOM is still visible in driver logs
+                print(f"# micro {micro} {overrides} walked down: "
+                      f"{type(e).__name__}: "
+                      f"{str(e).splitlines()[0][:300]}", file=sys.stderr)
     if result is None:
         # Tiny-model numbers are not comparable to the 1.3B baseline:
         # report them honestly with vs_baseline 0.

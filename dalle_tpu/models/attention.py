@@ -35,6 +35,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from dalle_tpu.config import (
     ATTN_AXIAL_COL,
@@ -175,7 +176,10 @@ def dense_zoo_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         conv_kernel: int = 11) -> jax.Array:
     mask = jnp.asarray(zoo_attention_mask(attn_type, text_len, grid,
                                           conv_kernel))
-    return dense_attention(q, k, v, mask)
+    # named so the save_ctx/save_attn remat policies can keep the dense
+    # path's attention output (the Pallas kernels name their own outputs
+    # "attn_out"/"attn_stats" instead — each layer emits exactly one set)
+    return checkpoint_name(dense_attention(q, k, v, mask), "attn_ctx")
 
 
 # ---------------------------------------------------------------------------
@@ -282,7 +286,9 @@ def axial_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if attn_type == ATTN_AXIAL_COL:
         out_g = out_g.swapaxes(1, 2)
     out_i = out_g.reshape(b, grid * grid, h, d)
-    return jnp.concatenate([out_t, out_i], axis=1)
+    # named for the save policies (see dense_zoo_attention)
+    return checkpoint_name(jnp.concatenate([out_t, out_i], axis=1),
+                           "attn_ctx")
 
 
 def _window_fits_vmem(qshape, text_len: int, grid: int,
@@ -295,9 +301,11 @@ def _window_fits_vmem(qshape, text_len: int, grid: int,
     that exceeds the ~16 MB VMEM budget and the dense XLA path — or, for
     long contexts, ring/Ulysses sequence parallelism — is the right
     lowering."""
+    from dalle_tpu.ops.pallas.attention_kernels import _heads_per_step
+
     _, t, h, d = qshape
     img = grid * grid
-    hps = 2 if h % 2 == 0 else 1
+    hps = _heads_per_step(h)
     per_step = (11 * hps * img * d + 2 * text_len * d * hps) * 2 \
         + 2 * img * d * 4  # bf16 refs + f32 scratch
     return per_step <= budget_bytes

@@ -92,7 +92,11 @@ class ZooAttention(nn.Module):
             out = zoo_attention(
                 q, k, v, attn_type=self.attn_type, text_len=cfg.text_seq_len,
                 grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
-        out = checkpoint_name(out, "attn_ctx")
+        # (the attention output is named for the remat save-policies at
+        # its source: "attn_out"/"attn_stats" inside the Pallas kernels'
+        # custom_vjp fwd rules, "attn_ctx" on the dense/axial XLA paths —
+        # exactly one set per layer. Ring-SP layers are unnamed: their
+        # shard_map internals are not policy-saveable.)
         out = out.reshape(b, t, cfg.dim)
         return nn.Dense(cfg.dim, dtype=_dtype(cfg),
                         param_dtype=_param_dtype(cfg), name="out")(out)
@@ -208,18 +212,15 @@ class Transformer(nn.Module):
 
         block_cls = TransformerBlock
         if cfg.remat:
-            # The names to save depend on which attention lowering runs:
-            # the Pallas kernels name their own outputs ("attn_out" +
-            # "attn_stats") inside their custom_vjp fwd rules so backward
-            # never re-runs the forward kernel; the dense XLA path has no
-            # kernel stats — there "attn_ctx" (the zoo output, named in
-            # ZooAttention) is the value whose saving prunes the attention
-            # recompute. Saving BOTH on the Pallas path would store the
-            # attention output twice (attn_ctx is the concat of the saved
-            # attn_out residuals), hence the split.
-            from dalle_tpu.models.attention import _pallas_by_default
-            ctx_names = (("attn_out", "attn_stats")
-                         if _pallas_by_default() else ("attn_ctx",))
+            # Every attention lowering names its output exactly once at
+            # the source — "attn_out"+"attn_stats" inside the Pallas
+            # kernels' custom_vjp fwd rules (so backward never re-runs
+            # the forward kernel), "attn_ctx" on the dense/axial XLA
+            # paths — so saving all three names never double-stores a
+            # layer, and a model that mixes lowerings (e.g. a conv layer
+            # past the window kernel's VMEM budget falling back to dense)
+            # still saves every layer's context.
+            ctx_names = ("attn_out", "attn_stats", "attn_ctx")
             if cfg.remat_policy == "save_attn":
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "attn_q", "attn_k", "attn_v", *ctx_names)

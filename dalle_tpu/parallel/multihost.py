@@ -71,6 +71,35 @@ def broadcast_arrays(arrays: Optional[List[np.ndarray]],
     return [np.asarray(a) for a in out]
 
 
+def is_fully_addressable(x: Any) -> bool:
+    """Whether this process holds every shard of ``x`` locally (always
+    true single-process; false for arrays sharded across processes)."""
+    return not isinstance(x, jax.Array) or x.is_fully_addressable
+
+
+def host_global(leaves: List[Any]) -> List[np.ndarray]:
+    """Host numpy copies of each leaf's GLOBAL value.
+
+    ``np.asarray`` raises on a jax.Array sharded across processes (the
+    fsdp/tp/sp slices the multi-host feature exists for); those leaves are
+    all-gathered first. The gather is a COLLECTIVE: in a multi-process run
+    with cross-process-sharded leaves, every process must call this in
+    lockstep (all swarm-layer callers are on broadcast-synchronized
+    paths; the StateServer thread uses the local-only snapshot instead).
+    """
+    out = []
+    gather = None
+    for x in leaves:
+        if is_fully_addressable(x):
+            out.append(np.asarray(x))
+        else:
+            if gather is None:
+                from jax.experimental import multihost_utils
+                gather = multihost_utils.process_allgather
+            out.append(np.asarray(gather(x, tiled=True)))
+    return out
+
+
 def sync() -> None:
     """Barrier across processes (used around checkpoint writes so hosts
     don't race each other's filesystem views). No-op single-process."""

@@ -143,22 +143,44 @@ class CollaborativeOptimizer:
         self._apply_timings: dict = {}
         self._server: Optional[StateServer] = None
         if serve_state and not client_mode and self.role.swarm_enabled:
-            self._server = StateServer(
-                dht, cfg.run_id, self._state_snapshot,
-                codec=self._state_codec,
-                adaptive_threshold=cfg.size_adaptive_threshold,
-                epoch_fn=lambda: self.local_epoch).start()
+            from dalle_tpu.parallel.multihost import is_fully_addressable
+            leaves = jax.tree_util.tree_leaves((state.params,
+                                                state.opt_state))
+            if all(is_fully_addressable(x) for x in leaves):
+                self._server = StateServer(
+                    dht, cfg.run_id, self._state_snapshot,
+                    codec=self._state_codec,
+                    adaptive_threshold=cfg.size_adaptive_threshold,
+                    epoch_fn=lambda: self.local_epoch).start()
+            else:
+                # the snapshot runs on a server thread that cannot join
+                # the cross-process all-gather a sharded state needs;
+                # such slices train fine but don't serve joiners
+                logger.warning(
+                    "state is sharded across processes: state server "
+                    "disabled on this slice (joiners must bootstrap from "
+                    "an unsharded peer or a checkpoint)")
         self.tracker.report_local_progress(0, 0, force=True)
 
     # -- state (de)construction -----------------------------------------
 
     def _state_leaves(self) -> List[np.ndarray]:
+        """Global host copies of the state leaves. COLLECTIVE when the
+        state is sharded across processes — callers are the lockstep,
+        broadcast-synchronized paths (startup sync, NaN rollback,
+        load_state_from_peers)."""
+        from dalle_tpu.parallel.multihost import host_global
         leaves = jax.tree_util.tree_leaves(
             (self.state.params, self.state.opt_state))
-        return [np.asarray(x) for x in leaves]
+        return host_global(leaves)
 
     def _state_snapshot(self):
-        return self.local_epoch, self._state_leaves()
+        """StateServer snapshot — runs on a background thread, so it must
+        NOT join collectives; the server is only started when the state is
+        fully addressable (see __init__)."""
+        leaves = jax.tree_util.tree_leaves(
+            (self.state.params, self.state.opt_state))
+        return self.local_epoch, [np.asarray(x) for x in leaves]
 
     def _replace_state_leaves(self, arrays: List[np.ndarray]) -> None:
         from dalle_tpu.swarm.state_transfer import apply_state_arrays
@@ -217,35 +239,41 @@ class CollaborativeOptimizer:
             return True
         return False
 
+    # _run_global_step exchange modes, broadcast coordinator -> followers
+    # on slices whose gradients are sharded across processes
+    _X_ALONE, _X_ALLREDUCE, _X_POWERSGD = 0, 1, 2
+
     def _run_global_step(self) -> None:
-        from dalle_tpu.parallel.multihost import broadcast_arrays
+        from dalle_tpu.parallel.multihost import (broadcast_arrays,
+                                                  broadcast_decision,
+                                                  host_global,
+                                                  is_fully_addressable)
 
         t0 = time.monotonic()
         treedef = jax.tree_util.tree_structure(self._grad_acc)
-
-        if not self.role.swarm_enabled:
-            # follower of a multi-host slice: the coordinator runs the
-            # swarm exchange; receive its averaged gradients and apply
-            # the identical update. Only shapes/dtypes are needed as the
-            # broadcast template — no device-to-host gradient pull here.
-            like = [np.zeros(g.shape, np.float32) for g in
-                    jax.tree_util.tree_leaves(self._grad_acc)]
-            averaged = broadcast_arrays(None, like=like)
-            self._apply_averaged(treedef, averaged)
-            self.last_timings = dict(self._apply_timings)
-            return
-
+        leaves = jax.tree_util.tree_leaves(self._grad_acc)
+        # Gradients sharded ACROSS processes (fsdp/tp/sp slices): pulling
+        # them to a host is a collective all-gather, and the PowerSGD
+        # device phases are SPMD programs — every process of the slice
+        # must run those paths in lockstep, with the wire exchange still
+        # coordinator-only (ADVICE r2: np.asarray raises on such arrays).
+        sharded = not all(is_fully_addressable(g) for g in leaves)
         weight = float(max(self.local_samples, 1))
-        if self._powersgd is not None:
+
+        if not (self.role.swarm_enabled or sharded):
+            grads_local = None  # unsharded follower: broadcast only
+        elif self._powersgd is not None:
             # device-side PowerSGD: the accumulated grads stay on device —
             # phase1 projects them there and only rank-r factors (plus the
             # small unplanned tail) are pulled for the wire
-            grads_local: List[Any] = [
-                g / weight for g in jax.tree_util.tree_leaves(self._grad_acc)]
+            grads_local: List[Any] = [g / weight for g in leaves]
         else:
-            grads_local = [np.asarray(g) / weight for g in
-                           jax.tree_util.tree_leaves(self._grad_acc)]
+            grads_local = [a / weight for a in host_global(leaves)]
         t_pull = time.monotonic()
+
+        if not self.role.swarm_enabled:
+            self._follower_exchange(treedef, leaves, grads_local, sharded)
+            return
 
         group = make_group(
             self.dht, f"{self.cfg.run_id}_grads", self.local_epoch,
@@ -254,39 +282,21 @@ class CollaborativeOptimizer:
             client_mode=self.client_mode, authorizer=self.authorizer,
             encrypt=self.cfg.encrypt_data_plane)
         t_match = time.monotonic()
-        if group is not None and group.size > 1:
+        exchanging = group is not None and group.size > 1
+        mode = (self._X_POWERSGD if self._powersgd is not None else
+                self._X_ALLREDUCE) if exchanging else self._X_ALONE
+        if sharded:
+            broadcast_decision(mode)
+        if exchanging:
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
                              - (time.monotonic() - t0)))
-            if self._powersgd is not None:
-                from dalle_tpu.swarm.powersgd import (IncompleteRound,
-                                                      average_with_powersgd)
-
-                def reduce_fn(tensors, phase):
-                    # two factor rounds per epoch (P then Q+raw), each
-                    # with half the round budget. An incomplete round
-                    # (member died mid-exchange) means this peer's
-                    # averaged factor bytes may diverge from other
-                    # survivors' orthogonal bases — reconstructing from
-                    # them corrupts gradients, so the epoch falls back to
-                    # local grads instead (the elasticity story).
-                    rep: dict = {}
-                    out = run_allreduce(
-                        self.dht, group,
-                        f"{self.cfg.run_id}_grads_{phase}",
-                        self.local_epoch, tensors, weight=weight,
-                        allreduce_timeout=budget / 2,
-                        codec=self._grad_codec,
-                        adaptive_threshold=self.cfg.size_adaptive_threshold,
-                        report=rep)
-                    if not rep.get("complete", False):
-                        raise IncompleteRound(phase)
-                    return out
-
-                # an IncompleteRound raised by reduce_fn is handled inside:
-                # the round is abandoned and local gradients come back
+            if mode == self._X_POWERSGD:
+                from dalle_tpu.swarm.powersgd import average_with_powersgd
                 averaged = average_with_powersgd(
-                    self._powersgd, grads_local, reduce_fn,
+                    self._powersgd, grads_local,
+                    self._powersgd_reduce_fn(group, weight, budget,
+                                             sharded),
                     epoch=self.local_epoch)
             else:
                 averaged = run_allreduce(
@@ -296,9 +306,16 @@ class CollaborativeOptimizer:
                     adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
             averaged = grads_local  # alone this epoch
-        # deliver the averaged gradients to this slice's followers (no-op
-        # in single-process runs)
-        averaged = broadcast_arrays(averaged, like=grads_local)
+        # deliver the averaged gradients to this slice's followers. On
+        # sharded slices the PowerSGD result is already global on every
+        # process (device SPMD + in-phase broadcasts) and the ALONE case
+        # is each process's identical grads — only a plain all-reduce
+        # result lives solely on the coordinator.
+        if sharded:
+            if mode == self._X_ALLREDUCE:
+                averaged = broadcast_arrays(averaged, like=grads_local)
+        else:
+            averaged = broadcast_arrays(averaged, like=grads_local)
         t_reduce = time.monotonic()
 
         self._apply_averaged(treedef, averaged)
@@ -315,6 +332,73 @@ class CollaborativeOptimizer:
         logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",
                     self.local_epoch, time.monotonic() - t0,
                     group.size if group else 1, self.last_timings)
+
+    def _follower_exchange(self, treedef, leaves, grads_local,
+                           sharded: bool) -> None:
+        """The follower half of a slice's global step. Unsharded slices:
+        just receive the coordinator's averaged gradients. Sharded slices:
+        mirror the coordinator's announced mode — the PowerSGD device
+        phases are SPMD collectives this process must join."""
+        from dalle_tpu.parallel.multihost import (broadcast_arrays,
+                                                  broadcast_decision)
+
+        if not sharded:
+            like = [np.zeros(g.shape, np.float32) for g in leaves]
+            averaged = broadcast_arrays(None, like=like)
+        else:
+            mode = broadcast_decision(self._X_ALONE)
+            if mode == self._X_POWERSGD:
+                from dalle_tpu.swarm.powersgd import average_with_powersgd
+                averaged = average_with_powersgd(
+                    self._powersgd, grads_local,
+                    self._powersgd_reduce_fn(None, 0.0, 0.0, sharded=True),
+                    epoch=self.local_epoch)
+            elif mode == self._X_ALLREDUCE:
+                averaged = broadcast_arrays(None, like=grads_local)
+            else:  # ALONE: every process already holds identical grads
+                averaged = grads_local
+        self._apply_averaged(treedef, averaged)
+        self.last_timings = dict(self._apply_timings)
+
+    def _powersgd_reduce_fn(self, group, weight: float, budget: float,
+                            sharded: bool):
+        """Reduce callback for the PowerSGD factor rounds: two rounds per
+        epoch (P then Q+raw), each with half the round budget, wire on the
+        coordinator only. On sharded slices the completeness flag and the
+        averaged factors are broadcast so every process raises (or
+        proceeds) identically — an incomplete round (member died
+        mid-exchange) means the averaged factor bytes may diverge from
+        other survivors' orthogonal bases, so the epoch falls back to
+        local grads instead (the elasticity story)."""
+        from dalle_tpu.parallel.multihost import (broadcast_arrays,
+                                                  broadcast_decision)
+        from dalle_tpu.swarm.powersgd import IncompleteRound
+
+        coordinator = self.role.swarm_enabled
+
+        def reduce_fn(tensors, phase):
+            ok, out = 1, None
+            if coordinator:
+                rep: dict = {}
+                out = run_allreduce(
+                    self.dht, group,
+                    f"{self.cfg.run_id}_grads_{phase}",
+                    self.local_epoch, tensors, weight=weight,
+                    allreduce_timeout=budget / 2,
+                    codec=self._grad_codec,
+                    adaptive_threshold=self.cfg.size_adaptive_threshold,
+                    report=rep)
+                if not rep.get("complete", False):
+                    ok = 0
+            if sharded:
+                ok = broadcast_decision(ok)
+            if not ok:
+                raise IncompleteRound(phase)
+            if sharded:
+                out = broadcast_arrays(out, like=tensors)
+            return out
+
+        return reduce_fn
 
     def _apply_averaged(self, treedef, averaged) -> None:
         """The post-exchange half of a global step, identical on every
@@ -359,7 +443,9 @@ class CollaborativeOptimizer:
         from dalle_tpu.ops.quant import (Quantized, dequantize_blockwise,
                                          quantize_blockwise)
         from dalle_tpu.parallel.multihost import (broadcast_arrays,
-                                                  broadcast_decision)
+                                                  broadcast_decision,
+                                                  host_global,
+                                                  is_fully_addressable)
 
         # the epoch condition that got us here is deterministic, so every
         # process of a slice enters together; whether a swarm group formed
@@ -370,21 +456,38 @@ class CollaborativeOptimizer:
         def float_leaves():
             # dequantizing every 8-bit moment + f32-copying every float
             # leaf is model-sized host work: build it only on paths that
-            # will actually average (a lone peer skips it entirely)
+            # will actually average (a lone peer skips it entirely).
+            # host_global + the dequant jit are collectives for state
+            # sharded across processes — see the lockstep hoist below.
             leaves = jax.tree_util.tree_leaves(tree, is_leaf=is_q)
-            float_idx, floats = [], []
+            float_idx, to_pull = [], []
             for i, leaf in enumerate(leaves):
                 if is_q(leaf):
                     float_idx.append(i)
-                    floats.append(np.asarray(dequantize_blockwise(leaf),
-                                             dtype=np.float32))
+                    to_pull.append(dequantize_blockwise(leaf))
                 elif compression.is_float_dtype(
                         getattr(leaf, "dtype", np.asarray(leaf).dtype)):
                     float_idx.append(i)
-                    floats.append(np.asarray(leaf, dtype=np.float32))
+                    to_pull.append(leaf)
+            floats = [a.astype(np.float32) for a in host_global(to_pull)]
             return leaves, float_idx, floats
 
+        def _addressable(leaf):
+            if is_q(leaf):
+                return (is_fully_addressable(leaf.codes)
+                        and is_fully_addressable(leaf.absmax))
+            return is_fully_addressable(leaf)
+
         averaged = leaves = float_idx = floats = None
+        state_sharded = not all(
+            _addressable(x)
+            for x in jax.tree_util.tree_leaves(tree, is_leaf=is_q))
+        if state_sharded:
+            # sharded slices must run the collective pull on every process
+            # in lockstep, BEFORE the coordinator disappears into
+            # matchmaking (followers would otherwise deadlock inside the
+            # all-gather while the coordinator owns the wire)
+            leaves, float_idx, floats = float_leaves()
         if self.role.swarm_enabled:
             group = make_group(
                 self.dht, f"{self.cfg.run_id}_state", self.local_epoch,
@@ -393,7 +496,8 @@ class CollaborativeOptimizer:
                 client_mode=self.client_mode, authorizer=self.authorizer,
                 encrypt=self.cfg.encrypt_data_plane)
             if group is not None and group.size > 1:
-                leaves, float_idx, floats = float_leaves()
+                if floats is None:
+                    leaves, float_idx, floats = float_leaves()
                 averaged = run_allreduce(
                     self.dht, group, f"{self.cfg.run_id}_state",
                     self.local_epoch, floats, weight=1.0,

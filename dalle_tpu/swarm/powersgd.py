@@ -97,28 +97,51 @@ def _as_matrix(shape: Sequence[int]) -> Tuple[int, int]:
     return m, n
 
 
-def orthogonalize(p: np.ndarray, eps: float = 1e-8) -> np.ndarray:
+#: a column whose post-projection residual is below this fraction of its
+#: original norm is (numerically) linearly dependent on the earlier basis:
+#: it must be ZEROED, not normalized — normalizing pure cancellation noise
+#: into a unit vector with large overlap with the earlier columns makes
+#: P_orth non-orthogonal and the reconstruction over-counts the gradient
+#: by up to the rank (this bites immediately on rank-deficient averaged
+#: Ps, e.g. near-constant gradients). A zero column simply lowers the
+#: effective rank for the round; error feedback recovers the residual.
+MGS_RELATIVE_TOL = 1e-4
+
+
+def orthogonalize(p: np.ndarray, rel_tol: float = MGS_RELATIVE_TOL
+                  ) -> np.ndarray:
     """Host-side modified Gram-Schmidt: plain IEEE f32 loop order,
     bit-identical across x86 peers for identical input bytes. Used for
-    the epoch-seeded Q init and the ``host_orthogonalize`` mode."""
+    the epoch-seeded Q init and the ``host_orthogonalize`` mode.
+    Numerically dependent columns come back zero (see MGS_RELATIVE_TOL)."""
     p = np.array(p, np.float32, copy=True)
     for i in range(p.shape[1]):
         col = p[:, i]
+        orig = float(np.linalg.norm(col))
         for j in range(i):
             col -= (col @ p[:, j]) * p[:, j]
         norm = float(np.linalg.norm(col))
-        p[:, i] = col / (norm + eps)
+        if norm > rel_tol * orig:
+            p[:, i] = col / norm
+        else:
+            p[:, i] = 0.0
     return p
 
 
-def _orthogonalize_dev(p: jax.Array, eps: float = 1e-8) -> jax.Array:
-    """Device MGS, unrolled over the (tiny, static) rank columns."""
+def _orthogonalize_dev(p: jax.Array, rel_tol: float = MGS_RELATIVE_TOL
+                       ) -> jax.Array:
+    """Device MGS, unrolled over the (tiny, static) rank columns; same
+    dependent-column zeroing as the host version."""
     cols: List[jax.Array] = []
     for i in range(p.shape[1]):
         c = p[:, i]
+        orig = jnp.linalg.norm(c)
         for q in cols:
             c = c - jnp.dot(c, q) * q
-        cols.append(c / (jnp.linalg.norm(c) + eps))
+        norm = jnp.linalg.norm(c)
+        keep = norm > rel_tol * orig
+        safe = jnp.where(keep, norm, 1.0)
+        cols.append(jnp.where(keep, c / safe, 0.0))
     return jnp.stack(cols, axis=1)
 
 
@@ -217,7 +240,10 @@ class PowerSGDCompressor:
         mats_e, ps = _dev_phase1(mats, errs, qs)
         for p, me in zip(plans, mats_e):
             self._mat_cache[p.index] = me
-        return [np.asarray(x) for x in ps]
+        # collective-safe host pull: on multi-host slices the factor
+        # outputs inherit the gradients' cross-process sharding
+        from dalle_tpu.parallel.multihost import host_global
+        return host_global(ps)
 
     def phase2_qs(self, plans: List[_TensorPlan],
                   averaged_ps: List[np.ndarray]) -> List[np.ndarray]:
@@ -236,7 +262,8 @@ class PowerSGDCompressor:
                                       [jnp.asarray(pa) for pa in host_ps])
         for p, po in zip(plans, p_orths):
             self._p_orth[p.index] = po
-        return [np.asarray(q) for q in qs]
+        from dalle_tpu.parallel.multihost import host_global
+        return host_global(qs)
 
     def reconstruct(self, leaves: List[Any],
                     plans: List[_TensorPlan],
@@ -298,8 +325,9 @@ def average_with_powersgd(
         ps = compressor.phase1_ps(leaves, plans, epoch)
         averaged_ps = reduce_fn(ps, "p") if ps else []
         qs = compressor.phase2_qs(plans, averaged_ps)
-        raw = [np.asarray(leaves[i], np.float32)
-               for i in range(len(leaves)) if i not in planned]
+        from dalle_tpu.parallel.multihost import host_global
+        raw = [a.astype(np.float32) for a in host_global(
+            [leaves[i] for i in range(len(leaves)) if i not in planned])]
         averaged_tail = reduce_fn(qs + raw, "q") if (qs or raw) else []
     except IncompleteRound:
         compressor.abandon_round()

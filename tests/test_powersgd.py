@@ -281,3 +281,38 @@ def test_flagship_sized_epoch_is_transfer_bound():
     # (including the one-time compile) must not look like host-loop MGS
     # over every gradient
     assert dt < 60, f"PowerSGD epoch took {dt:.1f}s"
+
+
+def test_orthogonalize_zeroes_dependent_columns():
+    """Rank-deficient P (e.g. near-constant gradients) must come back
+    with dependent columns ZEROED — normalizing the cancellation noise
+    into a garbage unit column makes P_orth non-orthogonal and the
+    reconstruction over-counts the gradient (code-review r3 finding)."""
+    from dalle_tpu.swarm.powersgd import _orthogonalize_dev
+
+    rank1 = np.ones((64, 1), np.float32) @ np.array([[2., 3., 4.]],
+                                                    np.float32)
+    for fn in (orthogonalize, lambda p: np.asarray(_orthogonalize_dev(
+            jnp.asarray(p)))):
+        p = fn(rank1)
+        # one unit column, the rest exactly zero
+        np.testing.assert_allclose(np.linalg.norm(p[:, 0]), 1.0, rtol=1e-5)
+        assert np.all(p[:, 1:] == 0.0), p[:, 1:]
+        # and the basis is orthonormal-or-zero: P^T P is diag of 1s/0s
+        gram = p.T @ p
+        np.testing.assert_allclose(gram, np.diag([1.0, 0.0, 0.0]),
+                                   atol=1e-5)
+
+
+def test_reconstruction_exact_on_rank_deficient_mean():
+    """A constant (rank-1) gradient averaged at rank 3 must reconstruct
+    the exact mean — the old behavior inflated it by up to the rank."""
+    comp = PowerSGDCompressor(rank=3)
+    leaves = [jnp.full((64, 32), 2.0, jnp.float32)]
+
+    def reduce_fn(tensors, phase):
+        return [t.copy() for t in tensors]
+
+    out = average_with_powersgd(comp, leaves, reduce_fn, epoch=0)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.full((64, 32), 2.0), rtol=1e-5)
