@@ -1,0 +1,35 @@
+import sys, time
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from bench import _bench  # noqa: F401  (shares helpers)
+from dalle_tpu.config import OptimizerConfig, flagship_model_config
+from dalle_tpu.data.synthetic import SyntheticCodes
+from dalle_tpu.models.dalle import DALLE, init_params
+from dalle_tpu.optim import make_optimizer
+from dalle_tpu.parallel.mesh import batch_sharding, make_mesh
+from dalle_tpu.parallel.sharding import shard_train_state
+from dalle_tpu.training.steps import TrainState, make_train_step
+
+micro, accum = 4, 4  # short accumulation: the profile needs shape, not scale
+cfg = flagship_model_config()
+mesh = make_mesh(dp=-1)
+model = DALLE(cfg)
+params = init_params(model, jax.random.PRNGKey(0))
+tx = make_optimizer(OptimizerConfig(warmup_steps=10, total_steps=1000))
+state = shard_train_state(mesh, TrainState.create(params, tx))
+batch_size = micro * accum
+data = SyntheticCodes(cfg, num_samples=batch_size, seed=0)
+batch = next(data.batches(batch_size, seed=0))
+batch = jax.device_put(batch, batch_sharding(mesh))
+step = jax.jit(make_train_step(model, tx, accum_steps=accum), donate_argnums=0)
+
+state, m = step(state, batch)
+print("warm loss", float(m["loss"]), flush=True)
+jax.profiler.start_trace("/tmp/prof_r3")
+for _ in range(2):
+    state, m = step(state, batch)
+float(m["loss"])
+jax.profiler.stop_trace()
+print("trace done", flush=True)

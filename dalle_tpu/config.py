@@ -87,6 +87,13 @@ class ModelConfig:
     # instead of materializing the full (B, T, vocab) logits in HBM.
     # 0 = off (dense head). Identical losses either way.
     head_chunk: int = 0
+    # Cycle passes unrolled inside ONE scan iteration of the weight-shared
+    # body. Backward accumulates the shared weights' f32 gradients into
+    # the scan carry once per iteration — at unroll 1 that read-modify-
+    # write of every unique weight 16x per microbatch was ~17% of the
+    # flagship step (profiled r3); unroll N divides it by N at the cost
+    # of an N-times-larger compiled body.
+    scan_unroll: int = 1
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
     # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"

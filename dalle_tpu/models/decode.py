@@ -74,12 +74,34 @@ def _ln(x, p, dtype):
     return (y * p["scale"] + p.get("bias", 0.0)).astype(dtype)
 
 
+def _cycle_reps(cfg: ModelConfig) -> int:
+    """Number of scan repetitions over the weight-shared cycle (0 when the
+    schedule is not cycle-structured and decode unrolls instead)."""
+    body = len(cfg.layer_schedule()) - (1 if cfg.final_conv_block else 0)
+    cycle = cfg.shared_block_cycle
+    if cycle and -(-body // cycle) > 1:
+        return -(-body // cycle)
+    return 0
+
+
+def n_cache_slots(cfg: ModelConfig) -> int:
+    """KV-cache slots. The scanned decode sizes the body as reps x cycle
+    (the final repetition's overhanging applications own dead slots, same
+    as training's masked scan overhang); the unrolled decode uses exactly
+    one slot per schedule entry."""
+    reps = _cycle_reps(cfg)
+    if reps:
+        return (reps * cfg.shared_block_cycle
+                + (1 if cfg.final_conv_block else 0))
+    return len(cfg.layer_schedule())
+
+
 def init_cache(cfg: ModelConfig, batch: int, dtype=None):
     """Static-shape KV cache: one (B, T, H, d) k/v pair per layer
     application (weight sharing shares parameters, not activations)."""
     dtype = dtype or jnp.dtype(cfg.dtype)
-    n_layers = len(cfg.layer_schedule())
-    shape = (n_layers, batch, cfg.total_seq_len, cfg.heads, cfg.head_dim)
+    shape = (n_cache_slots(cfg), batch, cfg.total_seq_len, cfg.heads,
+             cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -100,6 +122,46 @@ def _positional_table(params: Dict, cfg: ModelConfig) -> jax.Array:
     return jnp.concatenate([root["text_pos_emb"], img_pos], axis=0)
 
 
+def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
+                 cfg: ModelConfig, dtype):
+    """One cached block application: (B, dim) -> (B, dim) plus the block's
+    updated (B, T, H, d) cache pair. The incremental mirror of
+    transformer.TransformerBlock."""
+    b = x.shape[0]
+    h = _ln(x, lp["attn_norm"], dtype)
+    q = (h @ lp["attn"]["q"]["kernel"].astype(dtype)).reshape(
+        b, cfg.heads, cfg.head_dim)
+    k = (h @ lp["attn"]["k"]["kernel"].astype(dtype)).reshape(
+        b, cfg.heads, cfg.head_dim)
+    v = (h @ lp["attn"]["v"]["kernel"].astype(dtype)).reshape(
+        b, cfg.heads, cfg.head_dim)
+    if cfg.rotary:
+        q = apply_rotary(q, cos_p[None, None, :], sin_p[None, None, :])
+        k = apply_rotary(k, cos_p[None, None, :], sin_p[None, None, :])
+    k_cache = jax.lax.dynamic_update_index_in_dim(
+        k_cache, k.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_index_in_dim(
+        v_cache, v.astype(v_cache.dtype), pos, axis=1)
+
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum("bhd,bthd->bht", q, k_cache.astype(dtype),
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask_row[None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bht,bthd->bhd", probs.astype(dtype),
+                     v_cache.astype(dtype),
+                     preferred_element_type=jnp.float32).astype(dtype)
+    attn_out = ctx.reshape(b, cfg.dim) @ \
+        lp["attn"]["out"]["kernel"].astype(dtype)
+    x = x + attn_out
+
+    h = _ln(x, lp["ff_norm"], dtype)
+    wi = h @ lp["ff"]["wi"]["kernel"].astype(dtype)
+    gate = h @ lp["ff"]["gate"]["kernel"].astype(dtype)
+    ff = (wi * jax.nn.gelu(gate)) @ lp["ff"]["wo"]["kernel"].astype(dtype)
+    return x + ff, k_cache, v_cache
+
+
 def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                 input_ids: jax.Array, pos: jax.Array):
     """One cached decode step.
@@ -108,10 +170,14 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     ``pos``; returns (logits over the FULL combined vocabulary at ``pos``,
     updated cache). Segment masking is applied (text positions only emit
     text ids, image positions image ids).
+
+    Cycle-structured schedules (the flagship's 4 weight-shared blocks
+    x 16) run the body as ONE ``lax.scan`` over the repetitions — compile
+    cost is the 4 unique blocks, not the 64 applications (training needed
+    the same restructuring: PERF.md r2 #6, compile 237s -> 42s). Other
+    schedules unroll exactly as before.
     """
     root = params["params"] if "params" in params else params
-    layers = layer_params(params, cfg)
-    masks = jnp.asarray(_mask_stack(cfg))
     dtype = jnp.dtype(cfg.dtype)
     b = input_ids.shape[0]
     t_total = cfg.total_seq_len
@@ -123,44 +189,69 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     cos_t, sin_t = rotary_cos_sin(jnp.arange(t_total), cfg.head_dim)
     cos_p, sin_p = cos_t[pos], sin_t[pos]    # (d,)
 
-    new_k, new_v = [], []
-    for li, lp in enumerate(layers):
-        h = _ln(x, lp["attn_norm"], dtype)
-        q = (h @ lp["attn"]["q"]["kernel"].astype(dtype)).reshape(
-            b, cfg.heads, cfg.head_dim)
-        k = (h @ lp["attn"]["k"]["kernel"].astype(dtype)).reshape(
-            b, cfg.heads, cfg.head_dim)
-        v = (h @ lp["attn"]["v"]["kernel"].astype(dtype)).reshape(
-            b, cfg.heads, cfg.head_dim)
-        if cfg.rotary:
-            q = apply_rotary(q, cos_p[None, None, :], sin_p[None, None, :])
-            k = apply_rotary(k, cos_p[None, None, :], sin_p[None, None, :])
-        k_cache = jax.lax.dynamic_update_index_in_dim(
-            cache["k"][li], k.astype(cache["k"].dtype), pos, axis=1)
-        v_cache = jax.lax.dynamic_update_index_in_dim(
-            cache["v"][li], v.astype(cache["v"].dtype), pos, axis=1)
-        new_k.append(k_cache)
-        new_v.append(v_cache)
+    reps = _cycle_reps(cfg)
+    if reps:
+        cycle = cfg.shared_block_cycle
+        sched = cfg.layer_schedule()
+        n_body = len(sched) - (1 if cfg.final_conv_block else 0)
+        tr = root["transformer"]
+        blocks = dict(tr.get("cycle", {}))
+        for key, val in tr.items():
+            if key.startswith("block"):
+                blocks[key] = val
+        uid_masks = jnp.asarray(np.stack([
+            zoo_attention_mask(cfg.attn_types[u % len(cfg.attn_types)],
+                               cfg.text_seq_len, cfg.image_grid,
+                               cfg.conv_kernel)
+            for u in range(cycle)]))
 
-        scale = cfg.head_dim ** -0.5
-        scores = jnp.einsum("bhd,bthd->bht", q, k_cache.astype(dtype),
-                            preferred_element_type=jnp.float32) * scale
-        row = masks[li][pos]                 # (T,) static-shape mask row
-        scores = jnp.where(row[None, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bht,bthd->bhd", probs.astype(dtype),
-                         v_cache.astype(dtype),
-                         preferred_element_type=jnp.float32).astype(dtype)
-        attn_out = ctx.reshape(b, cfg.dim) @ \
-            lp["attn"]["out"]["kernel"].astype(dtype)
-        x = x + attn_out
+        body_k = cache["k"][:reps * cycle].reshape(
+            reps, cycle, *cache["k"].shape[1:])
+        body_v = cache["v"][:reps * cycle].reshape(
+            reps, cycle, *cache["v"].shape[1:])
 
-        h = _ln(x, lp["ff_norm"], dtype)
-        wi = h @ lp["ff"]["wi"]["kernel"].astype(dtype)
-        gate = h @ lp["ff"]["gate"]["kernel"].astype(dtype)
-        ff = (wi * jax.nn.gelu(gate)) @ lp["ff"]["wo"]["kernel"].astype(
-            dtype)
-        x = x + ff
+        def rep_body(x, xs):
+            k_slice, v_slice, it = xs
+            new_k, new_v = [], []
+            for uid in range(cycle):
+                y, k_new, v_new = _apply_block(
+                    x, blocks[f"block_{uid}"], uid_masks[uid][pos],
+                    k_slice[uid], v_slice[uid], pos, cos_p, sin_p,
+                    cfg, dtype)
+                # same overhang masking as training's BlockCycle: the
+                # final repetition's surplus applications run but their
+                # outputs are discarded
+                active = it * cycle + uid < n_body
+                x = jnp.where(active, y, x)
+                new_k.append(k_new)
+                new_v.append(v_new)
+            return x, (jnp.stack(new_k), jnp.stack(new_v))
+
+        x, (body_k, body_v) = jax.lax.scan(
+            rep_body, x, (body_k, body_v, jnp.arange(reps)))
+        new_k = [body_k.reshape(reps * cycle, *body_k.shape[2:])]
+        new_v = [body_v.reshape(reps * cycle, *body_v.shape[2:])]
+        if cfg.final_conv_block:
+            mask = jnp.asarray(zoo_attention_mask(
+                "conv_like", cfg.text_seq_len, cfg.image_grid,
+                cfg.conv_kernel))
+            x, k_new, v_new = _apply_block(
+                x, blocks["block_wconv"], mask[pos], cache["k"][-1],
+                cache["v"][-1], pos, cos_p, sin_p, cfg, dtype)
+            new_k.append(k_new[None])
+            new_v.append(v_new[None])
+        cache = {"k": jnp.concatenate(new_k), "v": jnp.concatenate(new_v)}
+    else:
+        layers = layer_params(params, cfg)
+        masks = jnp.asarray(_mask_stack(cfg))
+        new_k, new_v = [], []
+        for li, lp in enumerate(layers):
+            x, k_cache, v_cache = _apply_block(
+                x, lp, masks[li][pos], cache["k"][li], cache["v"][li],
+                pos, cos_p, sin_p, cfg, dtype)
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
     x = _ln(x, root["transformer"]["final_norm"], dtype)
 
@@ -176,8 +267,6 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     vocab_is_text = jnp.arange(cfg.vocab_total) < cfg.vocab_text
     valid = jnp.where(is_text_pos, vocab_is_text, ~vocab_is_text)
     logits = jnp.where(valid[None, :], logits, NEG_INF)
-
-    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
     return logits, cache
 
 

@@ -88,6 +88,12 @@ class ZooAttention(nn.Module):
                 q, k, v, mesh=self.mesh, mode=cfg.sequence_parallel,
                 attn_type=self.attn_type, text_len=cfg.text_seq_len,
                 grid=cfg.image_grid, conv_kernel=cfg.conv_kernel)
+            # names emitted inside the shard_map body don't surface to
+            # the outer remat policy: name the sp output here so
+            # save_ctx/save_attn at least save the attention RESULT
+            # (pruning the output recompute; shard_map internals still
+            # replay for their own residuals)
+            out = checkpoint_name(out, "attn_ctx")
         else:
             out = zoo_attention(
                 q, k, v, attn_type=self.attn_type, text_len=cfg.text_seq_len,
@@ -166,20 +172,28 @@ class BlockCycle(nn.Module):
         cfg = self.cfg
         rot = _make_rot(cfg)
         cycle = cfg.shared_block_cycle
-        exact = self.n_body % cycle == 0
+        unroll = max(1, cfg.scan_unroll)
+        exact = self.n_body % (cycle * unroll) == 0
         first_plain = cycle - cfg.remat_skip_blocks
+        blocks = {}
         for uid in range(cycle):
             attn_type = cfg.attn_types[uid % len(cfg.attn_types)]
             cls = (self.plain_cls
                    if self.plain_cls is not None and uid >= first_plain
                    else self.block_cls)
-            y = cls(cfg, attn_type, mesh=self.mesh,
-                    name=f"block_{uid}")(x, rot)
-            if exact:
-                x = y
-            else:
-                active = it * cycle + uid < self.n_body
-                x = jnp.where(active, y, x)
+            blocks[uid] = cls(cfg, attn_type, mesh=self.mesh,
+                              name=f"block_{uid}")
+        for u in range(unroll):
+            for uid in range(cycle):
+                # one module instance per uid, called ``unroll`` times:
+                # Flax shares the parameters across the calls
+                y = blocks[uid](x, rot)
+                if exact:
+                    x = y
+                else:
+                    active = ((it * unroll + u) * cycle + uid
+                              < self.n_body)
+                    x = jnp.where(active, y, x)
         return x, None
 
 
@@ -237,7 +251,8 @@ class Transformer(nn.Module):
 
         cycle = cfg.shared_block_cycle
         body = len(sched) - (1 if cfg.final_conv_block else 0)
-        reps = -(-body // cycle) if cycle else 0
+        per_iter = cycle * max(1, cfg.scan_unroll) if cycle else 0
+        reps = -(-body // per_iter) if cycle else 0
         if cycle and reps > 1:
             scan = nn.scan(BlockCycle,
                            variable_broadcast="params",

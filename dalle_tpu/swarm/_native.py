@@ -86,6 +86,17 @@ def load() -> ctypes.CDLL:
         lib.swarm_node_fetch.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_attach_relay.restype = ctypes.c_int
+        lib.swarm_node_attach_relay.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.swarm_node_relay_send.restype = ctypes.c_int
+        lib.swarm_node_relay_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+        lib.swarm_node_relay_fetch.restype = ctypes.c_void_p
+        lib.swarm_node_relay_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
         lib.swarm_node_peers.restype = ctypes.c_void_p
         lib.swarm_node_peers.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]

@@ -215,6 +215,7 @@ class DHT:
         self._lib.swarm_node_set_timeout(self._node, int(rpc_timeout * 1000))
         self.host = host
         self.port = self._lib.swarm_node_port(self._node)
+        self._relay_addr: Optional[str] = None
         for addr in initial_peers:
             self.bootstrap(addr)
 
@@ -247,14 +248,53 @@ class DHT:
 
     @property
     def visible_address(self) -> str:
-        """Copyable --initial_peers entry (reference utils.py:39-56)."""
+        """Copyable --initial_peers entry (reference utils.py:39-56).
+
+        A client-mode peer attached to a relay is reachable at
+        ``relay_host:relay_port/<its peer id>`` — the data plane routes
+        sends and mailbox fetches through the relay transparently, so a
+        relay-attached peer participates (and owns all-reduce parts) like
+        a routable one.
+        """
+        if self._relay_addr is not None:
+            return f"{self._relay_addr}/{self.peer_id}"
         return f"{self.host}:{self.port}"
 
+    @property
+    def reachable_address(self) -> str:
+        """The address other peers can deliver pushes to: the listener, a
+        relay route for an attached client-mode peer, or "" for a plain
+        client-mode peer (pull-only)."""
+        if self._relay_addr is not None:
+            return self.visible_address
+        return "" if self.client_mode else self.visible_address
+
     def bootstrap(self, addr: str) -> bool:
-        host, _, port = addr.rpartition(":")
-        rc = self._lib.swarm_node_bootstrap(
-            self._node, host.encode(), int(port))
+        # a relayed address ("host:port/<peer id>") bootstraps off the
+        # relay itself — the banner advertises relayed visible_addresses
+        # as copyable --initial-peers entries
+        host, port, _ = self._parse_addr(addr)
+        rc = self._lib.swarm_node_bootstrap(self._node, host.encode(), port)
         return rc == 0
+
+    def attach_relay(self, addr: str) -> bool:
+        """Attach to a routable relay peer (reference libp2p relay /
+        client_mode surface, arguments.py:89-124): keeps one persistent
+        outbound connection over which the relay forwards tagged messages
+        and mailbox fetches to this (listener-less) peer."""
+        host, _, port = addr.rpartition(":")
+        rc = self._lib.swarm_node_attach_relay(
+            self._node, host.encode(), int(port))
+        if rc == 0:
+            self._relay_addr = f"{host}:{int(port)}"
+        return rc == 0
+
+    @staticmethod
+    def _parse_addr(addr: str):
+        """(host, port, relayed_target_id_bytes | None)."""
+        hostport, _, target = addr.partition("/")
+        host, _, port = hostport.rpartition(":")
+        return host, int(port), bytes.fromhex(target) if target else None
 
     # -- records ----------------------------------------------------------
 
@@ -312,12 +352,18 @@ class DHT:
     def send(self, addr: str, tag: int, payload: bytes,
              timeout: Optional[float] = None) -> bool:
         """One-shot timeouts apply to this send only (the node-wide RPC
-        timeout is untouched)."""
-        host, _, port = addr.rpartition(":")
+        timeout is untouched). ``addr`` may be a plain ``host:port`` or a
+        relayed ``relay_host:relay_port/<peer id>``."""
+        host, port, target = self._parse_addr(addr)
         timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
-        rc = self._lib.swarm_node_send(
-            self._node, host.encode(), int(port), tag, payload, len(payload),
-            timeout_ms)
+        if target is not None:
+            rc = self._lib.swarm_node_relay_send(
+                self._node, host.encode(), port, target, tag,
+                payload, len(payload), timeout_ms)
+        else:
+            rc = self._lib.swarm_node_send(
+                self._node, host.encode(), port, tag, payload, len(payload),
+                timeout_ms)
         return rc == 0
 
     def recv(self, tag: int, timeout: float) -> Optional[bytes]:
@@ -339,13 +385,20 @@ class DHT:
     def fetch(self, addr: str, tag: int,
               timeout: Optional[float] = None) -> Optional[bytes]:
         """Single-round-trip mailbox read from a remote peer (poll to
-        wait)."""
-        host, _, port = addr.rpartition(":")
+        wait). A relayed address fetches THROUGH the relay: the relay
+        forwards the request down the target's attachment and returns its
+        mailbox answer."""
+        host, port, target = self._parse_addr(addr)
         timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
         out_len = ctypes.c_size_t()
-        ptr = self._lib.swarm_node_fetch(
-            self._node, host.encode(), int(port), tag, timeout_ms,
-            ctypes.byref(out_len))
+        if target is not None:
+            ptr = self._lib.swarm_node_relay_fetch(
+                self._node, host.encode(), port, target, tag, timeout_ms,
+                ctypes.byref(out_len))
+        else:
+            ptr = self._lib.swarm_node_fetch(
+                self._node, host.encode(), port, tag, timeout_ms,
+                ctypes.byref(out_len))
         if not ptr:
             return None
         return _native.take_buffer(ptr, out_len.value)

@@ -177,7 +177,9 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
     """
     key = f"{prefix}_matchmaking.e{epoch}"
     my_id = dht.peer_id
-    addr = "" if client_mode else dht.visible_address
+    # relay-attached client peers announce their relay route and act as
+    # full (part-owning) members; only plain client-mode peers announce ""
+    addr = dht.reachable_address
     deadline = time.monotonic() + matchmaking_time
     announce = {"addr": addr, "weight": float(weight),
                 "kx": dht.kx.public_bytes}
@@ -238,9 +240,10 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
             dht.send(m.addr, _confirm_tag(prefix, epoch, m.peer_id), payload,
                      timeout=confirm_wait)
     else:
-        if client_mode:
-            # pull from the leader's mailbox; poll, since the leader may
-            # still be finishing its own matchmaking window
+        if client_mode and dht._relay_addr is None:
+            # plain client mode (no relay): pull from the leader's
+            # mailbox; poll, since the leader may still be finishing its
+            # own matchmaking window
             raw = None
             confirm_deadline = time.monotonic() + confirm_wait
             while raw is None and leader.addr:

@@ -469,7 +469,8 @@ class CollaborativeOptimizer:
                         getattr(leaf, "dtype", np.asarray(leaf).dtype)):
                     float_idx.append(i)
                     to_pull.append(leaf)
-            floats = [a.astype(np.float32) for a in host_global(to_pull)]
+            floats = [a.astype(np.float32, copy=False)
+                      for a in host_global(to_pull)]
             return leaves, float_idx, floats
 
         def _addressable(leaf):
@@ -562,8 +563,14 @@ class CollaborativeOptimizer:
                     epoch, arrays = -1, None
         # broadcast_one_to_all needs identical shapes/dtypes on every
         # process: canonicalize the downloaded (wire-format) arrays to the
-        # local state's layout before the broadcast decision
-        like = self._state_leaves()
+        # local state's layout before the broadcast decision. Only shapes/
+        # dtypes are needed (a zeros template), NOT the values — pulling
+        # the values would be a model-sized collective that followers
+        # would enter while the coordinator is still inside the download
+        # loop (the lockstep-before-wire rule of _average_state).
+        like = [np.zeros(x.shape, np.dtype(getattr(x, "dtype", np.float32)))
+                for x in jax.tree_util.tree_leaves(
+                    (self.state.params, self.state.opt_state))]
         if arrays is not None:
             try:
                 assert len(arrays) == len(like)

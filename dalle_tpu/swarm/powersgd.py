@@ -326,7 +326,7 @@ def average_with_powersgd(
         averaged_ps = reduce_fn(ps, "p") if ps else []
         qs = compressor.phase2_qs(plans, averaged_ps)
         from dalle_tpu.parallel.multihost import host_global
-        raw = [a.astype(np.float32) for a in host_global(
+        raw = [a.astype(np.float32, copy=False) for a in host_global(
             [leaves[i] for i in range(len(leaves)) if i not in planned])]
         averaged_tail = reduce_fn(qs + raw, "q") if (qs or raw) else []
     except IncompleteRound:

@@ -317,7 +317,10 @@ def load_state_from_peers(dht: DHT, prefix: str,
             if best is not None:
                 break
         nonce = os.urandom(16)  # CSPRNG: the nonce is the freshness binding
-        reply_addr = "" if dht.client_mode else dht.visible_address
+        # relay-attached client peers CAN receive pushed chunks (their
+        # relay route is the reply address); only plain client mode pays
+        # the mailbox-poll pull path
+        reply_addr = dht.reachable_address
         # the kx public key lets the server seal chunks so only this
         # requester can read the state stream (swarm/crypto.py)
         req = msgpack.packb({"addr": reply_addr, "nonce": nonce,
@@ -326,7 +329,7 @@ def load_state_from_peers(dht: DHT, prefix: str,
         if not dht.send(addr, _req_tag(prefix, pid), req,
                         timeout=min(10.0, remaining)):
             continue
-        if dht.client_mode:
+        if not reply_addr:
             blob = _pull_chunks(dht, prefix, addr, nonce, deadline, pid)
         else:
             blob = _collect_chunks(dht, _rsp_tag(prefix, nonce), deadline,

@@ -86,6 +86,36 @@ int swarm_node_post(SwarmNode *node, uint64_t tag, const uint8_t *payload,
 uint8_t *swarm_node_fetch(SwarmNode *node, const char *host, int port,
                           uint64_t tag, int timeout_ms, size_t *out_len);
 
+/* Relay: a routable peer forwards traffic between client-mode peers that
+ * cannot reach each other (the reference's libp2p relay/hole-punching
+ * surface, arguments.py:89-124). A client-mode peer ATTACHES to a relay
+ * over one persistent outbound connection; the relay then (a) forwards
+ * tagged messages to it (swarm_node_relay_send from anyone) and (b)
+ * forwards mailbox FETCHes to it and returns the replies — so an attached
+ * peer can both receive pushes and serve its mailbox without a listener.
+ */
+
+/* Attach this node to a relay. Keeps one outbound connection open and
+ * spawns a reader that enqueues forwarded messages into the normal recv
+ * queues and answers forwarded fetches from the local mailbox. Re-attach
+ * replaces the previous attachment. Returns 0 on success. */
+int swarm_node_attach_relay(SwarmNode *node, const char *host, int port);
+
+/* Send tag+payload to the peer with `target` id ATTACHED to the relay at
+ * host:port. Returns 0 once the relay accepted and wrote the frame to the
+ * attachment, -1 otherwise (target not attached / relay unreachable). */
+int swarm_node_relay_send(SwarmNode *node, const char *host, int port,
+                          const uint8_t target[32], uint64_t tag,
+                          const uint8_t *payload, size_t len,
+                          int timeout_ms);
+
+/* Fetch a mailbox entry from an ATTACHED peer through its relay. Round
+ * trip: caller -> relay -> attachment -> relay -> caller. Returns malloc'd
+ * payload (swarm_free) or NULL. */
+uint8_t *swarm_node_relay_fetch(SwarmNode *node, const char *host, int port,
+                                const uint8_t target[32], uint64_t tag,
+                                int timeout_ms, size_t *out_len);
+
 /* Routing table dump: malloc'd buffer of u32 count entries:
  * 32B id, u32 host_len, host, u16 port (BE). */
 uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len);

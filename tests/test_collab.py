@@ -561,3 +561,69 @@ class TestCollaborativeOptimizer:
             fast.shutdown()
             for n in nodes:
                 n.shutdown()
+
+
+class TestRelayAllReduce:
+    def test_two_listenerless_peers_allreduce_through_relay(self):
+        """VERDICT r2 next #3 done-criterion: two client-mode peers (no
+        listeners at all) complete a full gradient all-reduce THROUGH a
+        routable relay peer — the relay forwards contribution pushes,
+        averaged-part pushes, and leader confirmations down each peer's
+        persistent attachment."""
+        from dalle_tpu.swarm import DHT
+
+        relay = DHT(rpc_timeout=2.0)
+        clients = [DHT(client_mode=True, rpc_timeout=2.0,
+                       initial_peers=[relay.visible_address])
+                   for _ in range(2)]
+        for c in clients:
+            assert c.attach_relay(relay.visible_address)
+            assert "/" in c.visible_address
+
+        cfg = CollabConfig(run_id="rly", target_batch_size=32,
+                           matchmaking_time=2.0, allreduce_timeout=10.0,
+                           averaging_timeout=20.0, average_state_every=0,
+                           grad_compression="none")
+        # client_mode=True: no all-reduce push listener... except the
+        # relay attachment makes these peers fully addressable
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+        from dalle_tpu.training.steps import TrainState, make_apply_step
+
+        opts = []
+        for dht in clients:
+            params = {"w": jnp.ones((16,)) * 0.5, "b": jnp.zeros((4,))}
+            tx = optax.sgd(0.1)
+            state = TrainState.create(params, tx)
+            opt = CollaborativeOptimizer(
+                dht, cfg, state, jax.jit(make_apply_step(tx)),
+                client_mode=True, serve_state=False)
+            opt.tracker.min_refresh_period = 0.05
+            opts.append(opt)
+
+        try:
+            def run_peer(i):
+                opt = opts[i]
+                grads = {"w": jnp.full((16,), float(i + 1)),
+                         "b": jnp.full((4,), -1.0)}
+                deadline = time.monotonic() + 30
+                while opt.local_epoch < 1 and time.monotonic() < deadline:
+                    opt.step(grads, batch_size=8)
+                    time.sleep(0.05)
+                return opt.local_epoch
+
+            epochs = run_threads([lambda i=i: run_peer(i) for i in range(2)])
+            assert all(e >= 1 for e in epochs), epochs
+            p0 = np.asarray(opts[0].state.params["w"])
+            p1 = np.asarray(opts[1].state.params["w"])
+            np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-6)
+            assert not np.allclose(p0, 0.5)  # a real averaged update ran
+            # both relay-attached peers owned parts (addr non-empty), so
+            # this was a genuine two-owner butterfly, not a solo epoch
+        finally:
+            for opt in opts:
+                opt.shutdown()
+            for n in clients + [relay]:
+                n.shutdown()
