@@ -54,6 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "(reference pulls every 2, arguments.py:150)")
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="append one JSON line per refresh round")
+    parser.add_argument("--archive-remote", type=str, default=None,
+                        help="also upload each archived checkpoint to this "
+                             "destination: a directory / file:// URL, a "
+                             "gs:// path (gsutil) or an rsync target — the "
+                             "TPU-native analogue of the reference's HF Hub "
+                             "upload (run_aux_peer.py:59-76)")
     parser.add_argument("--platform", type=str, default=None)
     parser.add_argument("--log-level", type=str, default="INFO")
     for cls in CONFIG_CLASSES:
@@ -104,6 +110,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if aux.checkpoint_dir:
         from dalle_tpu.training.checkpoint import CheckpointManager
         ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
+    from dalle_tpu.training.remote_sink import RemoteSink
+    remote_sink = RemoteSink.create(args.archive_remote)
+    if remote_sink is not None and ckpt_mgr is None:
+        logger.warning(
+            "--archive-remote %s requires --checkpoint-dir (the local "
+            "archive is what gets uploaded): remote archiving is OFF",
+            args.archive_remote)
+        remote_sink = None
 
     wandb_run = None
     if args.wandb_project:
@@ -150,9 +164,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 if result is not None:
                     epoch, arrays = result
                     state = apply_state_arrays(task.train_state, arrays)
-                    ckpt_mgr.save(state, epoch, backup=True)
+                    saved_path = ckpt_mgr.save(state, epoch, backup=True)
                     last_archived = epoch
                     logger.info("archived swarm state at epoch %d", epoch)
+                    if remote_sink is not None:
+                        if remote_sink.upload(saved_path):
+                            logger.info("uploaded %s to %s",
+                                        saved_path, args.archive_remote)
                 else:
                     logger.warning("state archive pull failed this round")
     if wandb_run is not None:

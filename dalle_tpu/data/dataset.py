@@ -92,12 +92,12 @@ class CodesDataset:
         self.tokenizer = tokenizer
         self.cfg = cfg
         self.shuffle_buffer = shuffle_buffer
-        if os.path.isdir(path):
-            self.shards = sorted(
-                os.path.join(path, f) for f in os.listdir(path)
-                if f.endswith((".msgpack", ".shard")))
-        else:
-            self.shards = [path]
+        # local paths, single shard URLs and manifest URLs all resolve to
+        # lazy openers (data/remote.py): remote shards download into the
+        # local cache on first use (the reference streams from the hub,
+        # data.py:34-38; this is the transport-agnostic equivalent)
+        from dalle_tpu.data.remote import resolve_shards
+        self.shards = resolve_shards(path)
         if not self.shards:
             raise FileNotFoundError(f"no shard files under {path}")
 
@@ -108,7 +108,7 @@ class CodesDataset:
         while True:
             order = rng.permutation(len(self.shards))
             for si in order:
-                with open(self.shards[si], "rb") as f:
+                with open(self.shards[si](), "rb") as f:
                     unpacker = msgpack.Unpacker(f, raw=False)
                     for rec in unpacker:
                         if isinstance(rec, dict) and record_filter(rec):

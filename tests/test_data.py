@@ -155,3 +155,70 @@ class TestCodesDataset:
             state, metrics = step(state, next(it))
             losses.append(float(metrics["loss"]))
         assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, losses
+
+class TestRemoteShards:
+    """URL-backed shard reading with a local cache (VERDICT r2 next #7;
+    reference streams from the hub, data.py:34-38)."""
+
+    def test_manifest_url_streams_through_cache(self, tmp_path, tokenizer,
+                                                monkeypatch):
+        from dalle_tpu.data import remote
+
+        cfg = tiny_model_config()
+        _make_shards(tmp_path, cfg, n_shards=2, per_shard=8)
+        manifest = tmp_path / "index.txt"
+        manifest.write_text("# shard list\nshard_0.msgpack\n"
+                            "shard_1.msgpack\n")
+        cache = tmp_path / "cache"
+        monkeypatch.setattr(remote, "DEFAULT_CACHE", str(cache))
+        ds = CodesDataset(f"file://{manifest}", cfg,
+                          tokenizer=tokenizer, shuffle_buffer=4)
+        batches = list(ds.batches(4, seed=0, loop=False))
+        assert batches, "no batches from remote manifest"
+        # the shards were fetched into the cache exactly once
+        cached = list(cache.glob("*shard_*.msgpack"))
+        assert len(cached) == 2, cached
+        # a second pass rereads the cache (no new files)
+        list(ds.batches(4, seed=1, loop=False))
+        assert len(list(cache.glob("*shard_*.msgpack"))) == 2
+
+    def test_single_shard_url(self, tmp_path, tokenizer):
+        from dalle_tpu.data import remote
+
+        cfg = tiny_model_config()
+        _make_shards(tmp_path, cfg, n_shards=1, per_shard=8)
+        cache = tmp_path / "cache2"
+        openers = remote.resolve_shards(
+            f"file://{tmp_path}/shard_0.msgpack", cache_dir=str(cache))
+        assert len(openers) == 1
+        local = openers[0]()
+        assert local.startswith(str(cache))
+        ds = CodesDataset(local, cfg, tokenizer=tokenizer, shuffle_buffer=4)
+        assert list(ds.batches(4, seed=0, loop=False))
+
+
+class TestRemoteSink:
+    def test_dir_sink_uploads_atomically(self, tmp_path):
+        from dalle_tpu.training.remote_sink import RemoteSink
+
+        src = tmp_path / "ckpt_00000004.msgpack"
+        src.write_bytes(b"state-bytes")
+        dest = tmp_path / "mock-remote"
+        sink = RemoteSink.create(f"file://{dest}")
+        assert sink.upload(str(src))
+        assert (dest / "ckpt_00000004.msgpack").read_bytes() == b"state-bytes"
+        # overwrite-on-newer works (the aux re-archives each cadence)
+        src.write_bytes(b"newer")
+        assert sink.upload(str(src))
+        assert (dest / "ckpt_00000004.msgpack").read_bytes() == b"newer"
+
+    def test_unreachable_command_sink_fails_soft(self, tmp_path):
+        from dalle_tpu.training.remote_sink import _CommandSink
+
+        src = tmp_path / "x.msgpack"
+        src.write_bytes(b"y")
+        # a missing transfer tool (and, via timeout, a hung one) must log
+        # and return False, never raise or stall the aux loop
+        sink = _CommandSink(["/nonexistent-transfer-tool"],
+                            "remote:/prefix", timeout=5.0)
+        assert sink.upload(str(src)) is False
