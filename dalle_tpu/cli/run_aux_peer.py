@@ -110,6 +110,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if aux.checkpoint_dir:
         from dalle_tpu.training.checkpoint import CheckpointManager
         ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
+    if aux.assist_in_averaging:
+        # the reference declares-but-stubs this mode
+        # (run_aux_peer.py:99-104 raises NotImplementedError); explicit
+        # out-of-scope parity rather than silent absence
+        logger.warning("assist_in_averaging is a declared-but-stubbed "
+                       "reference mode; ignoring")
     from dalle_tpu.training.remote_sink import RemoteSink
     remote_sink = RemoteSink.create(args.archive_remote)
     if remote_sink is not None and ckpt_mgr is None:
@@ -168,9 +174,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     last_archived = epoch
                     logger.info("archived swarm state at epoch %d", epoch)
                     if remote_sink is not None:
-                        if remote_sink.upload(saved_path):
-                            logger.info("uploaded %s to %s",
-                                        saved_path, args.archive_remote)
+                        # background upload: a slow/hung transfer must not
+                        # stall the swarm's only monitoring writer (the
+                        # sink is best-effort by contract)
+                        import threading
+
+                        def _upload(path=saved_path):
+                            if remote_sink.upload(path):
+                                logger.info("uploaded %s to %s", path,
+                                            args.archive_remote)
+
+                        threading.Thread(target=_upload,
+                                         daemon=True).start()
                 else:
                     logger.warning("state archive pull failed this round")
     if wandb_run is not None:

@@ -269,6 +269,10 @@ class AuxConfig:
     checkpoint_dir: Optional[str] = None
     upload_interval: Optional[float] = None
     store_checkpoints: bool = True
+    # Parity-with-a-stub: the reference DECLARES an aux averaging-assist
+    # mode but its implementation raises NotImplementedError
+    # (run_aux_peer.py:99-104) — deliberately out of scope here too; the
+    # flag exists so configs round-trip, and the aux CLI warns if set.
     assist_in_averaging: bool = False
 
 

@@ -73,10 +73,14 @@ def cached_fetch(url: str, cache_dir: str = None) -> str:
     path = os.path.join(cache_dir, name)
     if os.path.exists(path):
         return path
-    # per-process temp name: co-located peers fetching the same shard
-    # must not interleave writes into one tmp inode; whoever finishes
-    # last wins the atomic rename with a complete file either way
-    tmp = f"{path}.{os.getpid()}.tmp"
+    # unique temp file per fetcher (tempfile.mkstemp): concurrent
+    # processes AND threads racing on the same shard each write their own
+    # inode; whoever finishes last wins the atomic rename with a complete
+    # file either way
+    import tempfile
+    fd, tmp = tempfile.mkstemp(dir=cache_dir,
+                               prefix="." + name + ".", suffix=".tmp")
+    os.close(fd)
     try:
         _fetch_to(url, tmp)
         os.replace(tmp, path)

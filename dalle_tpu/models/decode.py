@@ -97,11 +97,32 @@ def n_cache_slots(cfg: ModelConfig) -> int:
 
 
 def init_cache(cfg: ModelConfig, batch: int, dtype=None):
-    """Static-shape KV cache: one (B, T, H, d) k/v pair per layer
-    application (weight sharing shares parameters, not activations)."""
+    """Static-shape KV cache, one k/v pair per layer application (weight
+    sharing shares parameters, not activations).
+
+    Layout: heads and head_dim are MERGED into the minor axis (B, T, H*d)
+    — with d=64 a (..., H, 64) layout pads every (8, 128) TPU tile 2x,
+    which at the flagship's 16-image decode doubles a 5 GB cache
+    (measured: the unmerged layout put decode 15 GB past HBM). The
+    cycle-structured decode also splits the scanned body from the w_conv
+    slot so the scan carries its cache without slicing a big array.
+    """
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (n_cache_slots(cfg), batch, cfg.total_seq_len, cfg.heads,
-             cfg.head_dim)
+    hd = cfg.heads * cfg.head_dim
+    reps = _cycle_reps(cfg)
+    if reps:
+        cycle = cfg.shared_block_cycle
+        out = {
+            "k_body": jnp.zeros((reps, cycle, batch, cfg.total_seq_len, hd),
+                                dtype),
+            "v_body": jnp.zeros((reps, cycle, batch, cfg.total_seq_len, hd),
+                                dtype),
+        }
+        if cfg.final_conv_block:
+            out["k_conv"] = jnp.zeros((batch, cfg.total_seq_len, hd), dtype)
+            out["v_conv"] = jnp.zeros((batch, cfg.total_seq_len, hd), dtype)
+        return out
+    shape = (n_cache_slots(cfg), batch, cfg.total_seq_len, hd)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -125,9 +146,10 @@ def _positional_table(params: Dict, cfg: ModelConfig) -> jax.Array:
 def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
                  cfg: ModelConfig, dtype):
     """One cached block application: (B, dim) -> (B, dim) plus the block's
-    updated (B, T, H, d) cache pair. The incremental mirror of
-    transformer.TransformerBlock."""
+    updated (B, T, H*d) cache pair (merged minor axis — see init_cache).
+    The incremental mirror of transformer.TransformerBlock."""
     b = x.shape[0]
+    t_total = k_cache.shape[1]
     h = _ln(x, lp["attn_norm"], dtype)
     q = (h @ lp["attn"]["q"]["kernel"].astype(dtype)).reshape(
         b, cfg.heads, cfg.head_dim)
@@ -139,17 +161,19 @@ def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
         q = apply_rotary(q, cos_p[None, None, :], sin_p[None, None, :])
         k = apply_rotary(k, cos_p[None, None, :], sin_p[None, None, :])
     k_cache = jax.lax.dynamic_update_index_in_dim(
-        k_cache, k.astype(k_cache.dtype), pos, axis=1)
+        k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos, axis=1)
     v_cache = jax.lax.dynamic_update_index_in_dim(
-        v_cache, v.astype(v_cache.dtype), pos, axis=1)
+        v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos, axis=1)
 
     scale = cfg.head_dim ** -0.5
-    scores = jnp.einsum("bhd,bthd->bht", q, k_cache.astype(dtype),
+    k_view = k_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
+    v_view = v_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
+    scores = jnp.einsum("bhd,bthd->bht", q, k_view.astype(dtype),
                         preferred_element_type=jnp.float32) * scale
     scores = jnp.where(mask_row[None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum("bht,bthd->bhd", probs.astype(dtype),
-                     v_cache.astype(dtype),
+                     v_view.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
     attn_out = ctx.reshape(b, cfg.dim) @ \
         lp["attn"]["out"]["kernel"].astype(dtype)
@@ -205,13 +229,17 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                                cfg.conv_kernel)
             for u in range(cycle)]))
 
-        body_k = cache["k"][:reps * cycle].reshape(
-            reps, cycle, *cache["k"].shape[1:])
-        body_v = cache["v"][:reps * cycle].reshape(
-            reps, cycle, *cache["v"].shape[1:])
-
-        def rep_body(x, xs):
-            k_slice, v_slice, it = xs
+        # The body cache rides the scan CARRY with per-iteration
+        # dynamic-update-slice: XLA aliases while-loop carry buffers in
+        # place, so the flagship's multi-GB cache exists ONCE — carrying
+        # it as xs/ys double-buffers the whole array (measured 2x 5 GB
+        # per k/v at the 16-image decode).
+        def rep_body(carry, it):
+            x, ck, cv = carry
+            k_slice = jax.lax.dynamic_index_in_dim(ck, it, 0,
+                                                   keepdims=False)
+            v_slice = jax.lax.dynamic_index_in_dim(cv, it, 0,
+                                                   keepdims=False)
             new_k, new_v = [], []
             for uid in range(cycle):
                 y, k_new, v_new = _apply_block(
@@ -225,22 +253,24 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                 x = jnp.where(active, y, x)
                 new_k.append(k_new)
                 new_v.append(v_new)
-            return x, (jnp.stack(new_k), jnp.stack(new_v))
+            ck = jax.lax.dynamic_update_index_in_dim(
+                ck, jnp.stack(new_k), it, 0)
+            cv = jax.lax.dynamic_update_index_in_dim(
+                cv, jnp.stack(new_v), it, 0)
+            return (x, ck, cv), None
 
-        x, (body_k, body_v) = jax.lax.scan(
-            rep_body, x, (body_k, body_v, jnp.arange(reps)))
-        new_k = [body_k.reshape(reps * cycle, *body_k.shape[2:])]
-        new_v = [body_v.reshape(reps * cycle, *body_v.shape[2:])]
+        (x, body_k, body_v), _ = jax.lax.scan(
+            rep_body, (x, cache["k_body"], cache["v_body"]),
+            jnp.arange(reps))
+        cache = dict(cache, k_body=body_k, v_body=body_v)
         if cfg.final_conv_block:
             mask = jnp.asarray(zoo_attention_mask(
                 "conv_like", cfg.text_seq_len, cfg.image_grid,
                 cfg.conv_kernel))
             x, k_new, v_new = _apply_block(
-                x, blocks["block_wconv"], mask[pos], cache["k"][-1],
-                cache["v"][-1], pos, cos_p, sin_p, cfg, dtype)
-            new_k.append(k_new[None])
-            new_v.append(v_new[None])
-        cache = {"k": jnp.concatenate(new_k), "v": jnp.concatenate(new_v)}
+                x, blocks["block_wconv"], mask[pos], cache["k_conv"],
+                cache["v_conv"], pos, cos_p, sin_p, cfg, dtype)
+            cache = dict(cache, k_conv=k_new, v_conv=v_new)
     else:
         layers = layer_params(params, cfg)
         masks = jnp.asarray(_mask_stack(cfg))

@@ -45,9 +45,9 @@ class RemoteSink:
             return _CommandSink(["gsutil", "-q", "cp"], dest)
         if dest.startswith("rsync://") or (":" in dest.split("/", 1)[0]
                                            and "@" in dest):
-            target = dest[len("rsync://"):] if dest.startswith("rsync://") \
-                else dest
-            return _CommandSink(["rsync", "-q"], target)
+            # rsync accepts rsync:// daemon URLs and user@host:path
+            # specs natively — pass through verbatim
+            return _CommandSink(["rsync", "-q"], dest)
         return _DirSink(dest)
 
 

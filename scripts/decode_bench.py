@@ -1,0 +1,69 @@
+"""Flagship decode bench (VERDICT r2 next #5): compile time + images/min
+for the reference's generation workload (inference/run_inference.py:
+87-90,132 generates 16 images x 8 iterations per query).
+
+Run on the TPU host:  python scripts/decode_bench.py [batch] [iters]
+
+Measured r3 (one v5e via tunnel), decode restructured as a lax.scan over
+the 4 weight-shared blocks with the KV cache as an in-place carry and a
+128-clean (B, T, H*d) layout:
+
+  - compile+first query: ~55 s (the r2 Python-unrolled depth-64 body was
+    never compilable at flagship scale; the unmerged cache layout alone
+    needed 31 GB HBM)
+  - steady state: B=2 -> 8.8 s/query; B=4 -> 15.1 s/query = 15.9 img/min
+  - B >= 8 reproducibly faults this tunnel's TPU worker mid-execution
+    (memory analysis says 6.2 GiB temp at B=16 — an environment wall,
+    not an HBM one); on direct-attached chips larger batches should
+    amortize further.
+
+Decode is KV-cache-bandwidth-bound: per position every layer reads the
+full static-length cache. Headroom: prefix-bucketed cache reads and
+removing the per-repetition cache-slice copies (~2x traffic).
+"""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from dalle_tpu.config import flagship_model_config  # noqa: E402
+from dalle_tpu.models.dalle import DALLE, init_params  # noqa: E402
+from dalle_tpu.models.decode import (SamplingConfig,  # noqa: E402
+                                     generate_images)
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    cfg = flagship_model_config(param_dtype="bfloat16")
+    model = DALLE(cfg)
+    params = init_params(model, jax.random.PRNGKey(0))
+    text = jnp.ones((b, cfg.text_seq_len), jnp.int32)
+    gen = jax.jit(lambda p, t, r: generate_images(
+        p, cfg, t, r, SamplingConfig(temperature=1.0, top_k=64)))
+
+    t0 = time.time()
+    jax.device_get(gen(params, text, jax.random.PRNGKey(1)))
+    print(f"compile+first: {time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    for i in range(iters):
+        # serialize queries: device_get per call (async-queuing several
+        # multi-GB cache allocations destabilizes the tunnel worker)
+        codes = jax.device_get(gen(params, text,
+                                   jax.random.PRNGKey(2 + i)))
+    dt = time.time() - t0
+    ok = bool((codes >= 0).all() and (codes < 8192).all())
+    print(f"B={b}: {dt / iters:.1f}s/query -> {b * iters / dt * 60:.1f} "
+          f"img/min (codes valid: {ok})")
+
+
+if __name__ == "__main__":
+    main()
