@@ -20,4 +20,5 @@ from dalle_tpu.config import (  # noqa: F401
     TrainerConfig,
     flagship_model_config,
     tiny_model_config,
+    xl_model_config,
 )

@@ -116,7 +116,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # out-of-scope parity rather than silent absence
         logger.warning("assist_in_averaging is a declared-but-stubbed "
                        "reference mode; ignoring")
-    from dalle_tpu.training.remote_sink import RemoteSink
+    from dalle_tpu.training.remote_sink import RemoteSink, UploadWorker
     remote_sink = RemoteSink.create(args.archive_remote)
     if remote_sink is not None and ckpt_mgr is None:
         logger.warning(
@@ -124,6 +124,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "archive is what gets uploaded): remote archiving is OFF",
             args.archive_remote)
         remote_sink = None
+    # one worker + 1-slot latest-wins queue: a slow/hung transfer never
+    # stalls the swarm's only monitoring writer, never piles up threads,
+    # and the final upload is drained at shutdown
+    uploader = UploadWorker(remote_sink, args.archive_remote) \
+        if remote_sink is not None else None
 
     wandb_run = None
     if args.wandb_project:
@@ -173,21 +178,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     saved_path = ckpt_mgr.save(state, epoch, backup=True)
                     last_archived = epoch
                     logger.info("archived swarm state at epoch %d", epoch)
-                    if remote_sink is not None:
-                        # background upload: a slow/hung transfer must not
-                        # stall the swarm's only monitoring writer (the
-                        # sink is best-effort by contract)
-                        import threading
-
-                        def _upload(path=saved_path):
-                            if remote_sink.upload(path):
-                                logger.info("uploaded %s to %s", path,
-                                            args.archive_remote)
-
-                        threading.Thread(target=_upload,
-                                         daemon=True).start()
+                    if uploader is not None:
+                        uploader.submit(saved_path)
                 else:
                     logger.warning("state archive pull failed this round")
+    if uploader is not None:
+        uploader.close()  # drain the freshest upload before exiting
     if wandb_run is not None:
         wandb_run.finish()
     return 0

@@ -24,7 +24,8 @@ from typing import Optional, Sequence
 
 from dalle_tpu.config import (CollabConfig, ModelConfig, OptimizerConfig,
                               PeerConfig, TrainerConfig,
-                              flagship_model_config, tiny_model_config)
+                              flagship_model_config, tiny_model_config,
+                              xl_model_config)
 from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
                                  dataclass_from_args)
 
@@ -35,6 +36,8 @@ MODEL_PRESETS = {
     # the same object bench.py measures (config.FLAGSHIP_TUNED)
     "flagship": flagship_model_config,
     "tiny": tiny_model_config,                # CPU smoke shape
+    # DALL-E-XL ~3B for pod-slice peers (BASELINE.json config 5)
+    "xl": xl_model_config,
 }
 
 CONFIG_CLASSES = (ModelConfig, OptimizerConfig, TrainerConfig, CollabConfig,

@@ -307,6 +307,20 @@ def flagship_model_config(**overrides: Any) -> ModelConfig:
     return dataclasses.replace(ModelConfig(), **base)
 
 
+def xl_model_config(**overrides: Any) -> ModelConfig:
+    """DALL-E-XL ~3B (BASELINE.json config 5): dim 1792, depth 64 with the
+    same 4-block weight sharing, 28 heads x 64, VQGAN-f16 tokens (16384-code
+    codebook; 512px images -> 32x32 codes). Sized for pod-slice peers
+    (v5p-64 in the north star) — one v5e chip cannot hold its state; train
+    it with fsdp/tp over a mesh (``parallel/sharding.py``).
+    """
+    base = dict(dim=1792, heads=28, head_dim=64,
+                vocab_image=16384, image_grid=32,
+                remat_skip_blocks=0, head_chunk=2048, scan_unroll=2)
+    base.update(overrides)
+    return dataclasses.replace(ModelConfig(), **base)
+
+
 def long_context_model_config(**overrides: Any) -> ModelConfig:
     """Long-sequence variant: a 64x64 code grid (4096 image tokens, e.g.
     512px images under an f8 VQGAN) with full-causal layers sharded over the

@@ -81,6 +81,11 @@ def cached_fetch(url: str, cache_dir: str = None) -> str:
     fd, tmp = tempfile.mkstemp(dir=cache_dir,
                                prefix="." + name + ".", suffix=".tmp")
     os.close(fd)
+    # mkstemp creates 0600; restore umask-governed permissions so
+    # co-located peers under other users can read the shared cache
+    umask = os.umask(0)
+    os.umask(umask)
+    os.chmod(tmp, 0o666 & ~umask)
     try:
         _fetch_to(url, tmp)
         os.replace(tmp, path)

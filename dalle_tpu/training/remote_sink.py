@@ -72,6 +72,50 @@ class _DirSink(RemoteSink):
             return False
 
 
+class UploadWorker:
+    """One background uploader with a 1-slot latest-wins queue.
+
+    The aux peer is the swarm's single monitoring writer: uploads must not
+    block its loop, must not pile up threads when the destination hangs,
+    and the FRESHEST checkpoint must still be drained at shutdown. A
+    submit while a transfer is in flight simply replaces the pending slot
+    (older checkpoints are superseded anyway).
+    """
+
+    def __init__(self, sink: RemoteSink, dest: str):
+        import threading
+
+        self.sink = sink
+        self.dest = dest
+        self._cv = threading.Condition()
+        self._pending: Optional[str] = None
+        self._closing = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, path: str) -> None:
+        with self._cv:
+            self._pending = path
+            self._cv.notify()
+
+    def close(self, timeout: float = 660.0) -> None:
+        with self._cv:
+            self._closing = True
+            self._cv.notify()
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closing:
+                    self._cv.wait()
+                path, self._pending = self._pending, None
+                if path is None and self._closing:
+                    return
+            if self.sink.upload(path):
+                logger.info("uploaded %s to %s", path, self.dest)
+
+
 class _CommandSink(RemoteSink):
     """Upload via an external transfer tool (gsutil / rsync)."""
 

@@ -57,9 +57,14 @@ def main():
         res = subprocess.run([sys.executable, __file__, "--one", str(sp)],
                              env=env, capture_output=True, text=True,
                              timeout=600)
-        line = [ln for ln in res.stdout.splitlines()
-                if ln.startswith("{")][-1]
-        r = json.loads(line)
+        lines = [ln for ln in res.stdout.splitlines()
+                 if ln.startswith("{")]
+        if res.returncode != 0 or not lines:
+            print(res.stdout[-2000:], file=sys.stderr)
+            print(res.stderr[-2000:], file=sys.stderr)
+            raise RuntimeError(f"sp={sp} child failed "
+                               f"(rc={res.returncode})")
+        r = json.loads(lines[-1])
         # cost_analysis reports the per-device SPMD program
         flops, bytes_ = r["flops"], r["bytes"]
         if base is None:

@@ -222,3 +222,30 @@ class TestRemoteSink:
         sink = _CommandSink(["/nonexistent-transfer-tool"],
                             "remote:/prefix", timeout=5.0)
         assert sink.upload(str(src)) is False
+
+
+class TestUploadWorker:
+    def test_latest_wins_and_drains_on_close(self, tmp_path):
+        import time as _time
+
+        from dalle_tpu.training.remote_sink import RemoteSink, UploadWorker
+
+        dest = tmp_path / "remote"
+        sink = RemoteSink.create(str(dest))
+        slow = []
+
+        class SlowSink:
+            def upload(self, path):
+                _time.sleep(0.2)
+                slow.append(path)
+                return sink.upload(path)
+
+        w = UploadWorker(SlowSink(), str(dest))
+        for i in range(5):  # rapid submits: intermediates are superseded
+            p = tmp_path / f"ckpt_{i}.msgpack"
+            p.write_bytes(b"v%d" % i)
+            w.submit(str(p))
+        w.close()
+        # the FRESHEST checkpoint always lands; stale ones may be skipped
+        assert (dest / "ckpt_4.msgpack").read_bytes() == b"v4"
+        assert len(slow) <= 3, slow
