@@ -148,7 +148,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     last_archived = -1
     rounds = 0
-    with task:
+    try:
+      with task:
         banner(task)
         while args.max_rounds is None or rounds < args.max_rounds:
             rounds += 1
@@ -182,10 +183,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         uploader.submit(saved_path)
                 else:
                     logger.warning("state archive pull failed this round")
-    if uploader is not None:
-        uploader.close()  # drain the freshest upload before exiting
-    if wandb_run is not None:
-        wandb_run.finish()
+    finally:
+        # drain the freshest upload and flush wandb even when the loop
+        # exits via KeyboardInterrupt / a DHT exception — the final
+        # checkpoint is the one most worth having remotely
+        if uploader is not None:
+            uploader.close()
+        if wandb_run is not None:
+            wandb_run.finish()
     return 0
 
 

@@ -30,6 +30,10 @@ from typing import Callable, List
 DEFAULT_CACHE = os.path.expanduser("~/.cache/dalle_tpu/shards")
 SHARD_SUFFIXES = (".msgpack", ".shard")
 
+# snapshot once: os.umask is process-global and write-to-read
+_UMASK = os.umask(0o022)
+os.umask(_UMASK)
+
 
 def is_url(ref: str) -> bool:
     return "://" in ref
@@ -83,9 +87,9 @@ def cached_fetch(url: str, cache_dir: str = None) -> str:
     os.close(fd)
     # mkstemp creates 0600; restore umask-governed permissions so
     # co-located peers under other users can read the shared cache
-    umask = os.umask(0)
-    os.umask(umask)
-    os.chmod(tmp, 0o666 & ~umask)
+    # (_UMASK read once at import: toggling the process umask per call
+    # races with concurrent fetcher threads)
+    os.chmod(tmp, 0o666 & ~_UMASK)
     try:
         _fetch_to(url, tmp)
         os.replace(tmp, path)

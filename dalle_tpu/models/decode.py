@@ -143,13 +143,9 @@ def _positional_table(params: Dict, cfg: ModelConfig) -> jax.Array:
     return jnp.concatenate([root["text_pos_emb"], img_pos], axis=0)
 
 
-def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
-                 cfg: ModelConfig, dtype):
-    """One cached block application: (B, dim) -> (B, dim) plus the block's
-    updated (B, T, H*d) cache pair (merged minor axis — see init_cache).
-    The incremental mirror of transformer.TransformerBlock."""
+def _qkv_rows(x, lp, cos_p, sin_p, cfg: ModelConfig, dtype):
+    """The block's q/k/v rows for the current position: (B, H, d) each."""
     b = x.shape[0]
-    t_total = k_cache.shape[1]
     h = _ln(x, lp["attn_norm"], dtype)
     q = (h @ lp["attn"]["q"]["kernel"].astype(dtype)).reshape(
         b, cfg.heads, cfg.head_dim)
@@ -160,11 +156,14 @@ def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
     if cfg.rotary:
         q = apply_rotary(q, cos_p[None, None, :], sin_p[None, None, :])
         k = apply_rotary(k, cos_p[None, None, :], sin_p[None, None, :])
-    k_cache = jax.lax.dynamic_update_index_in_dim(
-        k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos, axis=1)
-    v_cache = jax.lax.dynamic_update_index_in_dim(
-        v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos, axis=1)
+    return q, k, v
 
+
+def _attend_and_ff(x, lp, q, k_cache, v_cache, mask_row,
+                   cfg: ModelConfig, dtype):
+    """Attention of the current row over the block's (B, T, H*d) cache,
+    out-projection, and the GEGLU FF: (B, dim) -> (B, dim)."""
+    b, t_total = k_cache.shape[0], k_cache.shape[1]
     scale = cfg.head_dim ** -0.5
     k_view = k_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
     v_view = v_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
@@ -183,7 +182,22 @@ def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
     wi = h @ lp["ff"]["wi"]["kernel"].astype(dtype)
     gate = h @ lp["ff"]["gate"]["kernel"].astype(dtype)
     ff = (wi * jax.nn.gelu(gate)) @ lp["ff"]["wo"]["kernel"].astype(dtype)
-    return x + ff, k_cache, v_cache
+    return x + ff
+
+
+def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
+                 cfg: ModelConfig, dtype):
+    """One cached block application: (B, dim) -> (B, dim) plus the block's
+    updated (B, T, H*d) cache pair (merged minor axis — see init_cache).
+    The incremental mirror of transformer.TransformerBlock."""
+    b = x.shape[0]
+    q, k, v = _qkv_rows(x, lp, cos_p, sin_p, cfg, dtype)
+    k_cache = jax.lax.dynamic_update_index_in_dim(
+        k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_index_in_dim(
+        v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos, axis=1)
+    return (_attend_and_ff(x, lp, q, k_cache, v_cache, mask_row, cfg,
+                           dtype), k_cache, v_cache)
 
 
 def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
@@ -229,34 +243,40 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                                cfg.conv_kernel)
             for u in range(cycle)]))
 
-        # The body cache rides the scan CARRY with per-iteration
-        # dynamic-update-slice: XLA aliases while-loop carry buffers in
-        # place, so the flagship's multi-GB cache exists ONCE — carrying
-        # it as xs/ys double-buffers the whole array (measured 2x 5 GB
-        # per k/v at the 16-image decode).
+        # The body cache rides the scan CARRY with ROW-granular updates:
+        # XLA aliases while-loop carry buffers in place, so the
+        # flagship's multi-GB cache exists ONCE (as xs/ys it
+        # double-buffers the whole array — measured 2x 5 GB per k/v at
+        # the 16-image decode), and each block application writes only
+        # its new (B, H*d) row and reads only its own (B, T, H*d) block
+        # — an earlier version rewrote a whole (cycle, B, T, H*d) rep
+        # slice per position, ~4x the necessary cache traffic.
+        b = x.shape[0]
+        hd = cfg.dim
+
         def rep_body(carry, it):
             x, ck, cv = carry
-            k_slice = jax.lax.dynamic_index_in_dim(ck, it, 0,
-                                                   keepdims=False)
-            v_slice = jax.lax.dynamic_index_in_dim(cv, it, 0,
-                                                   keepdims=False)
-            new_k, new_v = [], []
             for uid in range(cycle):
-                y, k_new, v_new = _apply_block(
-                    x, blocks[f"block_{uid}"], uid_masks[uid][pos],
-                    k_slice[uid], v_slice[uid], pos, cos_p, sin_p,
-                    cfg, dtype)
+                lp = blocks[f"block_{uid}"]
+                q, k, v = _qkv_rows(x, lp, cos_p, sin_p, cfg, dtype)
+                start = (it, uid, 0, pos, 0)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.reshape(1, 1, b, 1, hd).astype(ck.dtype), start)
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.reshape(1, 1, b, 1, hd).astype(cv.dtype), start)
+                k_blk = jax.lax.dynamic_slice(
+                    ck, (it, uid, 0, 0, 0),
+                    (1, 1, b, t_total, hd)).reshape(b, t_total, hd)
+                v_blk = jax.lax.dynamic_slice(
+                    cv, (it, uid, 0, 0, 0),
+                    (1, 1, b, t_total, hd)).reshape(b, t_total, hd)
+                y = _attend_and_ff(x, lp, q, k_blk, v_blk,
+                                   uid_masks[uid][pos], cfg, dtype)
                 # same overhang masking as training's BlockCycle: the
                 # final repetition's surplus applications run but their
                 # outputs are discarded
                 active = it * cycle + uid < n_body
                 x = jnp.where(active, y, x)
-                new_k.append(k_new)
-                new_v.append(v_new)
-            ck = jax.lax.dynamic_update_index_in_dim(
-                ck, jnp.stack(new_k), it, 0)
-            cv = jax.lax.dynamic_update_index_in_dim(
-                cv, jnp.stack(new_v), it, 0)
             return (x, ck, cv), None
 
         (x, body_k, body_v), _ = jax.lax.scan(

@@ -5,21 +5,23 @@ for the reference's generation workload (inference/run_inference.py:
 Run on the TPU host:  python scripts/decode_bench.py [batch] [iters]
 
 Measured r3 (one v5e via tunnel), decode restructured as a lax.scan over
-the 4 weight-shared blocks with the KV cache as an in-place carry and a
-128-clean (B, T, H*d) layout:
+the 4 weight-shared blocks with the KV cache as an in-place carry in a
+128-clean (B, T, H*d) layout, ROW-granular writes and per-block reads
+(an earlier version rewrote a whole rep slice per position — ~4x the
+necessary cache traffic — and at B>=8 its slice storms faulted the
+tunnel's TPU worker):
 
-  - compile+first query: ~55 s (the r2 Python-unrolled depth-64 body was
-    never compilable at flagship scale; the unmerged cache layout alone
-    needed 31 GB HBM)
-  - steady state: B=2 -> 8.8 s/query; B=4 -> 15.1 s/query = 15.9 img/min
-  - B >= 8 reproducibly faults this tunnel's TPU worker mid-execution
-    (memory analysis says 6.2 GiB temp at B=16 — an environment wall,
-    not an HBM one); on direct-attached chips larger batches should
-    amortize further.
+  - compile+first query: ~42-83 s (the r2 Python-unrolled depth-64 body
+    was never compilable at flagship scale; the unmerged cache layout
+    alone needed 31 GB HBM)
+  - steady state: B=4 -> 9.3 s/query (25.9 img/min);
+    B=8 -> 14.5 s/query (33.0 img/min, the throughput sweet spot);
+    B=16 -> 44 s/query (21.8 img/min: cache reads dominate)
+  - the reference's 16x8=128-image query set: ~3.9 min at B=8.
 
 Decode is KV-cache-bandwidth-bound: per position every layer reads the
-full static-length cache. Headroom: prefix-bucketed cache reads and
-removing the per-repetition cache-slice copies (~2x traffic).
+full static-length cache. Remaining headroom: prefix-bucketed cache
+reads (~2x on average over the sequence).
 """
 
 import sys

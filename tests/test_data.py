@@ -246,6 +246,8 @@ class TestUploadWorker:
             p.write_bytes(b"v%d" % i)
             w.submit(str(p))
         w.close()
-        # the FRESHEST checkpoint always lands; stale ones may be skipped
+        # the deterministic guarantee: the FRESHEST checkpoint lands
+        # (intermediates may be superseded, but a loaded box can drain
+        # any number of them — no tight count bound)
         assert (dest / "ckpt_4.msgpack").read_bytes() == b"v4"
-        assert len(slow) <= 3, slow
+        assert len(slow) <= 5, slow
