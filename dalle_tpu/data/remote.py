@@ -30,9 +30,19 @@ from typing import Callable, List
 DEFAULT_CACHE = os.path.expanduser("~/.cache/dalle_tpu/shards")
 SHARD_SUFFIXES = (".msgpack", ".shard")
 
-# snapshot once: os.umask is process-global and write-to-read
-_UMASK = os.umask(0o022)
-os.umask(_UMASK)
+def _read_umask() -> int:
+    """The process umask without the racy os.umask write-to-read toggle
+    (another thread creating a file mid-toggle would get the wrong mode).
+    Linux exposes it in /proc/self/status; elsewhere fall back to a
+    conservative 0o022."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("Umask:"):
+                    return int(line.split()[1], 8)
+    except OSError:
+        pass
+    return 0o022
 
 
 def is_url(ref: str) -> bool:
@@ -87,9 +97,7 @@ def cached_fetch(url: str, cache_dir: str = None) -> str:
     os.close(fd)
     # mkstemp creates 0600; restore umask-governed permissions so
     # co-located peers under other users can read the shared cache
-    # (_UMASK read once at import: toggling the process umask per call
-    # races with concurrent fetcher threads)
-    os.chmod(tmp, 0o666 & ~_UMASK)
+    os.chmod(tmp, 0o666 & ~_read_umask())
     try:
         _fetch_to(url, tmp)
         os.replace(tmp, path)

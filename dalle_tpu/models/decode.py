@@ -186,28 +186,39 @@ def _attend_and_ff(x, lp, q, k_cache, v_cache, mask_row,
 
 
 def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
-                 cfg: ModelConfig, dtype):
+                 cfg: ModelConfig, dtype, vis: Optional[int] = None):
     """One cached block application: (B, dim) -> (B, dim) plus the block's
     updated (B, T, H*d) cache pair (merged minor axis — see init_cache).
-    The incremental mirror of transformer.TransformerBlock."""
+    The incremental mirror of transformer.TransformerBlock. ``vis``
+    statically truncates the attention's cache read (caller guarantees
+    pos < vis); the full-length cache pair is still returned."""
     b = x.shape[0]
     q, k, v = _qkv_rows(x, lp, cos_p, sin_p, cfg, dtype)
     k_cache = jax.lax.dynamic_update_index_in_dim(
         k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos, axis=1)
     v_cache = jax.lax.dynamic_update_index_in_dim(
         v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos, axis=1)
-    return (_attend_and_ff(x, lp, q, k_cache, v_cache, mask_row, cfg,
-                           dtype), k_cache, v_cache)
+    end = k_cache.shape[1] if vis is None else vis
+    y = _attend_and_ff(x, lp, q, k_cache[:, :end], v_cache[:, :end],
+                       mask_row[:end], cfg, dtype)
+    return y, k_cache, v_cache
 
 
 def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
-                input_ids: jax.Array, pos: jax.Array):
+                input_ids: jax.Array, pos: jax.Array,
+                visible: Optional[int] = None):
     """One cached decode step.
 
     input_ids: (B,) combined-vocabulary ids (BOS included) for position
     ``pos``; returns (logits over the FULL combined vocabulary at ``pos``,
     updated cache). Segment masking is applied (text positions only emit
     text ids, image positions image ids).
+
+    ``visible`` (STATIC) bounds the attention's cache read to positions
+    ``[0, visible)`` — callers that know ``pos < visible`` (the bucketed
+    ``generate_images``) skip streaming the dead tail of the cache, the
+    dominant cost of a bandwidth-bound decode. ``None`` reads the full
+    length.
 
     Cycle-structured schedules (the flagship's 4 weight-shared blocks
     x 16) run the body as ONE ``lax.scan`` over the repetitions — compile
@@ -219,6 +230,7 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     dtype = jnp.dtype(cfg.dtype)
     b = input_ids.shape[0]
     t_total = cfg.total_seq_len
+    vis = t_total if visible is None else min(visible, t_total)
 
     x = jnp.take(root["token_emb"], input_ids, axis=0)
     x = x + _positional_table(params, cfg)[pos]
@@ -266,12 +278,12 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                     cv, v.reshape(1, 1, b, 1, hd).astype(cv.dtype), start)
                 k_blk = jax.lax.dynamic_slice(
                     ck, (it, uid, 0, 0, 0),
-                    (1, 1, b, t_total, hd)).reshape(b, t_total, hd)
+                    (1, 1, b, vis, hd)).reshape(b, vis, hd)
                 v_blk = jax.lax.dynamic_slice(
                     cv, (it, uid, 0, 0, 0),
-                    (1, 1, b, t_total, hd)).reshape(b, t_total, hd)
+                    (1, 1, b, vis, hd)).reshape(b, vis, hd)
                 y = _attend_and_ff(x, lp, q, k_blk, v_blk,
-                                   uid_masks[uid][pos], cfg, dtype)
+                                   uid_masks[uid][pos, :vis], cfg, dtype)
                 # same overhang masking as training's BlockCycle: the
                 # final repetition's surplus applications run but their
                 # outputs are discarded
@@ -289,7 +301,7 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                 cfg.conv_kernel))
             x, k_new, v_new = _apply_block(
                 x, blocks["block_wconv"], mask[pos], cache["k_conv"],
-                cache["v_conv"], pos, cos_p, sin_p, cfg, dtype)
+                cache["v_conv"], pos, cos_p, sin_p, cfg, dtype, vis=vis)
             cache = dict(cache, k_conv=k_new, v_conv=v_new)
     else:
         layers = layer_params(params, cfg)
@@ -298,7 +310,7 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
         for li, lp in enumerate(layers):
             x, k_cache, v_cache = _apply_block(
                 x, lp, masks[li][pos], cache["k"][li], cache["v"][li],
-                pos, cos_p, sin_p, cfg, dtype)
+                pos, cos_p, sin_p, cfg, dtype, vis=vis)
             new_k.append(k_cache)
             new_v.append(v_cache)
         cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
@@ -346,38 +358,60 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
 
 def generate_images(params: Dict, cfg: ModelConfig,
                     text_tokens: jax.Array, rng: jax.Array,
-                    sampling: SamplingConfig = SamplingConfig()
-                    ) -> jax.Array:
+                    sampling: SamplingConfig = SamplingConfig(),
+                    buckets: int = 4) -> jax.Array:
     """Sample (B, image_seq_len) VQGAN codes for the given captions.
 
-    One ``lax.scan`` over all positions: the text prefix is teacher-forced,
-    image positions sample from the segment-masked logits (reference
-    ``generate_images(text, temperature, top_k, top_p, use_cache=True)``,
+    ``lax.scan`` over the positions — split into ``buckets`` prefix
+    buckets whose attention reads statically-truncated caches (see the
+    bucketing comment below; ``buckets=1`` is the single full-length
+    scan). The text prefix is teacher-forced, image positions sample from
+    the segment-masked logits (reference ``generate_images(text,
+    temperature, top_k, top_p, use_cache=True)``,
     inference/run_inference.py:88-89).
     """
     b = text_tokens.shape[0]
     bos_id = cfg.vocab_total
     cache = init_cache(cfg, b)
 
-    def step(carry, pos):
-        cache, cur_input, rng = carry
-        logits, cache = decode_step(params, cfg, cache, cur_input, pos)
-        rng, sub = jax.random.split(rng)
-        sampled = sample_logits(sub, logits, sampling)
-        # position pos emits S_pos, which is the input at pos+1:
-        # teacher-forced to the caption while pos is a text position,
-        # the sampled code once pos is in the image block
-        nxt = jnp.where(
-            pos < cfg.text_seq_len,
-            jnp.take(text_tokens,
-                     jnp.minimum(pos, cfg.text_seq_len - 1), axis=1),
-            sampled)
-        return (cache, nxt, rng), sampled
+    def make_step(visible):
+        def step(carry, pos):
+            cache, cur_input, rng = carry
+            logits, cache = decode_step(params, cfg, cache, cur_input, pos,
+                                        visible=visible)
+            rng, sub = jax.random.split(rng)
+            sampled = sample_logits(sub, logits, sampling)
+            # position pos emits S_pos, which is the input at pos+1:
+            # teacher-forced to the caption while pos is a text position,
+            # the sampled code once pos is in the image block
+            nxt = jnp.where(
+                pos < cfg.text_seq_len,
+                jnp.take(text_tokens,
+                         jnp.minimum(pos, cfg.text_seq_len - 1), axis=1),
+                sampled)
+            return (cache, nxt, rng), sampled
+        return step
 
+    # Prefix bucketing: decode is bandwidth-bound on the cache read, but
+    # positions in bucket [lo, hi) can only see cache rows [0, hi) — so
+    # each bucket's scan attends to a statically-truncated cache instead
+    # of streaming the dead tail (~1.6x less cache traffic at 4 buckets,
+    # for ~bucket-count x the step-body compile).
+    total = cfg.total_seq_len
+    n_buckets = max(1, min(int(buckets), total))
+    bounds = [round(total * (i + 1) / n_buckets) for i in range(n_buckets)]
     init_input = jnp.full((b,), bos_id, jnp.int32)
-    (cache, _, _), sampled = jax.lax.scan(
-        step, (cache, init_input, rng),
-        jnp.arange(cfg.total_seq_len))
+    carry = (cache, init_input, rng)
+    pieces = []
+    lo = 0
+    for hi in bounds:
+        if hi <= lo:
+            continue
+        carry, sampled = jax.lax.scan(
+            make_step(hi), carry, jnp.arange(lo, hi))
+        pieces.append(sampled)
+        lo = hi
+    sampled = jnp.concatenate(pieces, axis=0)
     # sampled[p] is the token emitted AT position p; image codes live at
     # positions text_seq_len..total; shift to (B, image_seq_len)
     codes = sampled[cfg.text_seq_len:].swapaxes(0, 1) - cfg.vocab_text

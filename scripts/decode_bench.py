@@ -11,17 +11,17 @@ the 4 weight-shared blocks with the KV cache as an in-place carry in a
 necessary cache traffic — and at B>=8 its slice storms faulted the
 tunnel's TPU worker):
 
-  - compile+first query: ~42-83 s (the r2 Python-unrolled depth-64 body
+  - compile+first query: ~42-81 s (the r2 Python-unrolled depth-64 body
     was never compilable at flagship scale; the unmerged cache layout
     alone needed 31 GB HBM)
-  - steady state: B=4 -> 9.3 s/query (25.9 img/min);
-    B=8 -> 14.5 s/query (33.0 img/min, the throughput sweet spot);
-    B=16 -> 44 s/query (21.8 img/min: cache reads dominate)
-  - the reference's 16x8=128-image query set: ~3.9 min at B=8.
+  - steady state with prefix bucketing (generate_images buckets=4):
+    B=8 -> 12.2 s/query (39.4 img/min, the throughput sweet spot);
+    B=16 -> 29.8 s/query (32.2 img/min)
+  - the reference's 16x8=128-image query set: ~3.3 min at B=8.
 
-Decode is KV-cache-bandwidth-bound: per position every layer reads the
-full static-length cache. Remaining headroom: prefix-bucketed cache
-reads (~2x on average over the sequence).
+Decode is KV-cache-bandwidth-bound: the r3 levers (row-granular carry
+updates; per-bucket statically-truncated cache reads) removed the
+avoidable traffic; what remains is the genuine prefix read.
 """
 
 import sys

@@ -120,3 +120,19 @@ class TestSampling:
                                    return_logits=True)
         pred = np.asarray(jnp.argmax(logits[:, cfg.text_seq_len:], -1))
         np.testing.assert_array_equal(pred - cfg.vocab_text, codes)
+
+def test_prefix_buckets_do_not_change_samples():
+    """Bucketed decode (statically truncated cache reads) must produce
+    the IDENTICAL sample sequence to the single full-length scan — the
+    truncation only skips cache rows the mask already forbids."""
+    cfg, model, params, text, image = _setup(
+        attn_types=("axial_row", "axial_col", "axial_row", "axial_row"),
+        depth=10, shared_block_cycle=4, final_conv_block=True,
+        conv_kernel=3)
+    from dalle_tpu.models.decode import SamplingConfig, generate_images
+
+    rng = jax.random.PRNGKey(11)
+    sam = SamplingConfig(temperature=1.0, top_k=8)
+    one = generate_images(params, cfg, text, rng, sam, buckets=1)
+    four = generate_images(params, cfg, text, rng, sam, buckets=4)
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(four))
