@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--clip-bpe", type=str, default=None,
         help="path to bpe_simple_vocab_16e6.txt.gz for CLIP tokenization")
+    parser.add_argument(
+        "--allow-unsafe-pickle", action="store_true",
+        help="permit torch's permissive pickle loader for VQGAN/CLIP "
+             "checkpoints the safe weights-only loader rejects; this "
+             "EXECUTES code from the file — only for checkpoints whose "
+             "origin you trust (utils/torch_io.py)")
     add_dataclass_args(parser, ModelConfig)
     return parser
 
@@ -115,7 +121,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         vq_cfg = VQGANConfig(n_embed=cfg.vocab_image,
                              resolution=cfg.image_grid * 8)
         vqgan = (jax.jit(lambda p, c: decode_codes(p, vq_cfg, c)),
-                 load_taming_checkpoint(args.vqgan_checkpoint, vq_cfg))
+                 load_taming_checkpoint(args.vqgan_checkpoint, vq_cfg,
+                                        allow_unsafe=args.allow_unsafe_pickle))
     if args.clip_checkpoint:
         if not (vqgan and args.clip_bpe):
             logger.error("--clip-checkpoint requires --vqgan-checkpoint "
@@ -129,7 +136,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         clip_bundle = (
             jax.jit(lambda p, im, tok: clip_scores(
                 p, cl_cfg, resize_for_clip(im, cl_cfg), tok)),
-            load_openai_checkpoint(args.clip_checkpoint, cl_cfg),
+            load_openai_checkpoint(args.clip_checkpoint, cl_cfg,
+                                   allow_unsafe=args.allow_unsafe_pickle),
             CLIPTokenizer(args.clip_bpe, cl_cfg.context_length))
 
     rng = jax.random.PRNGKey(args.seed)

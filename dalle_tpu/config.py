@@ -223,15 +223,25 @@ class CollabConfig:
     # feedback (swarm/powersgd.py; hivemind carries PowerSGD upstream,
     # SURVEY.md §2 component 15).
     size_adaptive_threshold: int = 2 ** 16 + 1
+    # NOTE: the tuned flagship operating point (FLAGSHIP_TUNED, PERF.md)
+    # was measured against the HBM wall with size_adaptive compression.
+    # power_sgd keeps device-resident f32 error-feedback + in-flight M
+    # caches at gradient size (~500 MB persistent + ~2x transient for the
+    # flagship's 125.6M unique params) — see PERF.md's PowerSGD footprint
+    # note before combining it with the tuned micro/accum point.
     grad_compression: str = "size_adaptive"
     state_compression: str = "size_adaptive"
     powersgd_rank: int = 4
     # Run PowerSGD's Gram-Schmidt on the host (bit-stable IEEE f32 loop
     # order) instead of on device. Cross-peer basis agreement needs every
     # group member to orthogonalize identical averaged bytes identically;
-    # device MGS guarantees that on a homogeneous backend (the normal
-    # fleet), host MGS also across deliberately mixed hardware.
-    powersgd_host_orthogonalize: bool = False
+    # device MGS guarantees that only on a homogeneous XLA backend, and a
+    # volunteer swarm is exactly where jax/XLA builds differ — divergent
+    # bases silently corrupt reconstructed gradients on every peer. Host
+    # MGS is bit-stable across peers and costs O(m*r^2) on a rank-4
+    # (m x 4) factor — noise next to the wire round-trip — so it is the
+    # DEFAULT; flip off only for a fleet known to run one backend build.
+    powersgd_host_orthogonalize: bool = True
     # AEAD-encrypt the all-reduce data plane under a per-round group key
     # distributed through the signed matchmaking confirmation
     # (swarm/crypto.py). The reference gets transport encryption from

@@ -249,9 +249,12 @@ def map_openai_state_dict(sd: Dict[str, Any],
     return {"params": p}
 
 
-def load_openai_checkpoint(path: str, cfg: CLIPConfig) -> Dict[str, Any]:
+def load_openai_checkpoint(path: str, cfg: CLIPConfig,
+                           allow_unsafe: bool = False) -> Dict[str, Any]:
     """Read an openai/CLIP checkpoint (torch .pt, jit archive or plain state
-    dict) and return Flax params (``clip.load("ViT-B/32")`` parity)."""
+    dict) and return Flax params (``clip.load("ViT-B/32")`` parity).
+    Non-jit pickle archives need ``allow_unsafe=True`` (see
+    utils/torch_io.py)."""
     import torch
 
     from dalle_tpu.utils.torch_io import torch_load_trusted
@@ -260,7 +263,7 @@ def load_openai_checkpoint(path: str, cfg: CLIPConfig) -> Dict[str, Any]:
         model = torch.jit.load(path, map_location="cpu")
         sd = model.state_dict()
     except RuntimeError:
-        ckpt = torch_load_trusted(path)
+        ckpt = torch_load_trusted(path, allow_unsafe=allow_unsafe)
         sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else (
             ckpt.state_dict())
     params = map_openai_state_dict(sd, cfg)

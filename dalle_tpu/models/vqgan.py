@@ -239,16 +239,19 @@ def map_taming_state_dict(sd: Dict[str, Any],
     return {"params": p}
 
 
-def load_taming_checkpoint(path: str, cfg: VQGANConfig) -> Dict[str, Any]:
+def load_taming_checkpoint(path: str, cfg: VQGANConfig,
+                           allow_unsafe: bool = False) -> Dict[str, Any]:
     """Read a taming-transformers ``.ckpt`` (torch) and return Flax params.
 
     Parity with ``inference/run_inference.py:122-124`` (``VQGanVAE(
     vqgan_model_path, vqgan_config_path)``). torch is used only as a
-    deserializer on the host; all compute stays in JAX.
+    deserializer on the host; all compute stays in JAX. Published
+    lightning-wrapped .ckpts need ``allow_unsafe=True`` (arbitrary-pickle
+    execution — see utils/torch_io.py).
     """
     from dalle_tpu.utils.torch_io import torch_load_trusted
 
-    ckpt = torch_load_trusted(path)
+    ckpt = torch_load_trusted(path, allow_unsafe=allow_unsafe)
     sd = ckpt.get("state_dict", ckpt)
     params = map_taming_state_dict(sd, cfg)
     return jax.tree.map(jnp.asarray, params)

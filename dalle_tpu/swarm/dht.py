@@ -281,12 +281,17 @@ class DHT:
         """Attach to a routable relay peer (reference libp2p relay /
         client_mode surface, arguments.py:89-124): keeps one persistent
         outbound connection over which the relay forwards tagged messages
-        and mailbox fetches to this (listener-less) peer."""
-        host, _, port = addr.rpartition(":")
+        and mailbox fetches to this (listener-less) peer.
+
+        Accepts a bare ``host:port`` or a relayed ``host:port/<peer id>``
+        entry (what the banner advertises as copyable ``--initial-peers``)
+        — attachment always targets the relay's own host:port component
+        (ADVICE r3: rpartition(':') choked on the /<peer id> suffix)."""
+        host, port, _ = self._parse_addr(addr)
         rc = self._lib.swarm_node_attach_relay(
-            self._node, host.encode(), int(port))
+            self._node, host.encode(), port)
         if rc == 0:
-            self._relay_addr = f"{host}:{int(port)}"
+            self._relay_addr = f"{host}:{port}"
         return rc == 0
 
     @staticmethod

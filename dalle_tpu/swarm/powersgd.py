@@ -23,13 +23,18 @@ reconstruction, and the error-feedback update — runs as jitted device ops
 kernels"); the error-feedback and M caches are device arrays, not host
 RAM. Only the rank-r factors (r*(m+n) floats per tensor, ~128x smaller
 than the gradients at the flagship's 1024x4096 blocks) cross to the host
-for the wire. Gram-Schmidt itself runs on device too (unrolled over the r
-columns); it is deterministic for identical input bytes on a given XLA
-backend, which is what cross-peer basis agreement needs — the butterfly's
-owner path makes the averaged-P bytes byte-identical across survivors
-(swarm/allreduce.py), and swarm peers run the same backend build. For a
-deliberately heterogeneous swarm, ``host_orthogonalize=True`` runs MGS on
-the host in plain IEEE f32 loop order instead.
+for the wire. Gram-Schmidt is the one exception, and it runs on the HOST
+by default (``host_orthogonalize=True``): cross-peer basis agreement
+needs every member to orthogonalize the identical averaged-P bytes
+identically, and device MGS only guarantees that on one homogeneous XLA
+backend build — a volunteer swarm (v4/v5e/CPU peers, mixed jax versions)
+is exactly where that assumption breaks, and divergent bases silently
+corrupt the reconstruction on every peer. Host MGS in plain IEEE f32
+loop order is bit-identical across peers and costs O(m*r^2) on a rank-4
+factor — noise next to the wire round-trip. The butterfly's owner path
+makes the averaged-P input bytes byte-identical across survivors
+(swarm/allreduce.py). ``host_orthogonalize=False`` keeps the whole phase
+on device for fleets pinned to one backend build.
 
 Cross-peer correctness hinges on every peer holding the identical Q basis
 in phase 2 and the identical averaged-P bytes in phase 4. Two design
@@ -187,7 +192,7 @@ class PowerSGDCompressor:
 
     def __init__(self, rank: int = 4, seed: int = 0,
                  min_ratio: float = MIN_COMPRESSION_RATIO,
-                 host_orthogonalize: bool = False):
+                 host_orthogonalize: bool = True):
         self.rank = rank
         self.seed = seed
         self.min_ratio = min_ratio

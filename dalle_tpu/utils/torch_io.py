@@ -4,21 +4,47 @@ stays in JAX)."""
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
+#: env escape hatch for non-CLI callers (tests, notebooks); the CLIs
+#: surface an explicit --allow-unsafe-pickle flag instead
+_UNSAFE_ENV = "DALLE_TPU_ALLOW_UNSAFE_PICKLE"
 
-def torch_load_trusted(path: str) -> Any:
-    """``torch.load`` preferring the safe tensor-only loader.
 
-    Falls back to the permissive pickle path only when the safe loader
-    rejects the archive (some published VQGAN/CLIP checkpoints carry
-    non-tensor pickles, e.g. pytorch-lightning wrappers). The permissive
-    path executes arbitrary pickled code: only call this on checkpoint
-    files you trust.
+class UnsafeCheckpointError(RuntimeError):
+    """The archive needs the permissive pickle loader, which executes
+    arbitrary code from the file, and the caller did not opt in."""
+
+
+def torch_load_trusted(path: str, allow_unsafe: bool = False) -> Any:
+    """``torch.load`` via the safe tensor-only loader.
+
+    Some published VQGAN/CLIP checkpoints carry non-tensor pickles
+    (e.g. pytorch-lightning wrappers) that the safe loader rejects;
+    loading those requires the permissive pickle path, which executes
+    arbitrary code from the archive. That path is gated: it runs only
+    with ``allow_unsafe=True`` (the CLIs' ``--allow-unsafe-pickle``) or
+    ``DALLE_TPU_ALLOW_UNSAFE_PICKLE=1`` in the environment — otherwise
+    an untrusted file that fails the safe loader fails LOUDLY with
+    :class:`UnsafeCheckpointError` instead of silently executing its
+    pickle (ADVICE r3).
     """
+    import pickle
+
     import torch
 
     try:
         return torch.load(path, map_location="cpu", weights_only=True)
-    except Exception:
+    except pickle.UnpicklingError as safe_err:
+        # Only the safe loader's REJECTION gates to the permissive path;
+        # missing files, truncated archives etc. propagate unchanged (the
+        # permissive loader would fail on those identically).
+        if not (allow_unsafe or os.environ.get(_UNSAFE_ENV) == "1"):
+            raise UnsafeCheckpointError(
+                f"{path} is rejected by torch's safe (weights_only) "
+                f"loader ({safe_err!r}); loading it requires executing "
+                f"pickled code from the file. Re-run with "
+                f"--allow-unsafe-pickle (or {_UNSAFE_ENV}=1) ONLY if you "
+                f"trust this checkpoint's origin.") from safe_err
         return torch.load(path, map_location="cpu", weights_only=False)

@@ -14,6 +14,7 @@
 
 #include <algorithm>
 #include <array>
+#include <cerrno>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -502,11 +503,34 @@ struct SwarmNode {
     return h;
   }
 
+  /* True if an idle pooled socket must not carry a new request: the peer
+   * closed it while pooled (FIN pending / error), or it has leftover
+   * unread bytes (desynced reply stream). */
+  static bool sock_stale(int fd) {
+    char b;
+    ssize_t k = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+    if (k >= 0) return true;  /* 0 = EOF; >0 = stray bytes */
+    return errno != EAGAIN && errno != EWOULDBLOCK;
+  }
+
+  /* Pure reads may be resent after a lost reply (a duplicate kPing /
+   * kFindNode / kFetch changes no peer state); mutating requests may NOT
+   * — kMsg/kStore/kRelaySend enqueue frames the all-reduce part exchange
+   * does not de-duplicate (ADVICE r3). */
+  static bool idempotent_type(uint8_t t) {
+    return t == kPing || t == kFindNode || t == kFindValue ||
+           t == kFetch || t == kRelayFetch;
+  }
+
   /* Build request = type || header || body, exchange over a POOLED
-   * connection (one persistent socket per endpoint; a stale pooled socket
-   * — peer closed it while idle — is detected by the failed exchange and
-   * retried once on a fresh connect). timeout_override_ms > 0 applies to
-   * this call only. */
+   * connection (one persistent socket per endpoint). A resend of a
+   * mutating request is safe ONLY while the server cannot have acted on
+   * it: stale pooled sockets are filtered by a pre-write probe; a failed
+   * (hence at most partial) write leaves the server a truncated frame it
+   * discards, so that falls through to a fresh connect; once write_frame
+   * has returned true, a read failure retries only idempotent_type()
+   * requests — for mutating ones it is a HARD failure, never resent.
+   * timeout_override_ms > 0 applies to this call only. */
   bool rpc(const std::string &host_, int port_, uint8_t type,
            const std::string &body, std::string *reply,
            int timeout_override_ms = 0) {
@@ -516,25 +540,36 @@ struct SwarmNode {
     req.push_back(char(type));
     req += header();
     req += body;
+    if (req.size() > kMaxFrame) return false;  /* doomed: keep the pool */
 
-    for (int attempt = 0; attempt < 2; ++attempt) {
-      bool from_pool = attempt == 0;
-      int fd = from_pool ? pool_acquire(host_, port_) : -1;
-      if (fd < 0) {
-        from_pool = false;
-        fd = connect_to(host_.c_str(), port_, ms);
-        if (fd < 0) return false;
-      } else {
-        set_timeouts(fd, ms);
+    int fd;
+    while ((fd = pool_acquire(host_, port_)) >= 0) {
+      if (sock_stale(fd)) {
+        close(fd);
+        continue;  /* try the next pooled fd for this endpoint */
+      }
+      set_timeouts(fd, ms);
+      if (!write_frame(fd, req)) {
+        close(fd);
+        break;  /* request not delivered: safe to go fresh below */
       }
       reply->clear();
-      bool ok = write_frame(fd, req) && read_frame(fd, reply) &&
-                !reply->empty();
-      pool_release(host_, port_, fd, ok);
-      if (ok) return true;
-      if (!from_pool) return false;  /* fresh connection failed: real */
+      if (read_frame(fd, reply) && !reply->empty()) {
+        pool_release(host_, port_, fd, true);
+        return true;
+      }
+      close(fd);
+      if (!idempotent_type(type)) return false;  /* may have been acted on */
+      break;  /* lost reply on a pure read: harmless to re-ask fresh */
     }
-    return false;
+
+    fd = connect_to(host_.c_str(), port_, ms);
+    if (fd < 0) return false;
+    reply->clear();
+    bool ok = write_frame(fd, req) && read_frame(fd, reply) &&
+              !reply->empty();
+    pool_release(host_, port_, fd, ok);
+    return ok;
   }
 
   void note_peer(const PeerInfo &p) { rt.update(p); }

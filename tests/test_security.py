@@ -301,3 +301,49 @@ class TestPerWriterQuota:
         finally:
             writer.shutdown()
             node.shutdown()
+
+
+class TestUnsafePickleGate:
+    """utils/torch_io.py (ADVICE r3): a checkpoint the safe weights-only
+    loader rejects must fail LOUDLY unless the caller explicitly opts
+    into executing its pickle."""
+
+    def _non_tensor_ckpt(self, tmp_path):
+        import argparse
+
+        import torch
+
+        path = tmp_path / "wrapped.ckpt"
+        # lightning-style wrapper object: rejected by weights_only=True
+        torch.save({"state_dict": {}, "hparams": argparse.Namespace(x=1)},
+                   str(path))
+        return str(path)
+
+    def test_rejected_without_optin(self, tmp_path, monkeypatch):
+        import pytest
+
+        from dalle_tpu.utils.torch_io import (UnsafeCheckpointError,
+                                              torch_load_trusted)
+
+        monkeypatch.delenv("DALLE_TPU_ALLOW_UNSAFE_PICKLE", raising=False)
+        path = self._non_tensor_ckpt(tmp_path)
+        with pytest.raises(UnsafeCheckpointError):
+            torch_load_trusted(path)
+
+    def test_flag_and_env_optins_load(self, tmp_path, monkeypatch):
+        from dalle_tpu.utils.torch_io import torch_load_trusted
+
+        path = self._non_tensor_ckpt(tmp_path)
+        assert torch_load_trusted(path, allow_unsafe=True)["hparams"].x == 1
+        monkeypatch.setenv("DALLE_TPU_ALLOW_UNSAFE_PICKLE", "1")
+        assert torch_load_trusted(path)["hparams"].x == 1
+
+    def test_safe_checkpoints_unaffected(self, tmp_path):
+        import torch
+
+        from dalle_tpu.utils.torch_io import torch_load_trusted
+
+        path = tmp_path / "plain.pt"
+        torch.save({"w": torch.zeros(2)}, str(path))
+        out = torch_load_trusted(str(path))
+        assert out["w"].shape == (2,)

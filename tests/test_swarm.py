@@ -329,6 +329,134 @@ class TestRelay:
                 n.shutdown()
 
 
+class TestRelayedAddressParsing:
+    def test_attach_relay_accepts_relayed_address(self):
+        """The banner advertises ``host:port/<peer id>`` as the copyable
+        --initial-peers entry; attach_relay must accept that form and
+        attach to the relay's host:port (ADVICE r3: rpartition(':')
+        raised ValueError on the suffix)."""
+        relay = DHT(rpc_timeout=2.0)
+        a = DHT(client_mode=True, rpc_timeout=2.0)
+        b = DHT(client_mode=True, rpc_timeout=2.0,
+                initial_peers=[relay.visible_address])
+        try:
+            relayed_form = f"{relay.visible_address}/{relay.peer_id}"
+            assert a.attach_relay(relayed_form)
+            assert b.attach_relay(relay.visible_address)
+            # the attachment is functional, not just rc==0
+            assert b.send(a.visible_address, 11, b"via-relay", timeout=3.0)
+            assert a.recv(11, timeout=3.0) == b"via-relay"
+        finally:
+            for n in (a, b, relay):
+                n.shutdown()
+
+
+def _frame_server(replies):
+    """Loopback fake endpoint speaking the daemon's u32-length framing.
+
+    ``replies`` maps the i-th received frame (across all connections) to
+    a reply payload, ``("reply_close", payload)`` (reply, then close the
+    connection cleanly — FIN reaches the client's pooled socket), or
+    ``None`` (swallow the request: the client's read times out).
+    Returns (port, frames, conns, closer).
+    """
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    frames, conns = [], []
+
+    def recv_exact(c, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = c.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def handle(c):
+        while True:
+            hdr = recv_exact(c, 4)
+            if hdr is None:
+                return
+            ln = int.from_bytes(hdr, "big")
+            payload = recv_exact(c, ln)
+            if payload is None:
+                return
+            idx = len(frames)
+            frames.append(payload)
+            action = replies.get(idx, None)
+            if isinstance(action, tuple) and action[0] == "reply_close":
+                c.sendall(len(action[1]).to_bytes(4, "big") + action[1])
+                c.close()  # handler exits: FIN lands while client idles
+                return
+            if action is not None:
+                c.sendall(len(action).to_bytes(4, "big") + action)
+
+    def accept_loop():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            conns.append(c)
+            threading.Thread(target=handle, args=(c,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return srv.getsockname()[1], frames, conns, srv.close
+
+
+MSG_OK = bytes([10])  # kMsgOk
+
+
+class TestPooledRpcSafety:
+    """ADVICE r3 (swarm.cc rpc retry): a resend is only safe while the
+    server cannot have acted on the request. These tests drive the
+    client's rpc() against a scripted fake endpoint."""
+
+    def test_lost_reply_is_hard_failure_no_duplicate(self):
+        """Reply lost AFTER the server consumed the request: the client
+        must fail the call without resending — kMsg is not idempotent
+        and the all-reduce part exchange does not de-duplicate."""
+        port, frames, conns, closer = _frame_server(
+            {0: MSG_OK, 1: None})  # swallow the 2nd request's reply
+        node = DHT(rpc_timeout=2.0)
+        try:
+            addr = f"127.0.0.1:{port}"
+            assert node.send(addr, 1, b"first", timeout=2.0)   # pools fd
+            assert not node.send(addr, 2, b"second", timeout=1.0)
+            time.sleep(1.5)  # a would-be retry fires within the timeout
+            assert len(frames) == 2, (
+                f"server saw {len(frames)} frames: lost-reply retry "
+                f"delivered a duplicate")
+        finally:
+            closer()
+            node.shutdown()
+
+    def test_stale_pooled_socket_reconnects(self):
+        """Server closed the pooled connection while idle: the pre-write
+        probe must detect the dead socket and the call must complete on
+        a fresh connection (exactly one delivery of each request)."""
+        port, frames, conns, closer = _frame_server(
+            {0: ("reply_close", MSG_OK), 1: MSG_OK})
+        node = DHT(rpc_timeout=2.0)
+        try:
+            addr = f"127.0.0.1:{port}"
+            assert node.send(addr, 1, b"first", timeout=2.0)
+            time.sleep(0.3)    # let the server's FIN land
+            assert node.send(addr, 2, b"second", timeout=2.0)
+            assert len(frames) == 2
+            assert len(conns) == 2  # second send went over a fresh fd
+        finally:
+            closer()
+            node.shutdown()
+
+
 class TestConnectionReuse:
     def test_many_rpcs_per_connection_latency(self):
         """The data plane keeps one pooled connection per endpoint (a TCP
