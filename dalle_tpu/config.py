@@ -94,6 +94,15 @@ class ModelConfig:
     # flagship step (profiled r3); unroll N divides it by N at the cost
     # of an N-times-larger compiled body.
     scan_unroll: int = 1
+    # Fused Pallas GEGLU feed-forward (ops/pallas/geglu_kernels.py): the
+    # (B*T, ff_mult*dim) intermediates stay in VMEM tiles and backward
+    # saves only the FF input. "plain" fuses the non-rematted blocks
+    # (remat_skip_blocks), where it cuts the FF autodiff residual from
+    # ~84 MB to ~10 MB per flagship apply at strictly fewer FLOPs than
+    # remat; "all" also fuses rematted blocks (their replay already
+    # avoids the residual, so this mostly trades FLOPs for HBM traffic);
+    # "none" keeps the unfused XLA lowering everywhere.
+    ff_fusion: str = "plain"
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
     # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"
@@ -113,6 +122,16 @@ class ModelConfig:
     @property
     def vocab_total(self) -> int:
         return self.vocab_text + self.vocab_image
+
+    def fuse_ff(self, is_plain: bool) -> bool:
+        """Whether a block routes its FF through the fused Pallas GEGLU
+        kernel: "all" fuses every block; "plain" fuses blocks whose
+        residuals are actually saved — the remat_skip (plain) blocks, or
+        everything when remat is off. ONE definition for both the scanned
+        and unrolled transformer paths."""
+        return (self.ff_fusion == "all"
+                or (self.ff_fusion == "plain"
+                    and (is_plain or not self.remat)))
 
     def layer_schedule(self) -> Tuple[Tuple[int, str], ...]:
         """(unique_block_id, attn_type) per layer.
@@ -146,6 +165,10 @@ class ModelConfig:
             raise ValueError(
                 f"remat_skip_blocks {self.remat_skip_blocks} outside "
                 f"[0, shared_block_cycle={self.shared_block_cycle}]")
+        if self.ff_fusion not in ("none", "plain", "all"):
+            raise ValueError(
+                f"unknown ff_fusion {self.ff_fusion!r}; "
+                "expected 'none', 'plain' or 'all'")
         if self.sequence_parallel not in VALID_SP_MODES:
             raise ValueError(
                 f"unknown sequence_parallel {self.sequence_parallel!r}; "

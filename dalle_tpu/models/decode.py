@@ -174,14 +174,24 @@ def _attend_and_ff(x, lp, q, k_cache, v_cache, mask_row,
     ctx = jnp.einsum("bht,bthd->bhd", probs.astype(dtype),
                      v_view.astype(dtype),
                      preferred_element_type=jnp.float32).astype(dtype)
-    attn_out = ctx.reshape(b, cfg.dim) @ \
-        lp["attn"]["out"]["kernel"].astype(dtype)
+    # q/k/v are bias-free (ZooAttention use_bias=False) but the OUT
+    # projection keeps nn.Dense's default bias — dropping it desyncs
+    # decode from trained checkpoints (invisible at zero-init)
+    attn_out = (ctx.reshape(b, cfg.dim)
+                @ lp["attn"]["out"]["kernel"].astype(dtype)
+                + lp["attn"]["out"]["bias"].astype(dtype))
     x = x + attn_out
 
     h = _ln(x, lp["ff_norm"], dtype)
-    wi = h @ lp["ff"]["wi"]["kernel"].astype(dtype)
-    gate = h @ lp["ff"]["gate"]["kernel"].astype(dtype)
-    ff = (wi * jax.nn.gelu(gate)) @ lp["ff"]["wo"]["kernel"].astype(dtype)
+    # biases match training's GEGLUFeedForward (nn.Dense defaults /
+    # dalle-pytorch's biased nn.Linear); dropping them here desyncs decode
+    # from any TRAINED checkpoint (invisible at zero-init)
+    wi = h @ lp["ff"]["wi"]["kernel"].astype(dtype) \
+        + lp["ff"]["wi"]["bias"].astype(dtype)
+    gate = h @ lp["ff"]["gate"]["kernel"].astype(dtype) \
+        + lp["ff"]["gate"]["bias"].astype(dtype)
+    ff = (wi * jax.nn.gelu(gate)) @ lp["ff"]["wo"]["kernel"].astype(dtype) \
+        + lp["ff"]["wo"]["bias"].astype(dtype)
     return x + ff
 
 

@@ -108,32 +108,83 @@ class ZooAttention(nn.Module):
                         param_dtype=_param_dtype(cfg), name="out")(out)
 
 
+class DenseKernel(nn.Module):
+    """Parameter-compatible stand-in for ``nn.Dense``: owns the identical
+    ``{name: {'kernel': (in, out), 'bias': (out,)}}`` param tree (same
+    init, same dtype) but returns the parameter VALUES so the caller can
+    feed them to a fused kernel — checkpoints trained either way
+    interchange. The FF keeps nn.Dense's default biases (dalle-pytorch's
+    FeedForward uses biased nn.Linear); attention stays bias-free."""
+
+    features: int
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (in_features, self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), self.param_dtype)
+        return kernel, bias
+
+
 class GEGLUFeedForward(nn.Module):
-    """GEGLU MLP (dalle-pytorch's FeedForward uses a GEGLU gate)."""
+    """GEGLU MLP (dalle-pytorch's FeedForward uses a GEGLU gate).
+
+    ``fuse`` routes through the Pallas fused kernel
+    (ops/pallas/geglu_kernels.py): the (B*T, inner) intermediates stay in
+    VMEM tiles and backward saves only ``x`` — on a NON-rematted block
+    that removes the dominant autodiff residual (PERF.md r3 headroom #1).
+    Shapes the kernel cannot tile fall back to the unfused path.
+    """
 
     cfg: ModelConfig
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
         inner = cfg.ff_mult * cfg.dim
+        d = x.shape[-1]
+        cd = _dtype(cfg)
         # Separate value/gate matmuls: one fused projection + split costs
         # two big HBM slice copies per layer (see ZooAttention).
-        h = nn.Dense(inner, dtype=_dtype(cfg),
-                     param_dtype=_param_dtype(cfg), name="wi")(x)
-        gate = nn.Dense(inner, dtype=_dtype(cfg),
-                        param_dtype=_param_dtype(cfg), name="gate")(x)
-        h = h * nn.gelu(gate)
-        return nn.Dense(cfg.dim, dtype=_dtype(cfg),
-                        param_dtype=_param_dtype(cfg), name="wo")(h)
+        wi, bi = DenseKernel(inner, _param_dtype(cfg), name="wi")(d)
+        wg, bg = DenseKernel(inner, _param_dtype(cfg), name="gate")(d)
+        wo, bo = DenseKernel(cfg.dim, _param_dtype(cfg), name="wo")(inner)
+        wi, wg, wo = wi.astype(cd), wg.astype(cd), wo.astype(cd)
+        bi, bg, bo = bi.astype(cd), bg.astype(cd), bo.astype(cd)
+        x = x.astype(cd)
+        if self.fuse:
+            # same kernel gating as the attention zoo: real TPU backend,
+            # or interpret mode when tests opt in (models/attention.py)
+            from dalle_tpu.models import attention as attn_mod
+            from dalle_tpu.ops.pallas.geglu_kernels import (geglu_ff,
+                                                            geglu_supported)
+            b, t, _ = x.shape
+            if (attn_mod._pallas_by_default()
+                    and geglu_supported(b * t, d, inner, cd)):
+                out = geglu_ff(x.reshape(b * t, d), wi, wg, wo,
+                               bi, bg, bo,
+                               256, 512, attn_mod._PALLAS_INTERPRET)
+                return out.reshape(b, t, cfg.dim)
+        h = jnp.dot(x, wi) + bi
+        gate = jnp.dot(x, wg) + bg
+        return jnp.dot(h * nn.gelu(gate), wo) + bo
 
 
 class TransformerBlock(nn.Module):
-    """Pre-norm attention + GEGLU FF with residuals."""
+    """Pre-norm attention + GEGLU FF with residuals.
+
+    ``fuse_ff`` routes the FF through the fused Pallas GEGLU kernel —
+    set on NON-rematted blocks (cfg.ff_fusion), where the fused
+    custom_vjp shrinks the block's saved residuals to the kernel inputs.
+    """
 
     cfg: ModelConfig
     attn_type: str
     mesh: Any = None
+    fuse_ff: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, rot=None) -> jax.Array:
@@ -144,7 +195,7 @@ class TransformerBlock(nn.Module):
                              name="attn")(h, rot)
         h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
                          name="ff_norm")(x)
-        x = x + GEGLUFeedForward(cfg, name="ff")(h)
+        x = x + GEGLUFeedForward(cfg, fuse=self.fuse_ff, name="ff")(h)
         return x
 
 
@@ -178,10 +229,10 @@ class BlockCycle(nn.Module):
         blocks = {}
         for uid in range(cycle):
             attn_type = cfg.attn_types[uid % len(cfg.attn_types)]
-            cls = (self.plain_cls
-                   if self.plain_cls is not None and uid >= first_plain
-                   else self.block_cls)
+            is_plain = self.plain_cls is not None and uid >= first_plain
+            cls = self.plain_cls if is_plain else self.block_cls
             blocks[uid] = cls(cfg, attn_type, mesh=self.mesh,
+                              fuse_ff=cfg.fuse_ff(is_plain),
                               name=f"block_{uid}")
         for u in range(unroll):
             for uid in range(cycle):
@@ -276,8 +327,11 @@ class Transformer(nn.Module):
         for uid, attn_type in rest:
             if uid not in blocks:
                 name = "block_wconv" if uid == -1 else f"block_{uid}"
-                cls = TransformerBlock if uid in plain_uids else block_cls
-                blocks[uid] = cls(cfg, attn_type, mesh=self.mesh, name=name)
+                is_plain = uid in plain_uids
+                cls = TransformerBlock if is_plain else block_cls
+                blocks[uid] = cls(cfg, attn_type, mesh=self.mesh,
+                                  fuse_ff=cfg.fuse_ff(is_plain),
+                                  name=name)
             x = blocks[uid](x, rot)
 
         return nn.LayerNorm(dtype=_dtype(cfg),

@@ -1,0 +1,218 @@
+"""Fused Pallas GEGLU feed-forward: ``(x@Wi * gelu(x@Wg)) @ Wo``.
+
+The XLA lowering of the GEGLU MLP (dalle-pytorch's FeedForward, applied at
+every layer of the reference flagship, learning-at-home/dalle task.py:62-83)
+materializes the two (B*T, ff_mult*dim) intermediates ``h``/``gate`` in HBM
+— ~84 MB per flagship microbatch apply — and, for a NON-rematted block,
+keeps them alive as autodiff residuals across all 16 scan iterations
+(~1.3 GB at micro 4). That residual footprint is what PERF.md r3 names the
+micro-6/8 memory wall (headroom #1).
+
+Here the inner dimension is tiled: each grid step computes an
+(block_m, block_k) slab of ``h`` and ``gate`` in VMEM, applies the gate,
+and accumulates the (block_m, dim) contribution of the third matmul into
+an f32 VMEM accumulator. Nothing of size (M, K) ever reaches HBM, and the
+``custom_vjp`` saves ONLY ``x`` (plus the bf16 weight casts XLA hoists out
+of the scan) — a plain block's FF residual drops from ~84 MB to ~10 MB per
+apply, the same footprint as a rematted block at strictly fewer FLOPs.
+
+Backward splits the work to avoid recomputing ``h``/``gate`` twice:
+
+1. one Pallas kernel recomputes ``h``/``gate`` tile-by-tile and emits the
+   three (M, K) bf16 tensors backward actually consumes — ``dh``, ``dg``,
+   ``hg`` (TRANSIENTS, freed within the layer's backward, not residuals);
+2. the remaining five gradient contractions (``dx``, ``dWi``, ``dWg``,
+   ``dWo``) are plain XLA matmuls over those tensors — shapes XLA already
+   schedules optimally on the MXU.
+
+Total: 8 matmul-units backward vs 6 for unfused-with-saved-residuals and
+9 for unfused-under-remat (replay included) — the fused PLAIN block beats
+the rematted block on both FLOPs and memory, which is what lets
+``remat_skip_blocks`` rise past 1 (each skipped block saves a full
+forward replay per scan iteration).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: tanh-approximation constant of flax's default ``nn.gelu``
+#: (approximate=True); the backward derivative below must match it.
+_GELU_C = 0.044715
+_SQRT_2_OVER_PI = 0.7978845608028654
+
+
+def _gelu(g):
+    """tanh-approx gelu in f32 — identical formula to jax.nn.gelu
+    (approximate=True), written out so fwd and bwd share one definition."""
+    u = _SQRT_2_OVER_PI * (g + _GELU_C * g * g * g)
+    return 0.5 * g * (1.0 + jnp.tanh(u))
+
+
+def _gelu_grad(g):
+    """d gelu(g) / dg for the tanh approximation."""
+    u = _SQRT_2_OVER_PI * (g + _GELU_C * g * g * g)
+    t = jnp.tanh(u)
+    du = _SQRT_2_OVER_PI * (1.0 + 3.0 * _GELU_C * g * g)
+    return 0.5 * (1.0 + t) + 0.5 * g * (1.0 - t * t) * du
+
+
+def _mm(a, b, trans_b=False):
+    """MXU matmul with f32 accumulation; contracts a's last dim with b's
+    first (or last, for ``trans_b``)."""
+    dims = (((1,), (1,)), ((), ())) if trans_b else (((1,), (0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims,
+                               preferred_element_type=jnp.float32)
+
+
+def _ff_fwd_kernel(x_ref, wi_ref, wg_ref, wo_ref, bi_ref, bg_ref, bo_ref,
+                   out_ref, acc_ref, *, nk: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        # seed the accumulator with the output bias (added exactly once)
+        acc_ref[...] = jnp.broadcast_to(
+            bo_ref[...].astype(jnp.float32), acc_ref.shape)
+
+    xb = x_ref[...]                       # (bm, d)
+    h = _mm(xb, wi_ref[...]) + bi_ref[...].astype(jnp.float32)
+    g = _mm(xb, wg_ref[...]) + bg_ref[...].astype(jnp.float32)
+    hg = (h * _gelu(g)).astype(x_ref.dtype)
+    acc_ref[...] += _mm(hg, wo_ref[...])  # (bm, d) f32
+
+    @pl.when(k == nk - 1)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _ff_bwd_kernel(x_ref, wi_ref, wg_ref, wo_ref, bi_ref, bg_ref, do_ref,
+                   dh_ref, dg_ref, hg_ref):
+    xb = x_ref[...]                          # (bm, d)
+    h = _mm(xb, wi_ref[...]) + bi_ref[...].astype(jnp.float32)
+    g = _mm(xb, wg_ref[...]) + bg_ref[...].astype(jnp.float32)
+    a = _gelu(g)
+    dhg = _mm(do_ref[...], wo_ref[...], trans_b=True)   # (bm, bk) f32
+    dh_ref[...] = (dhg * a).astype(dh_ref.dtype)
+    dg_ref[...] = (dhg * h * _gelu_grad(g)).astype(dg_ref.dtype)
+    hg_ref[...] = (h * a).astype(hg_ref.dtype)
+
+
+def _pick_block(total: int, target: int) -> int:
+    """Largest divisor of ``total`` that is <= target (tiles must divide)."""
+    b = min(total, target)
+    while total % b:
+        b -= 1
+    return b
+
+
+def geglu_supported(m: int, d: int, k: int, dtype) -> bool:
+    """Shapes the kernel handles: tiling-clean last dims and a real win
+    (tiny test models fall back to the unfused path)."""
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.float32)):
+        return False
+    return d % 128 == 0 and k % 128 == 0 and m % 8 == 0 and m >= 128
+
+
+def _ff_fwd(x, wi, wg, wo, bi, bg, bo, block_m, block_k, interpret):
+    m, d = x.shape
+    k = wi.shape[1]
+    bm = _pick_block(m, block_m)
+    bk = _pick_block(k, block_k)
+    nk = k // bk
+    grid = (m // bm, nk)
+    return pl.pallas_call(
+        functools.partial(_ff_fwd_kernel, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((d, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, d), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, d), jnp.float32)],
+        interpret=interpret,
+    )(x, wi, wg, wo, bi.reshape(1, -1), bg.reshape(1, -1),
+      bo.reshape(1, -1))
+
+
+def _ff_bwd_tensors(x, wi, wg, wo, bi, bg, dout, block_m, block_k,
+                    interpret):
+    m, d = x.shape
+    k = wi.shape[1]
+    bm = _pick_block(m, block_m)
+    bk = _pick_block(k, block_k)
+    grid = (m // bm, k // bk)
+    mk_spec = pl.BlockSpec((bm, bk), lambda i, j: (i, j))
+    return pl.pallas_call(
+        _ff_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((d, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+        ],
+        out_specs=[mk_spec, mk_spec, mk_spec],
+        out_shape=[jax.ShapeDtypeStruct((m, k), x.dtype)] * 3,
+        interpret=interpret,
+    )(x, wi, wg, wo, bi.reshape(1, -1), bg.reshape(1, -1), dout)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def geglu_ff(x, wi, wg, wo, bi, bg, bo, block_m: int = 256,
+             block_k: int = 512, interpret: bool = False):
+    """Fused GEGLU feed-forward with nn.Dense-parity biases.
+
+    x: (M, d); wi/wg: (d, K); wo: (K, d); bi/bg: (K,); bo: (d,) — all in
+    the computation dtype (bf16 on TPU). Returns (M, d). The (M, K)
+    intermediates live only in VMEM tiles; backward saves ``x`` and
+    recomputes them.
+    """
+    return _ff_fwd(x, wi, wg, wo, bi, bg, bo, block_m, block_k, interpret)
+
+
+def _vjp_fwd(x, wi, wg, wo, bi, bg, bo, block_m, block_k, interpret):
+    out = _ff_fwd(x, wi, wg, wo, bi, bg, bo, block_m, block_k, interpret)
+    return out, (x, wi, wg, wo, bi, bg)
+
+
+def _vjp_bwd(block_m, block_k, interpret, res, dout):
+    x, wi, wg, wo, bi, bg = res
+    dh, dg, hg = _ff_bwd_tensors(x, wi, wg, wo, bi, bg, dout, block_m,
+                                 block_k, interpret)
+    # the remaining contractions are plain MXU matmuls / reductions XLA
+    # schedules well; dh/dg/hg are transients freed within this layer's
+    # backward
+    dx = (_mm(dh, wi, trans_b=True)
+          + _mm(dg, wg, trans_b=True)).astype(x.dtype)
+    dims_t = (((0,), (0,)), ((), ()))    # contract over M
+    dwi = jax.lax.dot_general(x, dh, dims_t,
+                              preferred_element_type=jnp.float32)
+    dwg = jax.lax.dot_general(x, dg, dims_t,
+                              preferred_element_type=jnp.float32)
+    dwo = jax.lax.dot_general(hg, dout, dims_t,
+                              preferred_element_type=jnp.float32)
+    dbi = jnp.sum(dh.astype(jnp.float32), axis=0)
+    dbg = jnp.sum(dg.astype(jnp.float32), axis=0)
+    dbo = jnp.sum(dout.astype(jnp.float32), axis=0)
+    return (dx, dwi.astype(wi.dtype), dwg.astype(wg.dtype),
+            dwo.astype(wo.dtype), dbi.astype(bi.dtype),
+            dbg.astype(bg.dtype), dbo.astype(dout.dtype))
+
+
+geglu_ff.defvjp(_vjp_fwd, _vjp_bwd)

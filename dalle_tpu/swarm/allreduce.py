@@ -49,9 +49,20 @@ from dalle_tpu.swarm.identity import (Identity, PK_LEN, SIG_LEN,
                                       open_frame, signed_frame)
 from dalle_tpu.swarm.matchmaking import AveragingGroup
 
-# group_hash, sender_index, weight, n_elems, codec
-_HDR = struct.Struct(">16sIdIB")
+# group_hash, sender_index, weight, n_elems (this chunk), chunk_idx,
+# n_chunks, codec
+_HDR = struct.Struct(">16sIdIIIB")
 _PREFIX_LEN = _HDR.size + PK_LEN + SIG_LEN
+
+#: elements per wire chunk. Parts larger than this are split into
+#: independently-compressed, independently-signed chunks: the daemon
+#: rejects frames over 64 MiB (native/swarm/swarm.cc kMaxFrame), and a
+#: flagship-scale part (125.6M params / N owners) must also PIPELINE —
+#: with one frame per part, encode, wire and decode serialize; with ~16 MB
+#: chunks the owner reduces chunk i while chunk i+1 is still in flight.
+#: Multiple of the u8 codec's 256-element block so chunk boundaries do not
+#: change the quantization math.
+CHUNK_ELEMS = 1 << 22
 
 
 def _sign_ctx(prefix: str, epoch: int, phase: str,
@@ -66,11 +77,12 @@ def _sign_ctx(prefix: str, epoch: int, phase: str,
 
 def _make_frame(identity: Identity, ctx: bytes, group_hash: bytes,
                 sender: int, weight: float, n: int, codec: int,
-                payload: bytes) -> bytes:
+                payload: bytes, chunk: int = 0, n_chunks: int = 1) -> bytes:
     """Signed data-plane chunk. Frames carry sender-supplied weights and
     gradient bytes; unsigned they let any peer that knows the run id
-    inject arbitrary contributions (ADVICE r1)."""
-    hdr = _HDR.pack(group_hash, sender, weight, n, codec)
+    inject arbitrary contributions (ADVICE r1). ``chunk``/``n_chunks``
+    place this frame inside its part (CHUNK_ELEMS chunking)."""
+    hdr = _HDR.pack(group_hash, sender, weight, n, chunk, n_chunks, codec)
     return signed_frame(identity, ctx, hdr, payload)
 
 
@@ -97,6 +109,16 @@ def _part_slices(total: int, owners: int) -> List[Tuple[int, int]]:
     return out
 
 
+def _chunk_slices(n: int, chunk_elems: int) -> List[Tuple[int, int]]:
+    """[start, stop) per wire chunk WITHIN a part of ``n`` elements.
+    Both sender and receiver derive the identical chunking from the part
+    size, so chunk_idx alone places a frame."""
+    if n == 0:
+        return [(0, 0)]
+    return [(lo, min(n, lo + chunk_elems))
+            for lo in range(0, n, chunk_elems)]
+
+
 def flatten_tensors(tensors: Sequence[np.ndarray]) -> np.ndarray:
     return np.concatenate(
         [np.asarray(t, np.float32).reshape(-1) for t in tensors]) \
@@ -108,7 +130,12 @@ def unflatten_tensors(flat: np.ndarray,
     out, off = [], 0
     for t in like:
         n = int(np.prod(t.shape)) if t.shape else 1
-        out.append(flat[off:off + n].reshape(t.shape).astype(np.float32))
+        # views of the (freshly allocated) flat buffer, not copies:
+        # astype() here duplicated the whole 500 MB flagship set per call
+        # (measured ~7 s/peer in the payload bench); asarray with the
+        # matching dtype is a no-op on an f32 input
+        out.append(np.asarray(flat[off:off + n].reshape(t.shape),
+                              np.float32))
         off += n
     return out
 
@@ -120,7 +147,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   adaptive_threshold: int =
                   compression.SIZE_ADAPTIVE_THRESHOLD,
                   sender_timeout: Optional[float] = None,
-                  report: Optional[dict] = None) -> List[np.ndarray]:
+                  report: Optional[dict] = None,
+                  chunk_elems: int = CHUNK_ELEMS) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
     ``report`` (optional dict) receives ``{"complete": bool}``: True iff
@@ -143,8 +171,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     """
     from dalle_tpu.swarm.crypto import maybe_decrypt, maybe_encrypt
     gkey = group.group_key
+    phases: Dict[str, float] = {}
     if report is not None:
         report["complete"] = True  # falsified below on any missing chunk
+        report["phases"] = phases  # wall time per protocol phase
+    t_flat = time.monotonic()
     flat = flatten_tensors(tensors)
     owners = [m for m in group.members if m.addr]  # part owners
     if group.size <= 1 or not owners or flat.size == 0:
@@ -155,6 +186,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     my_part = owner_index.get(me.peer_id)  # None in client mode
     slices = _part_slices(flat.size, len(owners))
     t0 = time.monotonic()
+    phases["flatten_s"] = round(t0 - t_flat, 3)
     deadline = t0 + allreduce_timeout
     if sender_timeout is None:
         sender_timeout = max(1.0, 0.25 * allreduce_timeout)
@@ -188,36 +220,54 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     def fetch_chunk(addr: str, tag: int, timeout: float) -> Optional[bytes]:
         return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
-    # --- scatter: my data for part k -> owner k -------------------------
+    # --- scatter: my data for part k -> owner k, chunk by chunk ---------
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(8, len(owners))) as pool:
         futures = []
+        sends: List[Tuple[str, int, bytes]] = []  # for the one retry pass
         for k, owner in enumerate(owners):
             if k == my_part:
                 continue
             lo, hi = slices[k]
-            chunk = flat[lo:hi]
-            c = part_codec(chunk.size)
-            body = _make_frame(dht.identity,
-                               _sign_ctx(prefix, epoch, "scatter",
-                                         owner.peer_id),
-                               group.group_hash,
-                               group.my_index, weight, chunk.size, c,
-                               compression.compress(chunk, c))
-            futures.append(pool.submit(
-                send_chunk, owner.addr,
-                _tag(prefix, epoch, "scatter", owner.peer_id), body))
+            part = flat[lo:hi]
+            chunks = _chunk_slices(part.size, chunk_elems)
+            ctx = _sign_ctx(prefix, epoch, "scatter", owner.peer_id)
+            tag = _tag(prefix, epoch, "scatter", owner.peer_id)
+            for ci, (clo, chi) in enumerate(chunks):
+                piece = part[clo:chi]
+                c = part_codec(piece.size)
+                body = _make_frame(dht.identity, ctx, group.group_hash,
+                                   group.my_index, weight, piece.size, c,
+                                   compression.compress(piece, c),
+                                   chunk=ci, n_chunks=len(chunks))
+                # one future per chunk: encode of chunk i+1 overlaps the
+                # wire of chunk i (the pool serializes per-endpoint sends
+                # through the connection pool, preserving order is not
+                # required — chunk_idx places each frame)
+                sends.append((owner.addr, tag, body))
+                futures.append(pool.submit(send_chunk, owner.addr, tag,
+                                           body))
+        t_built = time.monotonic()
+        phases["scatter_build_s"] = round(t_built - t0, 3)
 
         # --- reduce my part while scatter sends run ---------------------
         averaged_mine: Optional[np.ndarray] = None
         if my_part is not None:
             lo, hi = slices[my_part]
             mine = flat[lo:hi]
+            n_mine = hi - lo
+            my_chunks = _chunk_slices(n_mine, chunk_elems)
             acc = mine * weight
             total_w = weight
             expected = {i for i, m in enumerate(group.members)
                         if m.peer_id != me.peer_id}
+            # a sender's contribution applies ATOMICALLY once all its
+            # chunks arrived (partial senders are dropped wholesale, the
+            # same elasticity semantics as the unchunked protocol)
+            bufs: Dict[int, np.ndarray] = {}
+            got: Dict[int, set] = {}
             my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
+            my_ctx = _sign_ctx(prefix, epoch, "scatter", me.peer_id)
             last_progress = time.monotonic()
             while expected:
                 now = time.monotonic()
@@ -229,61 +279,99 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     0.5, max(0.05, reduce_deadline - now)))
                 if raw is None:
                     continue
-                parsed = _parse(raw, group, hi - lo,
-                                _sign_ctx(prefix, epoch, "scatter",
-                                          me.peer_id))
+                parsed = _parse(raw, group, my_chunks, my_ctx)
                 if parsed is None:
                     continue
-                sender, w, data = parsed
+                sender, w, ci, data = parsed
                 if sender not in expected:
-                    continue  # duplicate
-                expected.discard(sender)
-                acc += data * w
-                total_w += w
+                    continue  # duplicate or already-complete sender
+                if sender not in bufs:
+                    bufs[sender] = np.zeros(n_mine, np.float32)
+                    got[sender] = set()
+                if ci in got[sender]:
+                    continue  # duplicate chunk
+                clo, chi = my_chunks[ci]
+                bufs[sender][clo:chi] = data
+                got[sender].add(ci)
+                if len(got[sender]) == len(my_chunks):
+                    acc += bufs.pop(sender) * w
+                    got.pop(sender)
+                    total_w += w
+                    expected.discard(sender)
                 last_progress = time.monotonic()
             if expected and report is not None:
                 report["complete"] = False
             averaged_mine = acc / total_w
+            phases["reduce_s"] = round(time.monotonic() - t_built, 3)
 
+        t_wait = time.monotonic()
         concurrent.futures.wait(futures)
+        # One application-layer retry for scatter sends that failed: the
+        # wire layer never resends a mutating frame after a lost reply
+        # (swarm.cc rpc, ADVICE r3), but at THIS layer a resend is safe —
+        # receivers de-duplicate by (sender, chunk_idx) — so a dropped
+        # connection costs one retry instead of this peer's whole
+        # contribution being banned at the owner.
+        retries = [s for f, s in zip(futures, sends)
+                   if not f.cancelled() and not f.result()]
+        if retries and time.monotonic() < deadline:
+            retry_futs = [pool.submit(send_chunk, *s) for s in retries]
+            concurrent.futures.wait(retry_futs)
+        phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
     out = flat.copy()
 
+    t_gather = time.monotonic()
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(8, group.size)) as pool:
         futures = []
+        sends = []
         if my_part is not None:
             lo, hi = slices[my_part]
-            c = part_codec(averaged_mine.size)
-            wire = compression.compress(averaged_mine, c)
-            # apply the same lossy wire bytes locally so all members end
-            # the round with byte-identical values for this part
-            out[lo:hi] = compression.decompress(wire, c, averaged_mine.size)
-            body = _make_frame(dht.identity, gather_ctx, group.group_hash,
-                               group.my_index, 1.0, averaged_mine.size, c,
-                               wire)
-            # the gather body is receiver-independent: encrypt ONCE, not
-            # once per recipient (the scatter path must stay per-receiver,
-            # its bodies differ)
-            wire_body = maybe_encrypt(gkey, body)
-            for m in group.members:
-                if m.peer_id == me.peer_id or not m.addr:
-                    continue
-                futures.append(pool.submit(
-                    send_raw, m.addr,
-                    _tag(prefix, epoch, "gather", m.peer_id), wire_body))
-            if any(not m.addr for m in group.members):
-                # client-mode members can't receive pushes: publish the
-                # averaged part in this owner's mailbox for them to pull
-                dht.post(_tag(prefix, epoch, "mailbox", me.peer_id),
-                         wire_body,
-                         expiration_time=time.time()
-                         + 2 * allreduce_timeout)
+            my_chunks = _chunk_slices(averaged_mine.size, chunk_elems)
+            have_clients = any(not m.addr for m in group.members)
+            push_to = [m for m in group.members
+                       if m.peer_id != me.peer_id and m.addr]
+            for ci, (clo, chi) in enumerate(my_chunks):
+                piece = averaged_mine[clo:chi]
+                c = part_codec(piece.size)
+                wire = compression.compress(piece, c)
+                # apply the same lossy wire bytes locally so all members
+                # end the round with byte-identical values for this part
+                out[lo + clo:lo + chi] = compression.decompress(
+                    wire, c, piece.size)
+                body = _make_frame(dht.identity, gather_ctx,
+                                   group.group_hash, group.my_index, 1.0,
+                                   piece.size, c, wire,
+                                   chunk=ci, n_chunks=len(my_chunks))
+                # the gather body is receiver-independent: encrypt ONCE
+                # per chunk, not once per recipient (the scatter path must
+                # stay per-receiver, its bodies differ)
+                wire_body = maybe_encrypt(gkey, body)
+                for m in push_to:
+                    gtag = _tag(prefix, epoch, "gather", m.peer_id)
+                    sends.append((m.addr, gtag, wire_body))
+                    futures.append(pool.submit(send_raw, m.addr, gtag,
+                                               wire_body))
+                if have_clients:
+                    # client-mode members can't receive pushes: publish
+                    # each chunk of the averaged part in this owner's
+                    # mailbox for them to pull (per-chunk tags)
+                    dht.post(_tag(prefix, epoch, f"mailbox{ci}",
+                                  me.peer_id),
+                             wire_body,
+                             expiration_time=time.time()
+                             + 2 * allreduce_timeout)
 
         if me.addr:  # client-mode peers receive no gather traffic
-            pending: Dict[int, Tuple[int, int]] = {
-                owner_index[m.peer_id]: slices[owner_index[m.peer_id]]
+            part_chunks = {
+                k: _chunk_slices(hi_ - lo_, chunk_elems)
+                for k, (lo_, hi_) in enumerate(slices)}
+            # pending chunk ids per part
+            pending: Dict[int, set] = {
+                owner_index[m.peer_id]:
+                    set(range(len(part_chunks[owner_index[m.peer_id]])))
                 for m in owners if m.peer_id != me.peer_id}
             sender_to_part = {
                 group.members.index(m): owner_index[m.peer_id]
@@ -305,68 +393,110 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 part = sender_to_part.get(sender)
                 if part is None or part not in pending:
                     continue
-                lo, hi = pending[part]
-                parsed = _parse(raw, group, hi - lo, gather_ctx)
+                parsed = _parse(raw, group, part_chunks[part], gather_ctx)
                 if parsed is None:
                     continue
-                _, _, data = parsed
-                out[lo:hi] = data
-                del pending[part]
+                _, _, ci, data = parsed
+                if ci not in pending[part]:
+                    continue  # duplicate chunk
+                lo, hi = slices[part]
+                clo, chi = part_chunks[part][ci]
+                out[lo + clo:lo + chi] = data
+                pending[part].discard(ci)
+                if not pending[part]:
+                    del pending[part]
                 last_progress = time.monotonic()
-            # parts never received keep this peer's local values (owner
+            # chunks never received keep this peer's local values (owner
             # died mid-round): degraded but well-defined
             if pending and report is not None:
                 report["complete"] = False
         else:
-            # client mode: pull each averaged part from its owner's mailbox
-            pending = {k: m for k, m in enumerate(owners)}
+            # client mode: pull each averaged part's chunks from its
+            # owner's mailbox
+            part_chunks = {
+                k: _chunk_slices(hi_ - lo_, chunk_elems)
+                for k, (lo_, hi_) in enumerate(slices)}
+            pending = {k: set(range(len(part_chunks[k])))
+                       for k in range(len(owners))}
             last_progress = max(time.monotonic(), gather_baseline)
             while pending:
                 now = time.monotonic()
                 if now >= deadline or now - last_progress >= sender_timeout:
                     break
-                for k, owner in list(pending.items()):
-                    raw = fetch_chunk(
-                        owner.addr, _tag(prefix, epoch, "mailbox",
-                                         owner.peer_id),
-                        timeout=min(2.0, max(
-                            0.1, deadline - time.monotonic())))
-                    if raw is None:
-                        continue
-                    lo, hi = slices[k]
-                    parsed = _parse(raw, group, hi - lo, gather_ctx)
-                    if parsed is None:
-                        continue
-                    _, _, data = parsed
-                    out[lo:hi] = data
-                    del pending[k]
-                    last_progress = time.monotonic()
+                for k in list(pending):
+                    owner = owners[k]
+                    for ci in sorted(pending[k]):
+                        raw = fetch_chunk(
+                            owner.addr,
+                            _tag(prefix, epoch, f"mailbox{ci}",
+                                 owner.peer_id),
+                            timeout=min(2.0, max(
+                                0.1, deadline - time.monotonic())))
+                        if raw is None:
+                            continue
+                        parsed = _parse(raw, group, part_chunks[k],
+                                        gather_ctx)
+                        if parsed is None:
+                            continue
+                        _, _, pci, data = parsed
+                        if pci not in pending[k]:
+                            continue
+                        lo, hi = slices[k]
+                        clo, chi = part_chunks[k][pci]
+                        out[lo + clo:lo + chi] = data
+                        pending[k].discard(pci)
+                        last_progress = time.monotonic()
+                    if not pending.get(k):
+                        pending.pop(k, None)
                 if pending:
                     time.sleep(0.1)
             if pending and report is not None:
                 report["complete"] = False
 
-    return unflatten_tensors(out, tensors)
+        concurrent.futures.wait(futures)
+        # same application-layer retry as scatter: gather chunks are
+        # de-duplicated by (part, chunk_idx) at every receiver
+        retries = [s for f, s in zip(futures, sends)
+                   if not f.cancelled() and not f.result()]
+        if retries and time.monotonic() < deadline:
+            retry_futs = [pool.submit(send_raw, *s) for s in retries]
+            concurrent.futures.wait(retry_futs)
+
+    phases["gather_s"] = round(time.monotonic() - t_gather, 3)
+    t_out = time.monotonic()
+    result = unflatten_tensors(out, tensors)
+    phases["unflatten_s"] = round(time.monotonic() - t_out, 3)
+    return result
 
 
 def _peek(raw: bytes, group: AveragingGroup
           ) -> Optional[Tuple[int, float]]:
     if len(raw) < _PREFIX_LEN:
         return None
-    ghash, sender, w, _n, _c = _HDR.unpack_from(raw)
+    ghash, sender, w, _n, _ci, _nc, _c = _HDR.unpack_from(raw)
     if ghash != group.group_hash or not (0 <= sender < group.size):
         return None
     return sender, w
 
 
-def _parse(raw: bytes, group: AveragingGroup, expect_n: int, ctx: bytes
-           ) -> Optional[Tuple[int, float, np.ndarray]]:
+def _parse(raw: bytes, group: AveragingGroup,
+           chunks: List[Tuple[int, int]], ctx: bytes
+           ) -> Optional[Tuple[int, float, int, np.ndarray]]:
+    """-> (sender, weight, chunk_idx, decoded chunk) or None.
+
+    ``chunks`` is the receiver-side chunking of the part this tag carries
+    (both sides derive it from the part size, so chunk_idx and the chunk's
+    element count must both agree — a frame chunked differently is
+    malformed and dropped)."""
     head = _peek(raw, group)
     if head is None:
         return None
     sender, w = head
-    _, _, _, n, codec = _HDR.unpack_from(raw)
-    if n != expect_n:
+    _, _, _, n, ci, nc, codec = _HDR.unpack_from(raw)
+    if nc != len(chunks) or not (0 <= ci < nc):
+        return None
+    clo, chi = chunks[ci]
+    if n != chi - clo:
         return None
     if not _verify_frame(raw, ctx, group, sender):
         return None  # forged or replayed chunk: drop
@@ -375,4 +505,4 @@ def _parse(raw: bytes, group: AveragingGroup, expect_n: int, ctx: bytes
         data = compression.decompress(body, codec, n)
     except (ValueError, struct.error):
         return None
-    return sender, float(w), data
+    return sender, float(w), ci, data

@@ -53,16 +53,26 @@ def compress_u8(x: np.ndarray) -> bytes:
 
     Layout: u32 n, then ceil(n/256) fp32 scales, then n uint8 codes
     (code 128 = zero, scale = max|x| per block / 127).
+
+    The quantize chain runs IN-PLACE on one padded working copy (divide /
+    rint / clip / add reuse the buffer): at flagship payloads (hundreds of
+    MB per part, one host core) every extra temporary was a measurable
+    slice of the all-reduce epoch.
     """
     flat = np.asarray(x, np.float32).reshape(-1)
     n = flat.size
     pad = (-n) % _QBLOCK
-    padded = np.pad(flat, (0, pad)).reshape(-1, _QBLOCK)
-    scales = np.abs(padded).max(axis=1) / 127.0
+    padded = np.pad(flat, (0, pad)).reshape(-1, _QBLOCK)  # working copy
+    scales = np.abs(padded).max(axis=1)
+    scales /= 127.0
     safe = np.where(scales > 0, scales, 1.0)
-    codes = np.clip(np.rint(padded / safe[:, None]) + 128, 0, 255)
+    np.divide(padded, safe[:, None], out=padded)
+    np.rint(padded, out=padded)
+    np.clip(padded, -128.0, 127.0, out=padded)
+    padded += 128.0
+    codes = padded.astype(np.uint8)
     return (struct.pack(">I", n) + scales.astype(np.float32).tobytes()
-            + codes.astype(np.uint8).reshape(-1)[:n].tobytes())
+            + codes.reshape(-1)[:n].tobytes())
 
 
 def decompress_u8(buf: bytes) -> np.ndarray:
@@ -71,9 +81,12 @@ def decompress_u8(buf: bytes) -> np.ndarray:
     scales = np.frombuffer(buf, np.float32, count=nblocks, offset=4)
     codes = np.frombuffer(buf, np.uint8, count=n, offset=4 + 4 * nblocks)
     pad = nblocks * _QBLOCK - n
-    padded = np.pad(codes.astype(np.float32) - 128.0, (0, pad))
-    out = padded.reshape(nblocks, _QBLOCK) * scales[:, None]
-    return out.reshape(-1)[:n].astype(np.float32)
+    out = codes.astype(np.float32)   # the one working copy
+    out -= 128.0
+    padded = np.pad(out, (0, pad)) if pad else out
+    padded = padded.reshape(nblocks, _QBLOCK)
+    padded *= scales[:, None]
+    return padded.reshape(-1)[:n]
 
 
 def adaptive_codec(n_elements: int,

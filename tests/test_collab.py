@@ -242,6 +242,51 @@ class TestAllReduce:
             np.testing.assert_allclose(got[lo:hi], live_avg[lo:hi],
                                        rtol=1e-5, atol=1e-6)
 
+    def test_chunked_parts_average_exact(self, swarm3):
+        """Parts larger than chunk_elems travel as multiple independently
+        signed+compressed frames (flagship-scale parts exceed the daemon's
+        64 MiB frame cap; VERDICT r3 next #2). Force multi-chunk with a
+        tiny chunk_elems and check exactness + the complete flag."""
+        rng = np.random.RandomState(21)
+        # 3 owners, ~433 elems/part, chunk_elems=100 -> 5 chunks/part
+        tensors = [[rng.randn(1300).astype(np.float32)] for _ in swarm3]
+        weights = [1.0, 3.0, 0.5]
+        reports = [dict() for _ in swarm3]
+
+        def peer(i):
+            g = make_group(swarm3[i], "arch", epoch=7, weight=weights[i],
+                           matchmaking_time=3.0, min_group_size=3)
+            assert g is not None and g.size == 3
+            return run_allreduce(swarm3[i], g, "arch", 7, tensors[i],
+                                 weight=weights[i], allreduce_timeout=10.0,
+                                 codec=compression.NONE,
+                                 report=reports[i], chunk_elems=100)
+
+        results = run_threads([lambda i=i: peer(i) for i in range(3)])
+        expected = self._weighted_mean(tensors, weights)
+        for rep, res in zip(reports, results):
+            assert rep["complete"]
+            np.testing.assert_allclose(flatten_tensors(res), expected,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_chunked_lossy_rounds_byte_identical(self, swarm3):
+        """The per-chunk owner-applies-wire-bytes path preserves the
+        byte-identity guarantee under chunking + u8 compression."""
+        rng = np.random.RandomState(22)
+        tensors = [[rng.randn(4096).astype(np.float32)] for _ in swarm3]
+
+        def peer(i):
+            g = make_group(swarm3[i], "archb", epoch=8, weight=1.0,
+                           matchmaking_time=3.0, min_group_size=3)
+            return run_allreduce(swarm3[i], g, "archb", 8, tensors[i],
+                                 weight=1.0, allreduce_timeout=10.0,
+                                 codec=compression.UNIFORM8BIT,
+                                 chunk_elems=512)
+
+        results = run_threads([lambda i=i: peer(i) for i in range(3)])
+        for res in results[1:]:
+            np.testing.assert_array_equal(res[0], results[0][0])
+
     def test_peer_dies_after_matchmaking(self, swarm3):
         """A group member that never shows up for the all-reduce is dropped:
         survivors finish fast with the dead peer's weight excluded on their
@@ -306,6 +351,40 @@ class TestClientMode:
             return run_allreduce(all_nodes[i], g, "cmar", 0, tensors[i],
                                  weight=1.0, allreduce_timeout=10.0,
                                  codec=compression.NONE)
+
+        try:
+            results = run_threads([lambda i=i: peer(i) for i in range(3)])
+            expected = sum(flatten_tensors(t) for t in tensors) / 3
+            for res in results:
+                np.testing.assert_allclose(flatten_tensors(res), expected,
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            client.shutdown()
+            for n in nodes:
+                n.shutdown()
+
+    def test_client_pulls_chunked_parts_from_mailboxes(self):
+        """A client-mode peer pulls a multi-chunk averaged part via the
+        per-chunk mailbox tags (chunked gather, VERDICT r3 next #2)."""
+        nodes = make_swarm(2)
+        client = DHT(initial_peers=[nodes[0].visible_address],
+                     identity=Identity.generate(), client_mode=True,
+                     rpc_timeout=2.0)
+        rng = np.random.RandomState(23)
+        all_nodes = nodes + [client]
+        # 2 owners, 600 elems/part, chunk_elems=128 -> 5 chunks/part
+        tensors = [[rng.randn(1200).astype(np.float32)]
+                   for _ in all_nodes]
+
+        def peer(i):
+            cm = all_nodes[i].client_mode
+            g = make_group(all_nodes[i], "cmch", epoch=1, weight=1.0,
+                           matchmaking_time=3.0, min_group_size=3,
+                           client_mode=cm)
+            assert g is not None and g.size == 3
+            return run_allreduce(all_nodes[i], g, "cmch", 1, tensors[i],
+                                 weight=1.0, allreduce_timeout=10.0,
+                                 codec=compression.NONE, chunk_elems=128)
 
         try:
             results = run_threads([lambda i=i: peer(i) for i in range(3)])

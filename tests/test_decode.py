@@ -17,6 +17,19 @@ def _setup(**overrides):
     cfg = tiny_model_config(**overrides)
     model = DALLE(cfg)
     params = init_params(model, jax.random.PRNGKey(0))
+    # zero-init biases would make the decode-vs-training parity blind to a
+    # dropped bias add (exactly the r4 FF-bias decode bug): perturb every
+    # bias leaf so both paths must apply them identically
+    key = jax.random.PRNGKey(99)
+
+    def _noise_bias(path, leaf):
+        if any(getattr(p, "key", None) == "bias" for p in path):
+            k = jax.random.fold_in(key, abs(hash(str(path))) % (2 ** 31))
+            return leaf + 0.05 * jax.random.normal(k, leaf.shape,
+                                                   leaf.dtype)
+        return leaf
+
+    params = jax.tree_util.tree_map_with_path(_noise_bias, params)
     rng = jax.random.PRNGKey(7)
     text = jax.random.randint(rng, (2, cfg.text_seq_len), 2, cfg.vocab_text)
     image = jax.random.randint(rng, (2, cfg.image_seq_len), 0,
