@@ -97,6 +97,21 @@ def load() -> ctypes.CDLL:
         lib.swarm_node_relay_fetch.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
+        lib.swarm_node_punch_prepare.restype = ctypes.c_int
+        lib.swarm_node_punch_prepare.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.swarm_node_punch_connect.restype = ctypes.c_int
+        lib.swarm_node_punch_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int]
+        lib.swarm_node_has_direct.restype = ctypes.c_int
+        lib.swarm_node_has_direct.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.swarm_node_relay_served.restype = ctypes.c_uint64
+        lib.swarm_node_relay_served.argtypes = [ctypes.c_void_p]
+        lib.swarm_node_observed_host.restype = ctypes.c_void_p
+        lib.swarm_node_observed_host.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]
         lib.swarm_node_peers.restype = ctypes.c_void_p
         lib.swarm_node_peers.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]

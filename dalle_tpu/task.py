@@ -141,9 +141,16 @@ class TrainingTask:
     @functools.cached_property
     def grad_step(self):
         """Jitted (params, batch) -> (grads, metrics); the per-minibatch
-        device program (reference ``lib/training/tpu.py:119-126``)."""
+        device program (reference ``lib/training/tpu.py:119-126``).
+
+        ``grad_accum_steps`` splits the delivered batch into microbatches
+        accumulated inside the jitted step — without it the flagship's
+        256-sample local batch lowers as ONE unsplit forward and needs
+        tens of GB of activations (found by the r4 sustained run: the
+        bench harness fused its own accumulation, masking this)."""
         from dalle_tpu.training.steps import make_grad_step
-        return jax.jit(make_grad_step(self.model))
+        return jax.jit(make_grad_step(
+            self.model, accum_steps=self.trainer_cfg.grad_accum_steps))
 
     @functools.cached_property
     def apply_step(self):

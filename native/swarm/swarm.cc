@@ -40,7 +40,9 @@ constexpr uint8_t kPing = 1, kPong = 2, kStore = 3, kStoreOk = 4,
                   kFetchMiss = 13,
                   /* relay plane */
                   kRelayAttach = 14, kAttachOk = 15, kRelaySend = 16,
-                  kRelayMiss = 17, kRelayFetch = 18, kRelayReply = 19;
+                  kRelayMiss = 17, kRelayFetch = 18, kRelayReply = 19,
+                  /* hole-punched direct links */
+                  kPunchHello = 20;
 
 /* How long a pooled / attachment connection may sit idle before its
  * blocking read gives up (the client pool simply reconnects). */
@@ -490,6 +492,25 @@ struct SwarmNode {
   int my_relay_fd = -1;
   std::thread my_relay_reader;
 
+  /* -- hole-punched direct links (DHT-coordinated TCP hole punch; the
+   * relay stays the fallback). Deterministic roles avoid a tie-break:
+   * the peer with the SMALLER node id dials, the larger one accepts. -- */
+  struct DirectLink {
+    int fd = -1;
+    std::shared_ptr<std::mutex> write_mu;
+  };
+  std::mutex dl_mu;
+  std::map<NodeId, DirectLink> direct_links;
+  std::map<NodeId, int> punch_sockets;      /* prepared, pre-connect */
+  /* host as observed by the relay we attached to (the server-reflexive
+   * address a NAT'd peer must advertise for punching); empty until the
+   * first kAttachOk carries it */
+  std::mutex obs_mu;
+  std::string observed_host;
+  /* relay traffic served BY this node (the relay role): lets tests and
+   * operators observe direct links actually bypassing the relay */
+  std::atomic<uint64_t> relay_served{0};
+
   /* set of inbound handler fds, so destroy() can unblock idle readers */
   std::mutex hfd_mu;
   std::set<int> handler_fds;
@@ -663,6 +684,7 @@ struct SwarmNode {
         uint64_t tag = r.u64();
         std::string payload = r.bytes();
         if (!r.ok) return {};
+        relay_served.fetch_add(1);
         std::string fwd;
         fwd.push_back(char(kMsg));
         put_u64(fwd, tag);
@@ -676,6 +698,7 @@ struct SwarmNode {
         NodeId target = r.id();
         uint64_t tag = r.u64();
         if (!r.ok) return {};
+        relay_served.fetch_add(1);
         uint64_t rid = next_req_id.fetch_add(1);
         auto pf = std::make_shared<PendingFetch>();
         {
@@ -748,7 +771,8 @@ struct SwarmNode {
 
   /* Serve an inbound connection that upgraded itself into a relay
    * attachment: register it, then pump kRelayReply frames until EOF. */
-  void serve_attachment(int cfd, const NodeId &peer) {
+  void serve_attachment(int cfd, const NodeId &peer,
+                        const std::string &peer_host) {
     auto wmu = std::make_shared<std::mutex>();
     {
       std::lock_guard<std::mutex> g(att_mu);
@@ -758,7 +782,12 @@ struct SwarmNode {
     }
     {
       std::lock_guard<std::mutex> g(*wmu);
+      /* kAttachOk carries the client's address AS THE RELAY SEES IT —
+       * the server-reflexive host a NAT'd peer must advertise when
+       * coordinating a hole punch (its local bind address is private) */
       std::string ok(1, char(kAttachOk));
+      put_bytes(ok, reinterpret_cast<const uint8_t *>(peer_host.data()),
+                peer_host.size());
       if (!write_frame(cfd, ok)) {
         /* deregister before the caller closes cfd — a stale map entry
          * would later inject frames into (and then kill) whatever
@@ -808,6 +837,216 @@ struct SwarmNode {
     auto it = attachments.find(peer);
     if (it != attachments.end() && it->second.fd == cfd)
       attachments.erase(it);
+  }
+
+  /* ---- hole-punched direct links ---------------------------------- */
+
+  void drop_direct(const NodeId &peer, int expect_fd) {
+    std::lock_guard<std::mutex> g(dl_mu);
+    auto it = direct_links.find(peer);
+    if (it != direct_links.end() && it->second.fd == expect_fd) {
+      shutdown(expect_fd, SHUT_RDWR);
+      direct_links.erase(it);
+    }
+  }
+
+  /* Pump a punched connection: symmetric vocabulary with the relay
+   * attachment — inbound kMsg -> recv queues, inbound kFetch answered
+   * from the local mailbox via kRelayReply, inbound kRelayReply resolves
+   * this node's own pending direct fetches. Writes from other threads
+   * (direct_send / direct_fetch) share the link's write mutex. */
+  void serve_direct(int fd, NodeId peer, std::shared_ptr<std::mutex> wmu) {
+    std::string fr;
+    while (running.load() && read_frame(fd, &fr)) {
+      Reader r(fr);
+      if (!r.need(1)) break;
+      uint8_t t = r.p[0];
+      r.off = 1;
+      if (t == kMsg) {
+        uint64_t tag = r.u64();
+        std::string payload = r.bytes();
+        if (!r.ok) continue;
+        {
+          std::lock_guard<std::mutex> g(msg_mu);
+          msgs[tag].push_back(std::move(payload));
+        }
+        msg_cv.notify_all();
+      } else if (t == kFetch) {
+        uint64_t rid = r.u64(), tag = r.u64();
+        if (!r.ok) continue;
+        std::string rep;
+        rep.push_back(char(kRelayReply));
+        put_u64(rep, rid);
+        {
+          std::lock_guard<std::mutex> g(mail_mu);
+          mailbox_gc_locked();
+          auto it = mailbox.find(tag);
+          if (it == mailbox.end()) {
+            rep.push_back(char(0));
+            put_bytes(rep, nullptr, 0);
+          } else {
+            rep.push_back(char(1));
+            put_bytes(rep, reinterpret_cast<const uint8_t *>(
+                               it->second.payload.data()),
+                      it->second.payload.size());
+          }
+        }
+        std::lock_guard<std::mutex> g(*wmu);
+        if (!write_frame(fd, rep)) break;
+      } else if (t == kRelayReply) {
+        uint64_t rid = r.u64();
+        uint8_t hit = 0;
+        if (r.need(1)) {
+          hit = r.p[r.off];
+          r.off += 1;
+        }
+        std::string payload = r.bytes();
+        if (!r.ok) continue;
+        std::shared_ptr<PendingFetch> pf;
+        {
+          std::lock_guard<std::mutex> g(pend_mu);
+          auto it = pending.find(rid);
+          if (it != pending.end()) pf = it->second;
+        }
+        if (pf) {
+          std::lock_guard<std::mutex> g(pf->mu);
+          pf->done = true;
+          pf->hit = hit != 0;
+          pf->payload = std::move(payload);
+          pf->cv.notify_all();
+        }
+      }
+    }
+    drop_direct(peer, fd);
+    close(fd);
+  }
+
+  bool register_direct(int fd, const NodeId &peer) {
+    auto wmu = std::make_shared<std::mutex>();
+    /* reads idle indefinitely (destroy() unblocks via handler_fds);
+     * WRITES are bounded so a stalled peer cannot park a sender holding
+     * the link's write mutex forever, and TCP_USER_TIMEOUT makes a
+     * half-open link (NAT mapping died, no RST) error out instead of
+     * buffering sends into the void for hours */
+    timeval tv{0, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    timeval stv{30, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &stv, sizeof stv);
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof one);
+#ifdef TCP_USER_TIMEOUT
+    unsigned int ut = 30000;
+    setsockopt(fd, IPPROTO_TCP, TCP_USER_TIMEOUT, &ut, sizeof ut);
+#endif
+    {
+      std::lock_guard<std::mutex> g(dl_mu);
+      if (!running.load()) return false;  /* destroy() already tearing down */
+      auto old = direct_links.find(peer);
+      if (old != direct_links.end()) shutdown(old->second.fd, SHUT_RDWR);
+      direct_links[peer] = {fd, wmu};
+    }
+    /* same lifecycle as inbound handlers: detached + live_handlers +
+     * handler_fds (destroy() shuts the fd to unblock the idle read and
+     * waits for the counter) — no unbounded thread vector */
+    live_handlers.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> g(hfd_mu);
+      handler_fds.insert(fd);
+    }
+    std::thread([this, fd, peer, wmu] {
+      try {
+        serve_direct(fd, peer, wmu);
+      } catch (...) {
+      }
+      {
+        std::lock_guard<std::mutex> g(hfd_mu);
+        handler_fds.erase(fd);
+      }
+      live_handlers.fetch_sub(1);
+    }).detach();
+    return true;
+  }
+
+  /* kMsg straight down the punched link; false -> caller falls back to
+   * the relay (and the dead link is dropped). */
+  bool direct_send(const NodeId &peer, uint64_t tag,
+                   const uint8_t *payload, size_t len) {
+    int fd = -1;
+    std::shared_ptr<std::mutex> wmu;
+    {
+      std::lock_guard<std::mutex> g(dl_mu);
+      auto it = direct_links.find(peer);
+      if (it == direct_links.end()) return false;
+      fd = it->second.fd;
+      wmu = it->second.write_mu;
+    }
+    std::string frame;
+    frame.push_back(char(kMsg));
+    put_u64(frame, tag);
+    put_bytes(frame, payload, len);
+    bool ok;
+    {
+      std::lock_guard<std::mutex> g(*wmu);
+      ok = write_frame(fd, frame);
+    }
+    if (!ok) drop_direct(peer, fd);
+    return ok;
+  }
+
+  /* Mailbox fetch over the punched link (same rid/pending machinery as
+   * relayed fetches). hit=false with ok=true means a clean miss. */
+  bool direct_fetch(const NodeId &peer, uint64_t tag, int tmo_ms,
+                    bool *hit, std::string *payload) {
+    int fd = -1;
+    std::shared_ptr<std::mutex> wmu;
+    {
+      std::lock_guard<std::mutex> g(dl_mu);
+      auto it = direct_links.find(peer);
+      if (it == direct_links.end()) return false;
+      fd = it->second.fd;
+      wmu = it->second.write_mu;
+    }
+    uint64_t rid = next_req_id.fetch_add(1);
+    auto pf = std::make_shared<PendingFetch>();
+    {
+      std::lock_guard<std::mutex> g(pend_mu);
+      pending[rid] = pf;
+    }
+    std::string frame;
+    frame.push_back(char(kFetch));
+    put_u64(frame, rid);
+    put_u64(frame, tag);
+    bool ok;
+    {
+      std::lock_guard<std::mutex> g(*wmu);
+      ok = write_frame(fd, frame);
+    }
+    if (ok) {
+      std::unique_lock<std::mutex> lk(pf->mu);
+      pf->cv.wait_for(lk, std::chrono::milliseconds(tmo_ms),
+                      [&] { return pf->done; });
+      if (!pf->done) {
+        /* the peer did not answer within the caller's budget: treat the
+         * link as dead (a live peer answers misses immediately), report
+         * an authoritative miss, and let later calls use the relay —
+         * falling through to a relay RPC here would silently DOUBLE the
+         * caller's timeout */
+        drop_direct(peer, fd);
+        *hit = false;
+        *payload = {};
+      } else {
+        *hit = pf->hit;
+        *payload = std::move(pf->payload);
+      }
+      ok = true;
+    } else {
+      drop_direct(peer, fd);
+    }
+    {
+      std::lock_guard<std::mutex> g(pend_mu);
+      pending.erase(rid);
+    }
+    return ok;
   }
 
   static void append_nodes(std::string &rep,
@@ -937,7 +1176,7 @@ struct SwarmNode {
               Reader r(req);
               r.off = 1;
               PeerInfo sender{r.id(), host, r.u16()};
-              if (r.ok) serve_attachment(cfd, sender.id);
+              if (r.ok) serve_attachment(cfd, sender.id, host);
               break;
             }
             std::string rep = handle(req, host);
@@ -1147,6 +1386,17 @@ int swarm_node_attach_relay(SwarmNode *node, const char *host, int port) {
     close(fd);
     return -1;
   }
+  {
+    /* the relay's view of our address (server-reflexive host for punch
+     * coordination); absent on replies from pre-r4 relays */
+    Reader r(reply);
+    r.off = 1;
+    std::string obs = r.bytes();
+    if (r.ok && !obs.empty()) {
+      std::lock_guard<std::mutex> g(node->obs_mu);
+      node->observed_host = obs;
+    }
+  }
   set_timeouts(fd, 0);  /* destroy()/re-attach unblocks via shutdown */
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof one);
@@ -1208,6 +1458,10 @@ int swarm_node_relay_send(SwarmNode *node, const char *host, int port,
                           const uint8_t target[32], uint64_t tag,
                           const uint8_t *payload, size_t len,
                           int timeout_ms) {
+  NodeId tid;
+  memcpy(tid.data(), target, 32);
+  /* punched direct link first; the relay is the fallback path */
+  if (node->direct_send(tid, tag, payload, len)) return 0;
   std::string body(reinterpret_cast<const char *>(target), 32);
   put_u64(body, tag);
   put_bytes(body, payload, len);
@@ -1220,6 +1474,20 @@ int swarm_node_relay_send(SwarmNode *node, const char *host, int port,
 uint8_t *swarm_node_relay_fetch(SwarmNode *node, const char *host, int port,
                                 const uint8_t target[32], uint64_t tag,
                                 int timeout_ms, size_t *out_len) {
+  NodeId tid;
+  memcpy(tid.data(), target, 32);
+  {
+    bool hit = false;
+    std::string payload;
+    int tmo = timeout_ms > 0 ? timeout_ms : node->timeout_ms.load();
+    if (node->direct_fetch(tid, tag, tmo, &hit, &payload)) {
+      if (!hit) return nullptr;  /* clean miss over the direct link */
+      auto *buf = static_cast<uint8_t *>(malloc(payload.size()));
+      memcpy(buf, payload.data(), payload.size());
+      *out_len = payload.size();
+      return buf;
+    }
+  }
   std::string body(reinterpret_cast<const char *>(target), 32);
   put_u64(body, tag);
   std::string reply;
@@ -1234,6 +1502,189 @@ uint8_t *swarm_node_relay_fetch(SwarmNode *node, const char *host, int port,
   memcpy(buf, payload.data(), payload.size());
   *out_len = payload.size();
   return buf;
+}
+
+/* ---- hole punch C API -------------------------------------------------
+ *
+ * Protocol (DHT-coordinated TCP hole punch, reference: the libp2p
+ * daemon's transport-level hole punching, arguments.py:89-124):
+ *
+ * 1. both peers call prepare(target): bind a socket (SO_REUSEADDR |
+ *    SO_REUSEPORT) to an ephemeral port; the DIALER role (smaller node
+ *    id) gets a plain socket, the ACCEPTOR (larger id) a listener.
+ *    Each advertises the bound port through the DHT (python side).
+ * 2. both call connect(target, other_host, other_port, timeout): the
+ *    dialer connect()s in a retry loop FROM its bound port (re-binding
+ *    after each refused attempt keeps the NAT mapping alive — the
+ *    simultaneous-open path); the acceptor accept()s.
+ * 3. both sides exchange kPunchHello || header and verify the peer id
+ *    matches the expectation; the socket then becomes a DirectLink that
+ *    relayed sends/fetches prefer over the relay.
+ */
+
+static int bound_socket(int *out_port, bool listen_too) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+#ifdef SO_REUSEPORT
+  setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof one);
+#endif
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(uint16_t(*out_port));
+  if (bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
+      (listen_too && listen(fd, 4) != 0)) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  *out_port = ntohs(addr.sin_port);
+  return fd;
+}
+
+static bool punch_hello(SwarmNode *node, int fd, const NodeId &expect,
+                        int timeout_ms) {
+  set_timeouts(fd, timeout_ms);
+  std::string hello;
+  hello.push_back(char(kPunchHello));
+  hello += node->header();
+  if (!write_frame(fd, hello)) return false;
+  std::string got;
+  if (!read_frame(fd, &got)) return false;
+  Reader r(got);
+  if (!r.need(1) || r.p[0] != kPunchHello) return false;
+  r.off = 1;
+  NodeId peer = r.id();
+  return r.ok && peer == expect;
+}
+
+int swarm_node_punch_prepare(SwarmNode *node, const uint8_t target[32]) {
+  NodeId tid;
+  memcpy(tid.data(), target, 32);
+  bool dialer = node->id < tid;
+  int port = 0;
+  int fd = bound_socket(&port, /*listen_too=*/!dialer);
+  if (fd < 0) return -1;
+  std::lock_guard<std::mutex> g(node->dl_mu);
+  auto old = node->punch_sockets.find(tid);
+  if (old != node->punch_sockets.end()) close(old->second);
+  node->punch_sockets[tid] = fd;
+  return port;
+}
+
+int swarm_node_punch_connect(SwarmNode *node, const uint8_t target[32],
+                             const char *host, int port, int timeout_ms) {
+  /* count as a live handler so destroy() (which sets running=false and
+   * then waits for the counter) cannot free the node under our feet */
+  node->live_handlers.fetch_add(1);
+  struct Guard {
+    SwarmNode *n;
+    ~Guard() { n->live_handlers.fetch_sub(1); }
+  } guard{node};
+  if (!node->running.load()) return -1;
+  NodeId tid;
+  memcpy(tid.data(), target, 32);
+  bool dialer = node->id < tid;
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> g(node->dl_mu);
+    auto it = node->punch_sockets.find(tid);
+    if (it == node->punch_sockets.end()) return -1;
+    fd = it->second;
+    node->punch_sockets.erase(it);
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int conn = -1;
+  if (dialer) {
+    sockaddr_in raddr{};
+    raddr.sin_family = AF_INET;
+    raddr.sin_port = htons(uint16_t(port));
+    if (inet_pton(AF_INET, host, &raddr.sin_addr) != 1) {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+        close(fd);
+        return -1;
+      }
+      raddr.sin_addr =
+          reinterpret_cast<sockaddr_in *>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    sockaddr_in laddr{};
+    socklen_t llen = sizeof laddr;
+    getsockname(fd, reinterpret_cast<sockaddr *>(&laddr), &llen);
+    int lport = ntohs(laddr.sin_port);
+    while (std::chrono::steady_clock::now() < deadline &&
+           node->running.load()) {
+      set_timeouts(fd, 1000);
+      if (connect(fd, reinterpret_cast<sockaddr *>(&raddr),
+                  sizeof raddr) == 0) {
+        conn = fd;
+        fd = -1;
+        break;
+      }
+      /* refused/timed out: a fresh socket re-bound to the SAME port
+       * keeps the advertised mapping while we retry */
+      close(fd);
+      std::this_thread::sleep_for(std::chrono::milliseconds(150));
+      int p = lport;
+      fd = bound_socket(&p, false);
+      if (fd < 0) return -1;
+    }
+  } else {
+    while (std::chrono::steady_clock::now() < deadline &&
+           node->running.load()) {
+      set_timeouts(fd, 1000);
+      sockaddr_in who{};
+      socklen_t wlen = sizeof who;
+      int c = accept(fd, reinterpret_cast<sockaddr *>(&who), &wlen);
+      if (c >= 0) {
+        conn = c;
+        break;
+      }
+    }
+    close(fd);
+    fd = -1;
+  }
+  if (fd >= 0) close(fd);
+  if (conn < 0) return -1;
+  int remain = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                       deadline - std::chrono::steady_clock::now())
+                       .count());
+  if (!punch_hello(node, conn, tid, std::max(1000, remain)) ||
+      !node->register_direct(conn, tid)) {
+    close(conn);
+    return -1;
+  }
+  return 0;
+}
+
+/* Host as observed by this node's relay (server-reflexive address for
+ * punch coordination). malloc'd string or NULL if no relay reported one. */
+uint8_t *swarm_node_observed_host(SwarmNode *node, size_t *out_len) {
+  std::lock_guard<std::mutex> g(node->obs_mu);
+  if (node->observed_host.empty()) return nullptr;
+  auto *buf = static_cast<uint8_t *>(malloc(node->observed_host.size()));
+  memcpy(buf, node->observed_host.data(), node->observed_host.size());
+  *out_len = node->observed_host.size();
+  return buf;
+}
+
+int swarm_node_has_direct(SwarmNode *node, const uint8_t target[32]) {
+  NodeId tid;
+  memcpy(tid.data(), target, 32);
+  std::lock_guard<std::mutex> g(node->dl_mu);
+  return node->direct_links.count(tid) ? 1 : 0;
+}
+
+uint64_t swarm_node_relay_served(SwarmNode *node) {
+  return node->relay_served.load();
 }
 
 uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len) {
@@ -1272,6 +1723,18 @@ void swarm_node_destroy(SwarmNode *node) {
       node->my_relay_fd = -1;
     }
     if (node->my_relay_reader.joinable()) node->my_relay_reader.join();
+  }
+  /* tear down punched links + prepared punch sockets (their reader
+   * threads follow the handler lifecycle: the handler_fds shutdown
+   * above unblocked them, live_handlers below waits them out) */
+  {
+    std::lock_guard<std::mutex> g(node->dl_mu);
+    for (auto &kv : node->direct_links) shutdown(kv.second.fd, SHUT_RDWR);
+    for (auto &kv : node->punch_sockets) {
+      shutdown(kv.second, SHUT_RDWR);
+      close(kv.second);
+    }
+    node->punch_sockets.clear();
   }
   node->pool_clear();
   /* Wait for in-flight handler threads: they hold `node`, so deleting

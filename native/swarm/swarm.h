@@ -116,6 +116,42 @@ uint8_t *swarm_node_relay_fetch(SwarmNode *node, const char *host, int port,
                                 const uint8_t target[32], uint64_t tag,
                                 int timeout_ms, size_t *out_len);
 
+/* Hole punch: DHT-coordinated TCP hole punching between two peers that
+ * cannot accept inbound connections (the reference libp2p daemon's
+ * transport-level hole punching; relay remains the fallback). Roles are
+ * deterministic — the smaller node id dials, the larger accepts — so no
+ * tie-break is needed when both directions would succeed.
+ *
+ *   port = swarm_node_punch_prepare(node, target_id);   // bind + advertise
+ *   ...exchange (host, port) with the target through the DHT...
+ *   swarm_node_punch_connect(node, target_id, host, port, timeout_ms);
+ *
+ * On success the connection becomes a DIRECT LINK: swarm_node_relay_send /
+ * swarm_node_relay_fetch to that target use it instead of the relay, and
+ * fall back to the relay automatically if the link dies. */
+
+/* Bind the punch socket for `target`; returns the local port to
+ * advertise, or -1. */
+int swarm_node_punch_prepare(SwarmNode *node, const uint8_t target[32]);
+
+/* Complete the punch against the target's advertised host:port (both
+ * peers must call this concurrently). Verifies the peer's identity with
+ * a hello exchange before registering the link. Returns 0 on success. */
+int swarm_node_punch_connect(SwarmNode *node, const uint8_t target[32],
+                             const char *host, int port, int timeout_ms);
+
+/* 1 if a live punched link to `target` exists. */
+int swarm_node_has_direct(SwarmNode *node, const uint8_t target[32]);
+
+/* Host as observed by this node's relay (kAttachOk reports it): the
+ * server-reflexive address a NAT'd peer advertises when coordinating a
+ * punch. malloc'd (swarm_free) or NULL if never attached. */
+uint8_t *swarm_node_observed_host(SwarmNode *node, size_t *out_len);
+
+/* Number of relayed frames (sends + fetch rounds) this node has served
+ * as a RELAY — lets tests observe punched links bypassing the relay. */
+uint64_t swarm_node_relay_served(SwarmNode *node);
+
 /* Routing table dump: malloc'd buffer of u32 count entries:
  * 32B id, u32 host_len, host, u16 port (BE). */
 uint8_t *swarm_node_peers(SwarmNode *node, size_t *out_len);

@@ -1,0 +1,86 @@
+"""BASELINE.json configs 2-3 benchmark rows (VERDICT r3 next #5).
+
+- config 2: DALL-E 1.3B DENSE — no weight sharing (shared_block_cycle=0,
+  64 independent blocks, ~1.15B unique params). The interesting question
+  is whether the full dense state (f32 params+grads ~9.2 GB + 8-bit
+  moments ~2.3 GB) plus activations fits a 16 GB v5e at any microbatch.
+- config 3: the dalle-pytorch attention-zoo variants — all-full
+  (plain causal) and conv-heavy — against the shipped axial mix.
+
+Appends driver-readable JSON lines to CONFIG_BENCH.json. Run on the TPU
+host:  python scripts/config_bench.py [row ...]
+rows: dense full conv axial (default: all)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_use_direct_linearize", False)
+
+from bench import _bench, _is_oom  # noqa: E402
+from dalle_tpu.config import flagship_model_config  # noqa: E402
+
+ROWS = {
+    # dense: no cycle -> no scan, no partial remat (remat_skip needs a
+    # cycle); blanket remat + streamed head are what make it fit at all
+    "dense": dict(shared_block_cycle=0, remat_skip_blocks=0,
+                  scan_unroll=1),
+    "full": dict(attn_types=("full", "full", "full", "full")),
+    "conv": dict(attn_types=("conv_like", "axial_row", "conv_like",
+                             "axial_row")),
+    "axial": dict(),  # the shipped flagship mix (reference task.py:63-64)
+}
+
+#: (micro, accum) ladder per row — dense carries ~9x the optimizer/grad
+#: state, so its ladder starts low
+LADDERS = {
+    "dense": [(2, 16), (1, 16), (1, 8)],
+    "full": [(4, 32), (2, 16)],
+    "conv": [(4, 32), (2, 16)],
+    "axial": [(4, 32)],
+}
+
+
+def main():
+    rows = sys.argv[1:] or list(ROWS)
+    out_path = os.path.join(os.path.dirname(__file__), "..",
+                            "CONFIG_BENCH.json")
+    for row in rows:
+        overrides = ROWS[row]
+        result = None
+        for micro, accum in LADDERS[row]:
+            cfg = flagship_model_config(**overrides)
+            t0 = time.time()
+            try:
+                ips = _bench(cfg, micro, accum, warmup=1, iters=3)
+                result = {"metric": f"dalle-1.3b train ({row})",
+                          "micro": micro, "accum": accum,
+                          "value": round(ips, 3),
+                          "unit": "images/sec/chip",
+                          "total_s": round(time.time() - t0, 1)}
+                break
+            except Exception as e:  # noqa: BLE001
+                if not _is_oom(e):
+                    raise
+                print(f"# {row} micro {micro}: OOM-class, walking down "
+                      f"({str(e).splitlines()[0][:160]})",
+                      file=sys.stderr, flush=True)
+        if result is None:
+            result = {"metric": f"dalle-1.3b train ({row})",
+                      "value": None, "unit": "images/sec/chip",
+                      "note": "memory wall: no ladder rung fits"}
+        print(json.dumps(result), flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+
+
+if __name__ == "__main__":
+    main()

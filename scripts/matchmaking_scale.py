@@ -1,0 +1,93 @@
+"""Matchmaking-only scale probe (VERDICT r3 next #6).
+
+The full scale bench (swarm_scale_bench.py) couples matchmaking with
+training compute, and at N>=24 on the one-core VM the COMPUTE saturates
+the box (apply_s inflates 100x), polluting the matchmaking read. This
+probe isolates the protocol: N DHT nodes, no optimizers, R rounds of
+concurrent make_group, reporting per-round matchmaking wall time plus
+the DHT-level fan-out counters that drive it (announce store + roster
+get per peer per round).
+
+Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+      python scripts/matchmaking_scale.py [N ...]
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dalle_tpu.swarm import DHT, Identity  # noqa: E402
+from dalle_tpu.swarm.matchmaking import make_group  # noqa: E402
+
+
+def bench(n: int, rounds: int = 3, matchmaking_time: float = 3.0):
+    nodes = []
+    for _ in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        nodes.append(DHT(initial_peers=peers,
+                         identity=Identity.generate(), rpc_timeout=3.0))
+
+    per_round = []
+    sizes = []
+    for r in range(rounds):
+        times = [0.0] * n
+        groups = [None] * n
+
+        def peer(i, r=r):
+            t0 = time.monotonic()
+            groups[i] = make_group(
+                nodes[i], "mscale", r, weight=1.0,
+                matchmaking_time=matchmaking_time, min_group_size=2)
+            times[i] = time.monotonic() - t0
+
+        ts = [threading.Thread(target=peer, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        grouped = [g for g in groups if g is not None and g.size > 1]
+        per_round.append(times)
+        sizes.append([g.size for g in grouped])
+
+    all_times = np.array(per_round).reshape(-1)
+    # how fragmented did the swarm match? (1 giant group vs many small)
+    flat_sizes = [s for row in sizes for s in row]
+    row = {
+        "metric": f"matchmaking scale ({n} peers)",
+        "rounds": rounds,
+        "stability_window_s": matchmaking_time,
+        "median_matchmaking_s": round(float(np.median(all_times)), 2),
+        "p90_matchmaking_s": round(float(np.percentile(all_times, 90)), 2),
+        "grouped_peers_per_round": round(
+            float(np.mean([len(s) for s in sizes])), 1),
+        "median_group_size": (round(float(np.median(flat_sizes)), 1)
+                              if flat_sizes else 0),
+    }
+    print(json.dumps(row), flush=True)
+    for d in nodes:
+        d.shutdown()
+    return row
+
+
+def main():
+    ns = [int(a) for a in sys.argv[1:]] or [8, 16, 24, 32]
+    rows = [bench(n) for n in ns]
+    print("\n| peers | median match s | p90 s | median group |")
+    print("|---|---|---|---|")
+    for r in rows:
+        n = r["metric"].split("(")[1].split()[0]
+        print(f"| {n} | {r['median_matchmaking_s']} "
+              f"| {r['p90_matchmaking_s']} | {r['median_group_size']} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""Sustained flagship training run on the real chip (VERDICT r3 weak #4).
+
+Runs the PRODUCTION path — TrainingTask -> train_loop (warmup self-check,
+jitted accumulate grad step, collaborative optimizer in solo mode,
+NaN sweep + rollback, rolling checkpoints) — at the tuned operating
+point (micro 4 x accum 64, remat skip 1, fused plain-block FF, 8-bit
+LAMB) on synthetic shard data for a wall-clock budget, logging one JSONL
+line per global step: the loss curve, step-time variance, NaN/rollback
+count and checkpoint cadence the reference's operators read off their
+wandb dashboards (SURVEY.md section 4).
+
+Run:  python scripts/sustained_run.py [minutes] [out_prefix]
+Artifacts: SUSTAINED_RUN.jsonl (per-step log) + SUSTAINED_RUN.json
+(driver-readable summary line).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 40.0
+    prefix = sys.argv[2] if len(sys.argv) > 2 else "SUSTAINED_RUN"
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_use_direct_linearize", False)
+
+    from dalle_tpu.config import (CollabConfig, OptimizerConfig,
+                                  PeerConfig, TrainerConfig,
+                                  flagship_model_config)
+    from dalle_tpu.task import TrainingTask
+    from dalle_tpu.training.loop import train_loop
+
+    model = flagship_model_config()
+    trainer = TrainerConfig(per_device_batch=4, grad_accum_steps=64)
+    # solo peer: every 256-sample local step completes a swarm epoch, so
+    # the LAMB apply + NaN sweep + checkpoint cadence all exercise
+    collab = CollabConfig(run_id="sustained", target_batch_size=256,
+                          average_state_every=0)
+    # a solo FULL peer: swarm of one, every epoch takes the ALONE path
+    # (LAMB apply + sweep + checkpoints all run; no wire traffic)
+    task = TrainingTask(model, OptimizerConfig(), trainer, collab,
+                        PeerConfig())
+
+    # count NaN rollbacks (train_loop reports them via logging)
+    import logging
+
+    rollbacks = {"n": 0}
+
+    class _RollbackCounter(logging.Handler):
+        def emit(self, record):
+            if "rolling back" in record.getMessage():
+                rollbacks["n"] += 1
+
+    logging.getLogger("dalle_tpu.training.loop").addHandler(
+        _RollbackCounter())
+    logging.basicConfig(level=logging.INFO)
+
+    log_path = f"{prefix}.jsonl"
+    log = open(log_path, "w")
+    t_start = time.monotonic()
+    deadline = t_start + minutes * 60
+    state = {"steps": 0, "last_t": None, "step_times": [],
+             "losses": [], "epochs_seen": set()}
+
+    def on_epoch(rep):
+        now = time.monotonic()
+        dt = None if state["last_t"] is None else now - state["last_t"]
+        state["last_t"] = now
+        if dt is not None:
+            state["step_times"].append(dt)
+        state["losses"].append(rep.loss)
+        state["epochs_seen"].add(rep.epoch)
+        state["steps"] += 1
+        log.write(json.dumps({
+            "t_s": round(now - t_start, 1),
+            "epoch": rep.epoch,
+            "loss": round(rep.loss, 4),
+            "samples_per_s": round(rep.samples_per_second, 2),
+            "step_s": None if dt is None else round(dt, 2),
+        }) + "\n")
+        log.flush()
+        if now >= deadline:
+            raise KeyboardInterrupt  # budget reached: clean stop
+
+    ckpt_dir = os.path.abspath(f"{prefix}_ckpt")
+    try:
+        train_loop(task, warmup_steps=2, on_epoch=on_epoch,
+                   publish_metrics_records=False,
+                   checkpoint_dir=ckpt_dir, save_every=10,
+                   backup_every=1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        task.shutdown()
+        log.close()
+
+    import numpy as np
+
+    losses = np.array(state["losses"])
+    times = np.array(state["step_times"]) if state["step_times"] else \
+        np.array([0.0])
+    n = len(losses)
+    ckpts = sorted(os.listdir(ckpt_dir)) if os.path.isdir(ckpt_dir) else []
+    summary = {
+        "metric": "dalle-1.3b sustained run (tpu, tuned operating point)",
+        "wall_minutes": round((time.monotonic() - t_start) / 60, 1),
+        "global_steps": n,
+        "samples_per_step": 256,
+        "first_loss": round(float(losses[0]), 4) if n else None,
+        "last_loss": round(float(losses[-1]), 4) if n else None,
+        "mean_last5_loss": round(float(losses[-5:].mean()), 4) if n else
+        None,
+        "loss_monotone_trend": bool(n >= 4 and losses[-3:].mean()
+                                    < losses[:3].mean()),
+        "step_s_median": round(float(np.median(times)), 2),
+        "step_s_p95": round(float(np.percentile(times, 95)), 2),
+        "step_s_cv": round(float(times.std() / max(times.mean(), 1e-9)),
+                           4),
+        "images_per_sec_chip": round(256 / float(np.median(times)), 3)
+        if times.mean() > 0 else None,
+        "nan_rollbacks": rollbacks["n"],
+        "checkpoints": ckpts,
+        "log": log_path,
+    }
+    line = json.dumps(summary)
+    print(line, flush=True)
+    with open(f"{prefix}.json", "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

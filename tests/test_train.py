@@ -198,3 +198,38 @@ class TestSharded:
         step = jax.jit(make_train_step(model, tx))
         new_state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestTaskGradAccum:
+    def test_task_grad_step_accumulates_microbatches(self, tmp_path):
+        """task.grad_step must thread trainer.grad_accum_steps into the
+        jitted step: without it the flagship's 256-sample local batch
+        lowers as ONE unsplit forward (tens of GB of activations — found
+        by the r4 sustained run). Accumulated grads must equal the
+        unsplit computation on the same samples."""
+        from dalle_tpu.config import (CollabConfig, PeerConfig,
+                                      TrainerConfig)
+        from dalle_tpu.task import TrainingTask
+
+        def make(accum, name):
+            return TrainingTask(
+                tiny_model_config(), OptimizerConfig(),
+                TrainerConfig(per_device_batch=2, grad_accum_steps=accum),
+                CollabConfig(run_id=f"ga-{name}", target_batch_size=999),
+                PeerConfig(identity_path=str(tmp_path / f"{name}.pem")))
+
+        t_acc, t_flat = make(2, "acc"), make(1, "flat")
+        try:
+            batch = next(t_acc.batches())  # local batch = 2*2*shards
+            params = t_acc.train_state.params
+            g_acc, m_acc = t_acc.grad_step(params, batch)
+            g_flat, m_flat = t_flat.grad_step(params, batch)
+            assert np.isclose(float(m_acc["loss"]), float(m_flat["loss"]),
+                              rtol=1e-5)
+            for a, b in zip(jax.tree.leaves(g_acc),
+                            jax.tree.leaves(g_flat)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=1e-6)
+        finally:
+            t_acc.shutdown()
+            t_flat.shutdown()
