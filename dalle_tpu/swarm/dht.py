@@ -365,10 +365,14 @@ class DHT:
                 if strip_owner(sub) == other_sub:
                     rec = (str(r.value["host"]), int(r.value["port"]))
             if rec is not None and rec != tried:
+                # cap the per-attempt budget: a stale record (the other
+                # side already re-bound) must not burn the whole window —
+                # the loop re-polls and picks up the fresh one
                 remaining = max(1.0, deadline - time.monotonic())
+                attempt = min(remaining, 3.0)
                 rc = self._lib.swarm_node_punch_connect(
                     self._node, target, rec[0].encode(), rec[1],
-                    int(remaining * 1000))
+                    int(attempt * 1000))
                 if rc == 0:
                     return True
                 tried = rec  # stale/failed: re-bind and wait for a fresh one

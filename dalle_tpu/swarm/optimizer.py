@@ -260,6 +260,15 @@ class CollaborativeOptimizer:
         sharded = not all(is_fully_addressable(g) for g in leaves)
         weight = float(max(self.local_samples, 1))
 
+        # single-process plain-codec peers defer the host grad pull until
+        # a real group forms: an ALONE epoch applies the DEVICE grads
+        # directly, and pulling ~0.5 GB of f32 through a slow
+        # host<->device link dominated solo flagship epochs (r4 sustained
+        # run: 100+ s/epoch of pure transfer). Multi-process slices keep
+        # the eager pull — host_global is a lockstep collective that must
+        # run on every process before the coordinator/follower split.
+        lazy_pull = (not sharded and self._powersgd is None
+                     and jax.process_count() == 1)
         if not (self.role.swarm_enabled or sharded):
             grads_local = None  # unsharded follower: broadcast only
         elif self._powersgd is not None:
@@ -267,6 +276,8 @@ class CollaborativeOptimizer:
             # phase1 projects them there and only rank-r factors (plus the
             # small unplanned tail) are pulled for the wire
             grads_local: List[Any] = [g / weight for g in leaves]
+        elif lazy_pull:
+            grads_local = None  # pulled below iff the epoch exchanges
         else:
             grads_local = [a / weight for a in host_global(leaves)]
         t_pull = time.monotonic()
@@ -287,7 +298,12 @@ class CollaborativeOptimizer:
                 self._X_ALLREDUCE) if exchanging else self._X_ALONE
         if sharded:
             broadcast_decision(mode)
+        pull_s = t_pull - t0
         if exchanging:
+            if grads_local is None:  # deferred pull: the wire needs host
+                t_lazy = time.monotonic()
+                grads_local = [a / weight for a in host_global(leaves)]
+                pull_s += time.monotonic() - t_lazy  # keep attribution
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
                              - (time.monotonic() - t0)))
@@ -305,7 +321,10 @@ class CollaborativeOptimizer:
                     allreduce_timeout=budget, codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold)
         else:
-            averaged = grads_local  # alone this epoch
+            # alone this epoch: with a deferred pull the grads never left
+            # the device — they flow straight into the jitted apply
+            averaged = (grads_local if grads_local is not None
+                        else [g / weight for g in leaves])
         # deliver the averaged gradients to this slice's followers. On
         # sharded slices the PowerSGD result is already global on every
         # process (device SPMD + in-phase broadcasts) and the ALONE case
@@ -324,9 +343,10 @@ class CollaborativeOptimizer:
         # sps). apply/state-averaging split comes from _apply_averaged so
         # state-averaging network time is not misattributed to compute.
         self.last_timings = {
-            "grad_pull_s": round(t_pull - t0, 4),
+            "grad_pull_s": round(pull_s, 4),
             "matchmaking_s": round(t_match - t_pull, 4),
-            "allreduce_s": round(t_reduce - t_match, 4),
+            "allreduce_s": round(t_reduce - t_match - max(
+                0.0, pull_s - (t_pull - t0)), 4),
             **self._apply_timings,
         }
         logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",

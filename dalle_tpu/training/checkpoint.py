@@ -71,8 +71,13 @@ def _read_header(path: str) -> Optional[int]:
 def _read_payload(path: str):
     with open(path, "rb") as f:
         blob = f.read()
+    # Decode the tiny epoch header from a bounded PREFIX: feeding the
+    # whole blob would duplicate a flagship-scale checkpoint (~1.2 GB)
+    # inside the unpacker's buffer (the default 100 MB max_buffer_size
+    # raised BufferFull outright — found by the r4 sustained run's
+    # resume; tiny-model tests never hit it).
     unpacker = msgpack.Unpacker(raw=False)
-    unpacker.feed(blob)
+    unpacker.feed(blob[:4096])
     epoch = int(unpacker.unpack()["epoch"])
     state_dict = flax.serialization.msgpack_restore(blob[unpacker.tell():])
     return epoch, state_dict

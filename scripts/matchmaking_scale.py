@@ -38,8 +38,9 @@ def bench(n: int, rounds: int = 3, matchmaking_time: float = 3.0):
 
     per_round = []
     sizes = []
+    hung_total = 0
     for r in range(rounds):
-        times = [0.0] * n
+        times = [None] * n  # None = never finished (counted, not hidden)
         groups = [None] * n
 
         def peer(i, r=r):
@@ -55,10 +56,13 @@ def bench(n: int, rounds: int = 3, matchmaking_time: float = 3.0):
         for t in ts:
             t.join(60)
         grouped = [g for g in groups if g is not None and g.size > 1]
-        per_round.append(times)
+        hung_total += sum(1 for t in times if t is None)
+        per_round.append([t for t in times if t is not None])
         sizes.append([g.size for g in grouped])
 
-    all_times = np.array(per_round).reshape(-1)
+    all_times = np.array([t for row in per_round for t in row])
+    if all_times.size == 0:
+        all_times = np.array([float("nan")])
     # how fragmented did the swarm match? (1 giant group vs many small)
     flat_sizes = [s for row in sizes for s in row]
     row = {
@@ -71,6 +75,7 @@ def bench(n: int, rounds: int = 3, matchmaking_time: float = 3.0):
             float(np.mean([len(s) for s in sizes])), 1),
         "median_group_size": (round(float(np.median(flat_sizes)), 1)
                               if flat_sizes else 0),
+        "peers_never_finished": hung_total,
     }
     print(json.dumps(row), flush=True)
     for d in nodes:

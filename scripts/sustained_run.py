@@ -42,8 +42,11 @@ def main():
     trainer = TrainerConfig(per_device_batch=4, grad_accum_steps=64)
     # solo peer: every 256-sample local step completes a swarm epoch, so
     # the LAMB apply + NaN sweep + checkpoint cadence all exercise
+    # matchmaking_time: a SOLO peer waits out the whole window every
+    # epoch before proceeding alone; 3 s keeps the cadence honest without
+    # spending a third of the run in an empty lobby
     collab = CollabConfig(run_id="sustained", target_batch_size=256,
-                          average_state_every=0)
+                          matchmaking_time=3.0, average_state_every=0)
     # a solo FULL peer: swarm of one, every epoch takes the ALONE path
     # (LAMB apply + sweep + checkpoints all run; no wire traffic)
     task = TrainingTask(model, OptimizerConfig(), trainer, collab,
@@ -92,10 +95,13 @@ def main():
 
     ckpt_dir = os.path.abspath(f"{prefix}_ckpt")
     try:
+        # backup cadence 5: each backup serializes ~1.2 GB of state
+        # through the tunnel's slow host link (~2 min); every-epoch
+        # backups would halve the run's step count
         train_loop(task, warmup_steps=2, on_epoch=on_epoch,
                    publish_metrics_records=False,
                    checkpoint_dir=ckpt_dir, save_every=10,
-                   backup_every=1)
+                   backup_every=5)
     except KeyboardInterrupt:
         pass
     finally:

@@ -140,8 +140,11 @@ def run(out_path="XL_STEP.json", cpu_axis="fsdp"):
     }
     line = json.dumps(result)
     print(line, flush=True)
-    mode = "a" if os.path.exists(out_path) else "w"
-    with open(out_path, mode) as f:
+    # anchor the artifact to the repo root regardless of CWD (like the
+    # sibling bench scripts)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", out_path)
+    with open(out_path, "a") as f:
         f.write(line + "\n")
 
 

@@ -154,3 +154,23 @@ class TestLoopRecovery:
             assert collab.local_epoch >= 3
         finally:
             task.shutdown()
+
+class TestLargeCheckpoint:
+    def test_restore_past_msgpack_default_buffer(self, tmp_path):
+        """Flagship-scale blobs exceed msgpack.Unpacker's default
+        100 MB max_buffer_size; restore must not BufferFull (found by
+        the r4 sustained run's resume — tiny-model tests never hit
+        it)."""
+        from dalle_tpu.training.checkpoint import CheckpointManager
+
+        big = {"w": jnp.arange(30_000_000, dtype=jnp.float32)}  # ~120 MB
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(big, epoch=7)
+        restored = mgr.restore_latest(
+            {"w": jnp.zeros(30_000_000, jnp.float32)})
+        assert restored is not None
+        state, epoch = restored
+        assert epoch == 7
+        np.testing.assert_array_equal(np.asarray(state["w"][-4:]),
+                                      np.arange(30_000_000,
+                                                dtype=np.float32)[-4:])
