@@ -369,18 +369,26 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
 def generate_images(params: Dict, cfg: ModelConfig,
                     text_tokens: jax.Array, rng: jax.Array,
                     sampling: SamplingConfig = SamplingConfig(),
-                    buckets: int = 4) -> jax.Array:
+                    buckets: Optional[int] = None) -> jax.Array:
     """Sample (B, image_seq_len) VQGAN codes for the given captions.
 
     ``lax.scan`` over the positions — split into ``buckets`` prefix
     buckets whose attention reads statically-truncated caches (see the
     bucketing comment below; ``buckets=1`` is the single full-length
-    scan). The text prefix is teacher-forced, image positions sample from
-    the segment-masked logits (reference ``generate_images(text,
-    temperature, top_k, top_p, use_cache=True)``,
+    scan). ``buckets=None`` picks by batch size: each bucket boundary
+    re-materializes the (B, T, H*d) cache carry, a cost that grows with
+    B while the dead-tail-read savings do not — measured on the v5e
+    flagship (DECODE_BENCH.json r4): B<=8 peaks at 4 buckets
+    (39.5 img/min at B=8), B=16 at 2 (44.2 img/min; 4 buckets there
+    REGRESSES to 32.7). The B<=8 / B>=12 threshold interpolates the
+    measured B=8/B=16 crossover. The text prefix is teacher-forced, image
+    positions sample from the segment-masked logits (reference
+    ``generate_images(text, temperature, top_k, top_p, use_cache=True)``,
     inference/run_inference.py:88-89).
     """
     b = text_tokens.shape[0]
+    if buckets is None:
+        buckets = 4 if b <= 8 else 2
     bos_id = cfg.vocab_total
     cache = init_cache(cfg, b)
 

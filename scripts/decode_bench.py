@@ -2,7 +2,11 @@
 for the reference's generation workload (inference/run_inference.py:
 87-90,132 generates 16 images x 8 iterations per query).
 
-Run on the TPU host:  python scripts/decode_bench.py [batch] [iters]
+Run on the TPU host:  python scripts/decode_bench.py [batch] [iters] [buckets]
+
+``buckets`` defaults to the SHIPPED adaptive choice (generate_images
+buckets=None) so the trend file tracks production; pass an explicit
+count to sweep alternatives (the r4 bucket table in PERF.md).
 
 Appends one driver-readable JSON line per run to DECODE_BENCH.json at
 the repo root (VERDICT r3 weak #6: the decode trend must be as
@@ -50,12 +54,14 @@ from dalle_tpu.models.decode import (SamplingConfig,  # noqa: E402
 def main():
     b = int(sys.argv[1]) if len(sys.argv) > 1 else 4
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    buckets = int(sys.argv[3]) if len(sys.argv) > 3 else None
     cfg = flagship_model_config(param_dtype="bfloat16")
     model = DALLE(cfg)
     params = init_params(model, jax.random.PRNGKey(0))
     text = jnp.ones((b, cfg.text_seq_len), jnp.int32)
     gen = jax.jit(lambda p, t, r: generate_images(
-        p, cfg, t, r, SamplingConfig(temperature=1.0, top_k=64)))
+        p, cfg, t, r, SamplingConfig(temperature=1.0, top_k=64),
+        buckets=buckets))
 
     t0 = time.time()
     jax.device_get(gen(params, text, jax.random.PRNGKey(1)))
@@ -82,6 +88,7 @@ def main():
             "metric": "dalle-1.3b decode images/min",
             "batch": b,
             "iters": iters,
+            "buckets": buckets,
             "compile_plus_first_s": round(t_compile, 1),
             "sec_per_query": round(dt / iters, 2),
             "value": round(img_per_min, 1),
