@@ -60,6 +60,14 @@ class ModelConfig:
     # (task.py:65,78-79: shared_attn_ids/shared_ff_ids cycle(0,1,2,3)).
     # 0 disables sharing (every layer owns parameters).
     shared_block_cycle: int = 4
+    # Dense (cycle=0) stacks as a scan with STACKED per-iteration params
+    # instead of unrolling depth blocks: the compiled body is one
+    # attn-type cycle, each iteration reads its own parameter slice
+    # (leading axis = repetitions). A 64-independent-block flagship
+    # unrolls to a ~16x larger XLA program that the tunnel's compile
+    # service cannot finish; the scanned dense body compiles like the
+    # weight-shared model. Train-path only (decode reads per-block trees).
+    dense_scan: bool = False
     # Whether the final layer is a distinct conv_like block with its own
     # parameters ('w_conv' shared id in task.py:65).
     final_conv_block: bool = True
@@ -103,6 +111,13 @@ class ModelConfig:
     # avoids the residual, so this mostly trades FLOPs for HBM traffic);
     # "none" keeps the unfused XLA lowering everywhere.
     ff_fusion: str = "plain"
+    # Single-pass Pallas LayerNorm with fused backward
+    # (ops/pallas/ln_kernels.py): forward reads/writes each row once with
+    # both statistics formed in-register; backward produces dx and the
+    # dscale/dbias partials in ONE pass instead of XLA's separate
+    # reduction fusions. flax-parity numerics; unsupported shapes (tiny
+    # test models, single-token decode) fall back to the plain lowering.
+    ln_fusion: bool = False
     dtype: str = "bfloat16"          # activation dtype on TPU (MXU-native)
     param_dtype: str = "float32"
     # Sequence parallelism over the mesh's ``sp`` axis: "none", "ulysses"
@@ -149,6 +164,18 @@ class ModelConfig:
         if self.final_conv_block:
             sched.append((-1, ATTN_CONV_LIKE))
         return tuple(sched)
+
+    def dense_scan_reps(self) -> int:
+        """Scan repetitions of the dense_scan (stacked-params) path — the
+        ONE source of truth for "is the dense tree stacked?", shared by
+        the transformer build and decode's parameter slicing. 0 when the
+        dense stack unrolls instead (weight sharing on, dense_scan off,
+        or body too shallow to scan)."""
+        if self.shared_block_cycle or not self.dense_scan:
+            return 0
+        body = self.depth - (1 if self.final_conv_block else 0)
+        reps = -(-body // len(self.attn_types))
+        return reps if reps > 1 else 0
 
     def validate(self) -> None:
         for t in self.attn_types:

@@ -56,8 +56,22 @@ def layer_params(params: Dict, cfg: ModelConfig) -> List[Dict]:
     for key, val in tr.items():
         if key.startswith("block"):
             blocks[key] = val
+    group = len(cfg.attn_types)
+    # the stacked tree exists only when the dense stack actually scanned
+    # (cfg.dense_scan_reps() is the one source of truth, shared with the
+    # transformer build); shallow dense_scan configs unroll and store
+    # plain block_{uid} params
+    dense_stacked = cfg.dense_scan_reps() > 0
     out = []
     for uid, attn_type in cfg.layer_schedule():
+        if dense_stacked and uid != -1:
+            # dense_scan tree: cycle/block_{uid%group} with a leading
+            # stacked axis of scan repetitions — slice this layer's rep
+            rep, sub = divmod(uid, group)
+            sliced = jax.tree.map(lambda a: a[rep],
+                                  blocks[f"block_{sub}"])
+            out.append({"attn_type": attn_type, **sliced})
+            continue
         name = "block_wconv" if uid == -1 else f"block_{uid}"
         out.append({"attn_type": attn_type, **blocks[name]})
     return out
@@ -366,6 +380,17 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+def resolve_buckets(buckets: Optional[int], batch: int) -> int:
+    """The adaptive prefix-bucket choice (``buckets=None``): each bucket
+    boundary re-materializes the (B, T, H*d) cache carry, a cost that
+    grows with B while the dead-tail-read savings do not — measured on
+    the v5e flagship (DECODE_BENCH.json r4), B<=8 peaks at 4 buckets,
+    B>=12 at 2."""
+    if buckets is None:
+        return 4 if batch <= 8 else 2
+    return buckets
+
+
 def generate_images(params: Dict, cfg: ModelConfig,
                     text_tokens: jax.Array, rng: jax.Array,
                     sampling: SamplingConfig = SamplingConfig(),
@@ -387,8 +412,7 @@ def generate_images(params: Dict, cfg: ModelConfig,
     inference/run_inference.py:88-89).
     """
     b = text_tokens.shape[0]
-    if buckets is None:
-        buckets = 4 if b <= 8 else 2
+    buckets = resolve_buckets(buckets, b)
     bos_id = cfg.vocab_total
     cache = init_cache(cfg, b)
 

@@ -108,6 +108,51 @@ class ZooAttention(nn.Module):
                         param_dtype=_param_dtype(cfg), name="out")(out)
 
 
+class FusedLayerNorm(nn.Module):
+    """Parameter-compatible stand-in for ``nn.LayerNorm``: owns the same
+    ``{scale, bias}`` (d,) params in param dtype, routed through the
+    single-pass Pallas kernel (ops/pallas/ln_kernels.py) when the shape
+    supports it. The fallback is the flax lowering written out inline
+    (f32 stats, fast variance, f32 affine) so both paths share one
+    parameter tree and one numerical contract."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (d,),
+                           _param_dtype(cfg))
+        bias = self.param("bias", nn.initializers.zeros_init(), (d,),
+                          _param_dtype(cfg))
+        from dalle_tpu.models import attention as attn_mod
+        from dalle_tpu.ops.pallas.ln_kernels import (_stats, layer_norm,
+                                                     ln_supported)
+        shape = x.shape
+        m = 1
+        for s in shape[:-1]:
+            m *= s
+        if attn_mod._pallas_by_default() and ln_supported(m, d):
+            y = layer_norm(x.reshape(m, d).astype(_dtype(cfg)), scale,
+                           bias, 1e-6, 256, attn_mod._PALLAS_INTERPRET)
+            return y.reshape(shape)
+        xf = x.astype(jnp.float32)
+        mean, rstd = _stats(xf, 1e-6)
+        y = ((xf - mean) * rstd
+             * scale.astype(jnp.float32) + bias.astype(jnp.float32))
+        return y.astype(_dtype(cfg))
+
+
+def _norm(cfg: ModelConfig, name: str):
+    """The block norm: fused Pallas LN when ``cfg.ln_fusion``, else flax's
+    ``nn.LayerNorm`` — identical {scale, bias} param tree either way."""
+    if cfg.ln_fusion:
+        return FusedLayerNorm(cfg, name=name)
+    return nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+                        name=name)
+
+
 class DenseKernel(nn.Module):
     """Parameter-compatible stand-in for ``nn.Dense``: owns the identical
     ``{name: {'kernel': (in, out), 'bias': (out,)}}`` param tree (same
@@ -189,12 +234,10 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, rot=None) -> jax.Array:
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-                         name="attn_norm")(x)
+        h = _norm(cfg, "attn_norm")(x)
         x = x + ZooAttention(cfg, self.attn_type, mesh=self.mesh,
                              name="attn")(h, rot)
-        h = nn.LayerNorm(dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-                         name="ff_norm")(x)
+        h = _norm(cfg, "ff_norm")(x)
         x = x + GEGLUFeedForward(cfg, fuse=self.fuse_ff, name="ff")(h)
         return x
 
@@ -217,13 +260,21 @@ class BlockCycle(nn.Module):
     # blocks with uid >= cycle - remat_skip_blocks use this class instead
     # (plain, no remat) — partial remat, cfg.remat_skip_blocks
     plain_cls: Any = None
+    # body size override: the weight-shared path cycles
+    # cfg.shared_block_cycle unique blocks; the dense_scan path (stacked
+    # per-iteration params) cycles one attn-type group instead
+    cycle_override: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, it: jax.Array) -> jax.Array:
         cfg = self.cfg
         rot = _make_rot(cfg)
-        cycle = cfg.shared_block_cycle
-        unroll = max(1, cfg.scan_unroll)
+        cycle = self.cycle_override or cfg.shared_block_cycle
+        # dense_scan (cycle_override set): each iteration's param slice is
+        # one group of layers, so in-iteration unrolling would REUSE that
+        # slice — and the unroll lever only exists to amortize the shared-
+        # weight grad accumulation dense models don't have. Force 1.
+        unroll = 1 if self.cycle_override else max(1, cfg.scan_unroll)
         exact = self.n_body % (cycle * unroll) == 0
         first_plain = cycle - cfg.remat_skip_blocks
         blocks = {}
@@ -302,15 +353,29 @@ class Transformer(nn.Module):
 
         cycle = cfg.shared_block_cycle
         body = len(sched) - (1 if cfg.final_conv_block else 0)
-        per_iter = cycle * max(1, cfg.scan_unroll) if cycle else 0
-        reps = -(-body // per_iter) if cycle else 0
-        if cycle and reps > 1:
-            scan = nn.scan(BlockCycle,
-                           variable_broadcast="params",
-                           split_rngs={"params": False})
+        # dense (cycle=0) with dense_scan: scan one attn-type group with
+        # STACKED per-iteration params — the compiled body stays one
+        # group while every iteration reads its own weights (a 64-block
+        # dense flagship otherwise unrolls to an XLA program ~16x the
+        # shared model's, past the compile service's budget)
+        dense_scan = cfg.dense_scan_reps() > 0
+        group = len(cfg.attn_types) if dense_scan else cycle
+        # dense_scan forces unroll 1 (see BlockCycle): per_iter = group
+        unroll = 1 if dense_scan else max(1, cfg.scan_unroll)
+        per_iter = group * unroll if group else 0
+        reps = (cfg.dense_scan_reps() if dense_scan
+                else -(-body // per_iter) if group else 0)
+        if group and reps > 1:
+            scan = nn.scan(
+                BlockCycle,
+                variable_broadcast=() if dense_scan else "params",
+                variable_axes={"params": 0} if dense_scan else {},
+                split_rngs={"params": dense_scan})
             x, _ = scan(cfg, block_cls, body, mesh=self.mesh,
                         plain_cls=(TransformerBlock if cfg.remat
-                                   and cfg.remat_skip_blocks else None),
+                                   and cfg.remat_skip_blocks
+                                   and not dense_scan else None),
+                        cycle_override=group if dense_scan else 0,
                         name="cycle")(x, jnp.arange(reps))
             rest = sched[body:]
         else:
@@ -334,6 +399,4 @@ class Transformer(nn.Module):
                                   name=name)
             x = blocks[uid](x, rot)
 
-        return nn.LayerNorm(dtype=_dtype(cfg),
-                            param_dtype=_param_dtype(cfg),
-                            name="final_norm")(x)
+        return _norm(cfg, "final_norm")(x)

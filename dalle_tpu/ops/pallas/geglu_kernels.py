@@ -103,12 +103,15 @@ def _ff_bwd_kernel(x_ref, wi_ref, wg_ref, wo_ref, bi_ref, bg_ref, do_ref,
     hg_ref[...] = (h * a).astype(hg_ref.dtype)
 
 
-def _pick_block(total: int, target: int) -> int:
-    """Largest divisor of ``total`` that is <= target (tiles must divide)."""
-    b = min(total, target)
-    while total % b:
-        b -= 1
-    return b
+def _pick_block(total: int, target: int, align: int = 8) -> int:
+    """Largest multiple of ``align`` <= target that divides ``total``.
+    TPU block shapes need 8-aligned second-minor and 128-aligned minor
+    dims; geglu_supported guarantees ``align | total`` (m % 8, k % 128),
+    so ``align`` itself is always a valid floor."""
+    b = min(total, target) // align * align
+    while b > align and total % b:
+        b -= align
+    return max(b, align)
 
 
 def geglu_supported(m: int, d: int, k: int, dtype) -> bool:
@@ -124,7 +127,7 @@ def _ff_fwd(x, wi, wg, wo, bi, bg, bo, block_m, block_k, interpret):
     m, d = x.shape
     k = wi.shape[1]
     bm = _pick_block(m, block_m)
-    bk = _pick_block(k, block_k)
+    bk = _pick_block(k, block_k, 128)  # bk is a MINOR dim in (d, bk) specs
     nk = k // bk
     grid = (m // bm, nk)
     return pl.pallas_call(
@@ -152,7 +155,7 @@ def _ff_bwd_tensors(x, wi, wg, wo, bi, bg, dout, block_m, block_k,
     m, d = x.shape
     k = wi.shape[1]
     bm = _pick_block(m, block_m)
-    bk = _pick_block(k, block_k)
+    bk = _pick_block(k, block_k, 128)  # bk is a MINOR dim in (d, bk) specs
     grid = (m // bm, k // bk)
     mk_spec = pl.BlockSpec((bm, bk), lambda i, j: (i, j))
     return pl.pallas_call(

@@ -1,0 +1,138 @@
+"""Single-pass Pallas LayerNorm with a fused backward (PERF.md headroom #2).
+
+LayerNorm is ~8% of the flagship train step (PERF.md r3 profile: "LayerNorm
+forward/backward reductions") — 128 applications per microbatch forward
+(2 per layer, reference dalle-pytorch PreNorm at every attn/ff,
+learning-at-home/dalle task.py:62-83) plus their backward and the remat
+replay. XLA's autodiff of the flax lowering emits separate reduction
+fusions for the mean/variance VJP and the ``dscale``/``dbias`` cross-row
+sums; here backward is ONE pass over ``x``/``dy`` per tile that produces
+``dx`` and per-tile ``dscale``/``dbias`` partials together, and forward is
+one read + one write with both statistics formed in-register.
+
+Numerics follow flax's ``nn.LayerNorm`` exactly (normalization.py of flax):
+statistics forced to f32, fast variance ``E[x^2] - E[x]^2`` clipped at 0,
+``eps`` inside the rsqrt, affine applied in f32 (param_dtype), output cast
+to the activation dtype. The backward recomputes mean/rstd from the tile
+it already loaded instead of saving them — LN residuals stay exactly
+{x, scale}, and under blanket remat nothing is saved at all.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# aligned-divisor search shared with the GEGLU kernel (align=8 default:
+# the TPU second-minor constraint; ln_supported guarantees 8 | m)
+from dalle_tpu.ops.pallas.geglu_kernels import _pick_block
+
+
+def _stats(x, eps):
+    """f32 row statistics, flax-identical (fast variance, clipped)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    msq = jnp.mean(x * x, axis=-1, keepdims=True)
+    var = jnp.maximum(msq - mean * mean, 0.0)
+    return mean, jax.lax.rsqrt(var + eps)
+
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, out_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)              # (bm, d)
+    mean, rstd = _stats(x, eps)
+    y = ((x - mean) * rstd * g_ref[...].astype(jnp.float32)
+         + b_ref[...].astype(jnp.float32))
+    out_ref[...] = y.astype(out_ref.dtype)
+
+
+def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, db_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mean, rstd = _stats(x, eps)
+    xhat = (x - mean) * rstd
+    dyg = dy * g_ref[...].astype(jnp.float32)
+    c1 = jnp.mean(dyg * xhat, axis=-1, keepdims=True)
+    c2 = jnp.mean(dyg, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (dyg - xhat * c1 - c2)).astype(dx_ref.dtype)
+    # cross-row partials, summed by the caller. TPU block shapes need the
+    # second-minor dim divisible by 8, so each grid step owns an (8, d)
+    # slab: the partial in row 0, zeros below.
+    pad = jnp.zeros((7,) + x.shape[-1:], jnp.float32)
+    dg_ref[...] = jnp.concatenate(
+        [jnp.sum(dy * xhat, axis=0, keepdims=True), pad], axis=0)
+    db_ref[...] = jnp.concatenate(
+        [jnp.sum(dy, axis=0, keepdims=True), pad], axis=0)
+
+
+
+
+def ln_supported(m: int, d: int) -> bool:
+    """Tiling-clean shapes where the kernel is a win; tiny test models and
+    single-token decode rows fall back to the plain lowering."""
+    return d % 128 == 0 and m % 8 == 0 and m >= 128
+
+
+def _fwd_call(x, scale, bias, eps, block_m, interpret):
+    m, d = x.shape
+    bm = _pick_block(m, block_m)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, d), x.dtype),
+        interpret=interpret,
+    )(x, scale.reshape(1, -1), bias.reshape(1, -1))
+
+
+def _bwd_call(x, scale, dy, eps, block_m, interpret):
+    m, d = x.shape
+    bm = _pick_block(m, block_m)
+    nm = m // bm
+    part_spec = pl.BlockSpec((8, d), lambda i: (i, 0))
+    return pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps),
+        grid=(nm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bm, d), lambda i: (i, 0)),
+                   part_spec, part_spec],
+        out_shape=[jax.ShapeDtypeStruct((m, d), x.dtype),
+                   jax.ShapeDtypeStruct((nm * 8, d), jnp.float32),
+                   jax.ShapeDtypeStruct((nm * 8, d), jnp.float32)],
+        interpret=interpret,
+    )(x, scale.reshape(1, -1), dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def layer_norm(x, scale, bias, eps: float = 1e-6, block_m: int = 256,
+               interpret: bool = False):
+    """flax-parity LayerNorm over the last axis of ``x`` (M, d).
+
+    ``scale``/``bias`` are the (d,) affine parameters in param dtype (f32);
+    output is in ``x.dtype``. Gradient residuals: {x, scale} only.
+    """
+    return _fwd_call(x, scale, bias, eps, block_m, interpret)
+
+
+def _vjp_fwd(x, scale, bias, eps, block_m, interpret):
+    return _fwd_call(x, scale, bias, eps, block_m, interpret), (x, scale)
+
+
+def _vjp_bwd(eps, block_m, interpret, res, dy):
+    x, scale = res
+    dx, dg_part, db_part = _bwd_call(x, scale, dy, eps, block_m, interpret)
+    return (dx, jnp.sum(dg_part, axis=0).astype(scale.dtype),
+            jnp.sum(db_part, axis=0).astype(scale.dtype))
+
+
+layer_norm.defvjp(_vjp_fwd, _vjp_bwd)

@@ -53,20 +53,47 @@ def default_wd_mask(params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def default_stacked_mask(params) -> Any:
+    """True for dense_scan's STACKED per-iteration leaves (transformer.py:
+    scan with ``variable_axes={"params": 0}``): leaves under the scanned
+    ``cycle`` whose rank exceeds their kind's canonical rank (kernel 2;
+    bias/scale 1) carry a leading scan-reps axis of independent layers.
+    LAMB's per-tensor trust ratio must then be computed PER SLICE so the
+    stacked model optimizes identically to its unrolled equivalent —
+    one shared ratio across 16 independent layers would silently change
+    convergence dynamics vs the model dense_scan merely re-stages."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out = []
+    for path, leaf in flat:
+        keys = [getattr(p, "key", str(p)).lower() for p in path]
+        canonical = 2 if keys and keys[-1] == "kernel" else 1
+        out.append("cycle" in keys and leaf.ndim > canonical)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def lamb_leaf_update(p: jax.Array, m: jax.Array, v: jax.Array,
                      decay, lr, *, eps: float, weight_decay: float,
-                     clamp_value: float) -> jax.Array:
+                     clamp_value: float, stacked: bool = False) -> jax.Array:
     """The shared per-tensor LAMB update (used by both the fp32 and 8-bit
     optimizers so their trajectories agree up to moment quantization):
     adam_step = m/(sqrt(v)+eps) + wd*p; trust = clamp(||p||, clamp_value) /
     ||adam_step|| (1.0 where either norm is 0); update = -lr*trust*adam_step.
-    Matches reference lamb_8bit.py:135-158 (debias=False)."""
+    Matches reference lamb_8bit.py:135-158 (debias=False).
+
+    ``stacked`` (dense_scan leaves, see default_stacked_mask): the leading
+    axis holds independent layers' weights — norms and trust ratios are
+    computed per slice so the update equals the unrolled model's."""
     p32 = p.astype(jnp.float32)
     adam_step = m / (jnp.sqrt(v) + eps)
     if weight_decay:
         adam_step = adam_step + jnp.where(decay, weight_decay, 0.0) * p32
-    wnorm = jnp.minimum(jnp.sqrt(jnp.sum(p32 * p32)), clamp_value)
-    anorm = jnp.sqrt(jnp.sum(adam_step * adam_step))
+    axes = tuple(range(1, p32.ndim)) if stacked else None
+    wnorm = jnp.minimum(
+        jnp.sqrt(jnp.sum(p32 * p32, axis=axes, keepdims=stacked)),
+        clamp_value)
+    anorm = jnp.sqrt(jnp.sum(adam_step * adam_step, axis=axes,
+                             keepdims=stacked))
     trust = jnp.where((wnorm > 0) & (anorm > 0),
                       wnorm / (anorm + 1e-12), 1.0)
     return (-lr * trust * adam_step).astype(p.dtype)
@@ -107,13 +134,15 @@ def lamb(learning_rate: ScalarOrSchedule,
         lr = learning_rate(state.count) if callable(learning_rate) \
             else learning_rate
         wd_mask = wd_mask_fn(params)
+        stacked_mask = default_stacked_mask(params)
 
-        def leaf_update(p, m, v, decay):
+        def leaf_update(p, m, v, decay, stacked):
             return lamb_leaf_update(
                 p, m, v, decay, lr, eps=eps, weight_decay=weight_decay,
-                clamp_value=clamp_value)
+                clamp_value=clamp_value, stacked=stacked)
 
-        new_updates = jax.tree.map(leaf_update, params, mu, nu, wd_mask)
+        new_updates = jax.tree.map(leaf_update, params, mu, nu, wd_mask,
+                                   stacked_mask)
         return new_updates, LambState(state.count + 1, mu, nu)
 
     return optax.GradientTransformation(init_fn, update_fn)

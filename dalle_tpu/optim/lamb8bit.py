@@ -34,6 +34,7 @@ from dalle_tpu.ops.quant import (
 )
 from dalle_tpu.optim.lamb import (
     ScalarOrSchedule,
+    default_stacked_mask,
     default_wd_mask,
     global_norm,
     lamb_leaf_update,
@@ -91,6 +92,7 @@ def lamb8bit(learning_rate: ScalarOrSchedule,
         m_leaves = treedef.flatten_up_to(state.mu)
         v_leaves = treedef.flatten_up_to(state.nu)
         d_leaves = treedef.flatten_up_to(wd_mask_fn(params))
+        s_leaves = treedef.flatten_up_to(default_stacked_mask(params))
 
         g_leaves = [g.astype(jnp.float32) for g in g_leaves]
         if max_grad_norm is not None:
@@ -102,13 +104,13 @@ def lamb8bit(learning_rate: ScalarOrSchedule,
             else learning_rate
 
         new_updates, new_mu, new_nu = [], [], []
-        for p, g, m_s, v_s, decay in zip(
-                p_leaves, g_leaves, m_leaves, v_leaves, d_leaves):
+        for p, g, m_s, v_s, decay, stacked in zip(
+                p_leaves, g_leaves, m_leaves, v_leaves, d_leaves, s_leaves):
             m = b1 * _dequantize_moment(m_s) + (1 - b1) * g
             v = b2 * _dequantize_moment(v_s) + (1 - b2) * g * g
             new_updates.append(lamb_leaf_update(
                 p, m, v, decay, lr, eps=eps, weight_decay=weight_decay,
-                clamp_value=clamp_value))
+                clamp_value=clamp_value, stacked=stacked))
             new_mu.append(_quantize_moment(m, True) if _is_q(m_s) else m)
             new_nu.append(_quantize_moment(v, False) if _is_q(v_s) else v)
 

@@ -64,6 +64,12 @@ def param_specs(params) -> Any:
     specs = []
     for path, leaf in flat:
         spec = spec_for_path(_path_str(path))
+        # dense_scan stacks per-iteration params: the leaf carries ONE
+        # extra leading scan-reps axis over the rank its rule was written
+        # for — shift the spec right so fsdp/tp land on the same matmul
+        # dims as the unrolled layout (reps stay unsharded).
+        if spec and leaf.ndim == len(spec) + 1:
+            spec = P(None, *spec)
         # Trim the spec to the leaf's rank; divisibility against a concrete
         # mesh is handled in param_shardings.
         kept = [ax if i < leaf.ndim else None

@@ -52,10 +52,17 @@ from dalle_tpu.models.decode import (SamplingConfig,  # noqa: E402
 
 
 def main():
-    b = int(sys.argv[1]) if len(sys.argv) > 1 else 4
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-    buckets = int(sys.argv[3]) if len(sys.argv) > 3 else None
-    cfg = flagship_model_config(param_dtype="bfloat16")
+    # "xl" as the first arg benches the ~3B preset (BASELINE config 5)
+    args = [a for a in sys.argv[1:] if a != "xl"]
+    xl = len(args) != len(sys.argv) - 1
+    b = int(args[0]) if len(args) > 0 else 4
+    iters = int(args[1]) if len(args) > 1 else 4
+    buckets = int(args[2]) if len(args) > 2 else None
+    if xl:
+        from dalle_tpu.config import xl_model_config
+        cfg = xl_model_config(param_dtype="bfloat16")
+    else:
+        cfg = flagship_model_config(param_dtype="bfloat16")
     model = DALLE(cfg)
     params = init_params(model, jax.random.PRNGKey(0))
     text = jnp.ones((b, cfg.text_seq_len), jnp.int32)
@@ -76,19 +83,24 @@ def main():
         codes = jax.device_get(gen(params, text,
                                    jax.random.PRNGKey(2 + i)))
     dt = time.time() - t0
-    ok = bool((codes >= 0).all() and (codes < 8192).all())
+    ok = bool((codes >= 0).all() and (codes < cfg.vocab_image).all())
     img_per_min = b * iters / dt * 60
     print(f"B={b}: {dt / iters:.1f}s/query -> {img_per_min:.1f} "
           f"img/min (codes valid: {ok})")
 
     out_path = os.path.join(os.path.dirname(__file__), "..",
                             "DECODE_BENCH.json")
+    # record the RESOLVED bucket count for adaptive (None) runs so every
+    # row stays joinable to the bucket-sweep table even if the adaptive
+    # thresholds in generate_images change later
+    from dalle_tpu.models.decode import resolve_buckets
     with open(out_path, "a") as f:
         f.write(json.dumps({
-            "metric": "dalle-1.3b decode images/min",
+            "metric": ("dalle-xl decode images/min" if xl
+                       else "dalle-1.3b decode images/min"),
             "batch": b,
             "iters": iters,
-            "buckets": buckets,
+            "buckets": resolve_buckets(buckets, b),
             "compile_plus_first_s": round(t_compile, 1),
             "sec_per_query": round(dt / iters, 2),
             "value": round(img_per_min, 1),
