@@ -375,10 +375,13 @@ def xl_model_config(**overrides: Any) -> ModelConfig:
     (v5p-64 in the north star) — one v5e chip cannot hold its state; train
     it with fsdp/tp over a mesh (``parallel/sharding.py``).
     """
+    # ln_fusion measured SLOWER on this shape (3.84 vs 4.12 img/s at
+    # micro 2 — XL_STEP.json; identical losses): under blanket remat at
+    # depth 64 the kernel's replay beats XLA's LN-into-neighbor fusion
+    # on the flagship but not at dim 1792. Keep the XLA lowering here.
     base = dict(dim=1792, heads=28, head_dim=64,
                 vocab_image=16384, image_grid=32,
-                remat_skip_blocks=0, head_chunk=2048, scan_unroll=2,
-                ln_fusion=True)
+                remat_skip_blocks=0, head_chunk=2048, scan_unroll=2)
     base.update(overrides)
     return dataclasses.replace(ModelConfig(), **base)
 

@@ -128,7 +128,8 @@ def run(out_path="XL_STEP.json", cpu_axis="fsdp"):
         "mesh": mesh_desc,
         "config": {"dim": cfg.dim, "depth": cfg.depth, "heads": cfg.heads,
                    "seq": cfg.total_seq_len, "vocab_image": cfg.vocab_image,
-                   "micro": micro, "accum": accum},
+                   "micro": micro, "accum": accum,
+                   "ln_fusion": cfg.ln_fusion},
         "unique_params_m": round(n_params / 1e6, 1),
         "init_s": round(t_init, 1),
         "compile_plus_first_step_s": round(t_compile_and_first, 1),
