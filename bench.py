@@ -130,9 +130,9 @@ def main() -> None:
                     raise
                 # full first line of the error so a genuine compile bug
                 # misclassified as OOM is still visible in driver logs
+                msg = (str(e).splitlines() or [repr(e)])[0]
                 print(f"# micro {micro} {overrides} walked down: "
-                      f"{type(e).__name__}: "
-                      f"{str(e).splitlines()[0][:300]}", file=sys.stderr)
+                      f"{type(e).__name__}: {msg[:300]}", file=sys.stderr)
     if result is None:
         # Tiny-model numbers are not comparable to the 1.3B baseline:
         # report them honestly with vs_baseline 0.
