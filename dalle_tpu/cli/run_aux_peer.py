@@ -110,12 +110,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if aux.checkpoint_dir:
         from dalle_tpu.training.checkpoint import CheckpointManager
         ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
-    if aux.assist_in_averaging:
-        # the reference declares-but-stubs this mode
-        # (run_aux_peer.py:99-104 raises NotImplementedError); explicit
-        # out-of-scope parity rather than silent absence
-        logger.warning("assist_in_averaging is a declared-but-stubbed "
-                       "reference mode; ignoring")
+    # averaging assist: the reference declares-but-stubs this mode (its
+    # run_aux_peer.py:99-104 raises NotImplementedError); here it is
+    # implemented — weight-0 part ownership in every gradient round
+    # (swarm/assist.py). Started inside the task context below.
+    assist = aux.assist_in_averaging
+    if assist and collab.grad_compression == "power_sgd":
+        logger.warning(
+            "assist_in_averaging is OFF: power_sgd rounds exchange "
+            "low-rank factors whose flat size an aux peer without a "
+            "model cannot reproduce")
+        assist = False
     from dalle_tpu.training.remote_sink import RemoteSink, UploadWorker
     remote_sink = RemoteSink.create(args.archive_remote)
     if remote_sink is not None and ckpt_mgr is None:
@@ -148,41 +153,56 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     last_archived = -1
     rounds = 0
+    assistant = None
     try:
       with task:
         banner(task)
-        while args.max_rounds is None or rounds < args.max_rounds:
-            rounds += 1
-            time.sleep(aux.refresh_period)
-            stats = aggregate(fetch_metrics(
-                task.dht, peer.experiment_prefix))
-            logger.info(
-                "round %d: epoch=%s alive=%d sum_sps=%.1f mean_loss=%s",
-                rounds, stats["epoch"], stats["alive_peers"],
-                stats["sum_sps"], stats["mean_loss"])
-            if args.metrics_file:
-                with open(args.metrics_file, "a") as f:
-                    f.write(json.dumps({"round": rounds, **stats}) + "\n")
-            if wandb_run is not None:
-                wandb_run.log({k: v for k, v in stats.items()
-                               if v is not None})
+        if assist:
+            from dalle_tpu.swarm.assist import AveragingAssistant
+            assistant = AveragingAssistant(task.dht, collab, model,
+                                           authorizer=task.authorizer)
+            assistant.start()
+        try:
+            while args.max_rounds is None or rounds < args.max_rounds:
+                rounds += 1
+                time.sleep(aux.refresh_period)
+                stats = aggregate(fetch_metrics(
+                    task.dht, peer.experiment_prefix))
+                logger.info(
+                    "round %d: epoch=%s alive=%d sum_sps=%.1f mean_loss=%s",
+                    rounds, stats["epoch"], stats["alive_peers"],
+                    stats["sum_sps"], stats["mean_loss"])
+                if args.metrics_file:
+                    with open(args.metrics_file, "a") as f:
+                        f.write(json.dumps({"round": rounds, **stats}) + "\n")
+                if wandb_run is not None:
+                    wandb_run.log({k: v for k, v in stats.items()
+                                   if v is not None})
 
-            if (ckpt_mgr is not None and aux.store_checkpoints
-                    and stats["epoch"] >= 0
-                    and stats["epoch"] >= last_archived
-                    + args.save_every_epochs):
-                result = load_state_from_peers(
-                    task.dht, collab.run_id, timeout=collab.averaging_timeout)
-                if result is not None:
-                    epoch, arrays = result
-                    state = apply_state_arrays(task.train_state, arrays)
-                    saved_path = ckpt_mgr.save(state, epoch, backup=True)
-                    last_archived = epoch
-                    logger.info("archived swarm state at epoch %d", epoch)
-                    if uploader is not None:
-                        uploader.submit(saved_path)
-                else:
-                    logger.warning("state archive pull failed this round")
+                if (ckpt_mgr is not None and aux.store_checkpoints
+                        and stats["epoch"] >= 0
+                        and stats["epoch"] >= last_archived
+                        + args.save_every_epochs):
+                    result = load_state_from_peers(
+                        task.dht, collab.run_id, timeout=collab.averaging_timeout)
+                    if result is not None:
+                        epoch, arrays = result
+                        state = apply_state_arrays(task.train_state, arrays)
+                        saved_path = ckpt_mgr.save(state, epoch, backup=True)
+                        last_archived = epoch
+                        logger.info("archived swarm state at epoch %d", epoch)
+                        if uploader is not None:
+                            uploader.submit(saved_path)
+                    else:
+                        logger.warning("state archive pull failed this round")
+        finally:
+            if assistant is not None:
+                assistant.stop()
+                # join BEFORE the task context tears the DHT
+                # down: the thread holds native daemon handles
+                # and an in-flight round may run this long
+                assistant.join(timeout=collab.matchmaking_time
+                               + collab.allreduce_timeout + 5)
     finally:
         # drain the freshest upload and flush wandb even when the loop
         # exits via KeyboardInterrupt / a DHT exception — the final

@@ -329,10 +329,13 @@ class AuxConfig:
     checkpoint_dir: Optional[str] = None
     upload_interval: Optional[float] = None
     store_checkpoints: bool = True
-    # Parity-with-a-stub: the reference DECLARES an aux averaging-assist
-    # mode but its implementation raises NotImplementedError
-    # (run_aux_peer.py:99-104) — deliberately out of scope here too; the
-    # flag exists so configs round-trip, and the aux CLI warns if set.
+    # Beyond-the-stub: the reference DECLARES this mode but its
+    # implementation raises NotImplementedError (run_aux_peer.py:99-104).
+    # Here it is real (swarm/assist.py): the aux peer joins every
+    # gradient round as a weight-0 part owner — pure reduce/gather
+    # bandwidth for the trainers, contributing no data. Unsupported (and
+    # refused loudly) with grad_compression="power_sgd", whose wire
+    # shapes an aux peer without a model cannot reproduce.
     assist_in_averaging: bool = False
 
 

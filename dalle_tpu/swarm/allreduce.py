@@ -31,6 +31,16 @@ a peer with a divergent group view are dropped (it effectively leaves the
 group). Client-mode members (no listener) contribute weight=their samples
 but own no part and receive nothing; their data still reaches part owners
 because *they* send in the scatter phase.
+
+WEIGHT-0 members are averaging ASSISTANTS (the reference's
+``assist_in_averaging`` aux mode, declared-but-stubbed at its
+run_aux_peer.py:99-104, here implemented): they own a part — absorbing
+reduce/gather bandwidth from the trainers — but contribute no data, so
+they skip the scatter phase entirely, receivers never wait on their
+(nonexistent) contribution, and they skip collecting the gathered result
+they have no model to apply. A trainer that legitimately accumulated 0
+samples gets the same treatment: nothing to contribute, nothing waited
+on.
 """
 
 from __future__ import annotations
@@ -221,11 +231,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
     # --- scatter: my data for part k -> owner k, chunk by chunk ---------
+    # weight-0 members (averaging assistants / 0-sample trainers) have
+    # nothing to contribute: they send no scatter chunks
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(8, len(owners))) as pool:
         futures = []
         sends: List[Tuple[str, int, bytes]] = []  # for the one retry pass
-        for k, owner in enumerate(owners):
+        scatter_to = list(enumerate(owners)) if weight > 0 else []
+        for k, owner in scatter_to:
             if k == my_part:
                 continue
             lo, hi = slices[k]
@@ -259,8 +272,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             my_chunks = _chunk_slices(n_mine, chunk_elems)
             acc = mine * weight
             total_w = weight
+            # weight-0 members contribute nothing (and send nothing):
+            # never wait on them
             expected = {i for i, m in enumerate(group.members)
-                        if m.peer_id != me.peer_id}
+                        if m.peer_id != me.peer_id and m.weight > 0}
+            n_expected0 = len(expected)
             # a sender's contribution applies ATOMICALLY once all its
             # chunks arrived (partial senders are dropped wholesale, the
             # same elasticity semantics as the unchunked protocol)
@@ -301,7 +317,24 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 last_progress = time.monotonic()
             if expected and report is not None:
                 report["complete"] = False
-            averaged_mine = acc / total_w
+            if report is not None:
+                # contributors whose full data reached this part (self
+                # included when weight > 0) — an assistant uses this to
+                # detect rounds where nothing ever parsed (e.g. a model
+                # mismatch producing un-parseable chunk geometry)
+                report["reduced_senders"] = (n_expected0 - len(expected)
+                                             + (1 if weight > 0 else 0))
+            if total_w > 0:
+                averaged_mine = acc / total_w
+            else:
+                # an assistant that received NO contributions must not
+                # gather its zero template — broadcasting it would
+                # silently zero this part on every trainer while the
+                # round looks complete. Withhold the part: receivers
+                # fall back to their local values and flag the round
+                # incomplete, the same dead-owner elasticity path.
+                # (A weight>0 member always has total_w >= weight > 0.)
+                averaged_mine = None
             phases["reduce_s"] = round(time.monotonic() - t_built, 3)
 
         t_wait = time.monotonic()
@@ -320,19 +353,30 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
-    out = flat.copy()
+    # an assistant's return value is meaningless (it collects nothing and
+    # its caller discards it) — skip the full-size copy; gather-send's
+    # local writes land in ``flat``, which is already this call's own
+    # buffer (flatten_tensors concatenates into a fresh array)
+    out = flat.copy() if weight > 0 else flat
 
     t_gather = time.monotonic()
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(8, group.size)) as pool:
         futures = []
         sends = []
-        if my_part is not None:
+        # averaged_mine is None only for an assistant that received no
+        # contributions: withhold the part (see the reduce phase)
+        if my_part is not None and averaged_mine is not None:
             lo, hi = slices[my_part]
             my_chunks = _chunk_slices(averaged_mine.size, chunk_elems)
-            have_clients = any(not m.addr for m in group.members)
+            have_clients = any(not m.addr and m.weight > 0
+                               for m in group.members)
+            # weight-0 assistants never drain their gather tag (they skip
+            # collection) — pushing to them would pile full-size parts
+            # into their native recv queue every round, unbounded
             push_to = [m for m in group.members
-                       if m.peer_id != me.peer_id and m.addr]
+                       if m.peer_id != me.peer_id and m.addr
+                       and m.weight > 0]
             for ci, (clo, chi) in enumerate(my_chunks):
                 piece = averaged_mine[clo:chi]
                 c = part_codec(piece.size)
@@ -364,7 +408,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                              expiration_time=time.time()
                              + 2 * allreduce_timeout)
 
-        if me.addr:  # client-mode peers receive no gather traffic
+        # weight-0 assistants collect no result at all (nothing to apply
+        # it to — and a routable assistant must NOT fall into the
+        # client-mode mailbox poll below, which would burn the round's
+        # remaining budget fetching chunks that are pushed, not posted)
+        if weight == 0:
+            pass
+        elif me.addr:
             part_chunks = {
                 k: _chunk_slices(hi_ - lo_, chunk_elems)
                 for k, (lo_, hi_) in enumerate(slices)}
@@ -463,6 +513,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             concurrent.futures.wait(retry_futs)
 
     phases["gather_s"] = round(time.monotonic() - t_gather, 3)
+    if weight == 0:
+        # assistants discard the result: skip the unflatten copies
+        return [np.array(t, np.float32, copy=False) for t in tensors]
     t_out = time.monotonic()
     result = unflatten_tensors(out, tensors)
     phases["unflatten_s"] = round(time.monotonic() - t_out, 3)

@@ -1,0 +1,192 @@
+"""Averaging-assist aux mode: bandwidth-donor participation in the
+gradient all-reduce.
+
+The reference DECLARES this mode and stubs it with ``NotImplementedError``
+(learning-at-home/dalle run_aux_peer.py:99-104, ``--assist_in_averaging``);
+here it is implemented: an aux peer joins each epoch's matchmaking with
+``weight=0`` and a zero gradient vector of the run's flat size. Weight-0
+members own an all-reduce part like any routable member — absorbing a
+1/(owners) share of every trainer's reduce/gather traffic — but
+contribute no data (they skip the scatter phase, receivers never wait on
+them, and they skip collecting the averaged result; swarm/allreduce.py).
+The assist is PURE capacity: with N trainers + A assistants each trainer
+uploads N-1 parts of ``size/(N+A)`` instead of ``size/N``, and
+client-mode-heavy swarms gain routable part owners.
+
+An assistant that dies mid-round degrades exactly like any dead part
+owner (the elasticity path: its part falls back to each trainer's local
+values and the round reports incomplete) — assisting never makes a round
+less reliable than running it without the assistant, except that the
+round's part layout included it.
+
+Not supported with ``grad_compression="power_sgd"``: those rounds
+exchange per-matrix low-rank factors whose flat size depends on the
+compressor's device state, which an aux peer without a model cannot
+reproduce. The CLI refuses the combination loudly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dalle_tpu.config import CollabConfig, ModelConfig
+from dalle_tpu.swarm.allreduce import run_allreduce
+from dalle_tpu.swarm.dht import DHT
+from dalle_tpu.swarm.matchmaking import make_group
+from dalle_tpu.swarm.progress import ProgressTracker
+
+logger = logging.getLogger(__name__)
+
+
+def grad_flat_elements(model_cfg: ModelConfig) -> int:
+    """Flat element count of the run's gradient vector (the unique
+    parameter tree the trainers exchange) — computed via ``eval_shape``,
+    no parameters allocated."""
+    import jax
+
+    from dalle_tpu.models.dalle import DALLE, init_params
+
+    shapes = jax.eval_shape(
+        lambda: init_params(DALLE(model_cfg), jax.random.PRNGKey(0)))
+    return int(sum(np.prod(leaf.shape)
+                   for leaf in jax.tree_util.tree_leaves(shapes)))
+
+
+def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
+                     template: np.ndarray, authorizer=None,
+                     codec: Optional[int] = None) -> str:
+    """Join epoch ``epoch``'s gradient matchmaking as a weight-0 member
+    and, if a real group forms, serve as a part owner for its all-reduce.
+
+    Returns ``"assisted"`` (at least one contributor's data reached this
+    peer's part), ``"empty"`` (a group formed but NOTHING parseable
+    arrived — with a healthy network that means this assistant's flat
+    size disagrees with the trainers', i.e. a model-config mismatch), or
+    ``"idle"`` (no group with contributors formed).
+
+    ``codec`` must match the trainers' wire codec choice (None = the
+    size-adaptive default the optimizer uses) — each owner compresses the
+    part it gathers, so an assistant with a different codec would gather
+    its part at different fidelity than trainer-owned parts."""
+    group = make_group(
+        dht, f"{cfg.run_id}_grads", epoch, weight=0.0,
+        matchmaking_time=cfg.matchmaking_time, min_group_size=2,
+        authorizer=authorizer, encrypt=cfg.encrypt_data_plane)
+    if group is None or group.size <= 1:
+        return "idle"
+    if not any(m.weight > 0 for m in group.members):
+        return "idle"  # a lobby of assistants has nothing to average
+    report: dict = {}
+    run_allreduce(dht, group, f"{cfg.run_id}_grads", epoch, [template],
+                  weight=0.0, allreduce_timeout=cfg.allreduce_timeout,
+                  codec=codec,
+                  adaptive_threshold=cfg.size_adaptive_threshold,
+                  report=report)
+    return "assisted" if report.get("reduced_senders", 0) > 0 else "empty"
+
+
+class AveragingAssistant(threading.Thread):
+    """Background loop: follow the run's progress tracker and join every
+    epoch's gradient round as a weight-0 part owner.
+
+    The loop re-announces continuously (each ``make_group`` call both
+    announces and waits out the stability window), so whenever the
+    trainers hit ``target_batch_size`` and matchmake, the assistant's
+    fresh announce is in their candidate set. A missed window degrades to
+    a round without the assistant (or, rarely, to the dead-owner
+    elasticity path if trainers confirmed a roster the assistant had
+    already abandoned)."""
+
+    def __init__(self, dht: DHT, cfg: CollabConfig,
+                 model_cfg: ModelConfig, authorizer=None):
+        super().__init__(daemon=True, name="averaging-assistant")
+        if cfg.grad_compression == "power_sgd":
+            # refuse HERE, not only in the aux CLI: power_sgd rounds
+            # exchange low-rank factors whose flat size depends on the
+            # compressor's device state, which an aux peer without a
+            # model cannot reproduce — and _CODECS has no power_sgd
+            # entry, so run() would die with an unlogged KeyError
+            raise ValueError(
+                "assist_in_averaging is unsupported with "
+                "grad_compression='power_sgd'")
+        self.dht = dht
+        self.cfg = cfg
+        self.authorizer = authorizer
+        self._n_elements = grad_flat_elements(model_cfg)
+        self._stop_event = threading.Event()
+        self.rounds_assisted = 0
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    def run(self) -> None:  # pragma: no cover - exercised via tests' join
+        # the trainers' wire codec: each owner compresses the part it
+        # gathers, so the assistant's part must ride the SAME codec or
+        # 1/N of every gradient step silently changes fidelity
+        from dalle_tpu.swarm.optimizer import _CODECS
+        codec = _CODECS[self.cfg.grad_compression]
+        template = np.zeros(self._n_elements, np.float32)
+        tracker = ProgressTracker(self.dht, self.cfg.run_id,
+                                  self.cfg.target_batch_size)
+        logger.info("averaging assistant up: %d grad elements (%.1f MB "
+                    "f32 parts pool)", self._n_elements,
+                    self._n_elements * 4 / 1e6)
+        last_epoch = -1
+        empty_streak = 0
+        while not self._stop_event.is_set():
+            try:
+                progress = tracker.global_progress(force_refresh=True)
+                if progress.reporting_peers == 0:
+                    # nobody training (num_peers floors at 1 — the
+                    # trainer-facing "alone" view — so test the raw
+                    # record count): don't camp in the matchmaking key.
+                    # Poll briskly — a trainer's first epoch can go from
+                    # first progress report to matchmaking in a second.
+                    self._stop_event.wait(0.5)
+                    continue
+                if progress.epoch <= last_epoch:
+                    # already assisted this epoch: trainers run one round
+                    # per epoch, so rejoining would only matchmake with
+                    # the round's STALE announces (they outlive the round
+                    # by design) and burn an elasticity timeout
+                    self._stop_event.wait(0.5)
+                    continue
+                outcome = assist_one_round(self.dht, self.cfg,
+                                           progress.epoch, template,
+                                           self.authorizer, codec=codec)
+                if outcome == "assisted":
+                    self.rounds_assisted += 1
+                    last_epoch = progress.epoch
+                    empty_streak = 0
+                    logger.info("assisted epoch %d (total %d rounds)",
+                                progress.epoch, self.rounds_assisted)
+                elif outcome == "empty":
+                    empty_streak += 1
+                    if empty_streak >= 3:
+                        # groups form but NOTHING this assistant can
+                        # parse ever arrives: almost certainly this aux
+                        # peer's model preset/flags disagree with the
+                        # trainers' (different flat grad size -> every
+                        # chunk fails geometry checks). Keep monitoring
+                        # duties but back off the assist loop hard —
+                        # occupying a part slot while unparseable is
+                        # WORSE than not assisting.
+                        logger.error(
+                            "%d consecutive assisted rounds received no "
+                            "parseable contribution — likely a model "
+                            "config mismatch with the trainers (this "
+                            "peer expects %d grad elements), or this "
+                            "assistant keeps matchmaking against stale "
+                            "announces of already-finished rounds. "
+                            "Backing off 60s",
+                            empty_streak, self._n_elements)
+                        self._stop_event.wait(60.0)
+            except Exception:  # noqa: BLE001 - a failed round must not
+                # take the aux peer's monitoring duties down with it
+                logger.warning("assist round failed", exc_info=True)
+                self._stop_event.wait(1.0)

@@ -9,8 +9,11 @@ Protocol (epoch-scoped DHT key + leader confirmation):
 1. Every candidate stores ``{addr, weight}`` under
    ``{prefix}_matchmaking.e{epoch}`` (subkey = its peer id) and polls the
    key until ``matchmaking_time`` elapses (early exit once the candidate
-   set has been stable for two polls and has >= 2 members).
-2. The candidate set is ordered by peer id; the lowest id is the *leader*.
+   set has been stable for two polls and has >= 2 CONTRIBUTORS — weight-0
+   averaging assistants never rush a group).
+2. The candidate set is ordered by peer id; the lowest-id CONTRIBUTOR
+   (weight > 0) is the *leader* — racing views that differ only in
+   which weight-0 assistants they saw still elect the same leader.
    The leader sends the final member list to every follower over the data
    plane (and parks a copy in its mailbox for client-mode followers, who
    have no listener to push to); followers prefer the leader's list over
@@ -152,6 +155,18 @@ def verify_confirmation(raw: bytes, prefix: str, epoch: int,
     return [m for m in members if member_authorized(m, authorizer)], keys
 
 
+def choose_leader(members: List[GroupMember]) -> GroupMember:
+    """The lowest-id CONTRIBUTOR (weight > 0), not merely the lowest id:
+    candidate views race during the stability window, and a weight-0
+    averaging assistant visible to only SOME candidates must not change
+    who they each wait on — two leaders means two confirmed rosters and
+    a splintered round (observed in the r4 assist CLI drive). Views that
+    agree on the lowest-id trainer agree on the leader regardless of
+    assistants. An all-assistant lobby falls back to the lowest id
+    (members must be sorted by peer id)."""
+    return next((m for m in members if m.weight > 0), members[0])
+
+
 def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
                matchmaking_time: float = 15.0,
                min_group_size: int = 1,
@@ -200,7 +215,12 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
         else:
             stable_polls = 0
         seen = current
-        if (len(seen) >= max(2, min_group_size) and stable_polls >= 2):
+        # only CONTRIBUTORS (weight > 0) count toward the early-exit
+        # quorum: a weight-0 averaging assistant camping in the
+        # matchmaking key must not make the first trainer to arrive
+        # rush a 2-member group before its real peers announce
+        contributors = sum(1 for m in seen if m.weight > 0)
+        if (contributors >= max(2, min_group_size) and stable_polls >= 2):
             break
         time.sleep(min(0.25, max(0.0, deadline - now)))
 
@@ -214,7 +234,7 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
             key=lambda m: m.peer_id)
 
     # leader confirmation round
-    leader = members[0]
+    leader = choose_leader(members)
     confirm_wait = min(5.0, matchmaking_time)
     group_key: Optional[bytes] = None
     if leader.peer_id == my_id:

@@ -74,6 +74,12 @@ class GlobalProgress:
     num_clients: int
     eta_next_epoch: float       # absolute dht-time estimate
     samples_per_second: float   # swarm-wide sum
+    # live peers with a published progress record. Differs from
+    # num_peers when nobody reports: num_peers floors at 1 (the "alone
+    # in the swarm" local view a trainer needs), reporting_peers is 0 —
+    # the signal a non-training observer (the averaging assistant) needs
+    # to know the swarm is idle.
+    reporting_peers: int = 0
 
     @property
     def ready_to_update(self) -> bool:
@@ -181,7 +187,8 @@ class ProgressTracker:
                 target_batch_size=self.target_batch_size,
                 num_peers=1, num_clients=int(self.client_mode),
                 eta_next_epoch=get_dht_time() + remaining / sps,
-                samples_per_second=self.performance_ema.samples_per_second)
+                samples_per_second=self.performance_ema.samples_per_second,
+                reporting_peers=0)
             self._cached_global = result
             return result
 
@@ -196,6 +203,7 @@ class ProgressTracker:
             epoch=epoch, samples_accumulated=samples,
             target_batch_size=self.target_batch_size,
             num_peers=len(peers),
+            reporting_peers=len(peers),
             num_clients=sum(1 for p in peers if p.client_mode),
             eta_next_epoch=eta, samples_per_second=sps)
         self._cached_global = result
