@@ -9,9 +9,14 @@ members own an all-reduce part like any routable member — absorbing a
 1/(owners) share of every trainer's reduce/gather traffic — but
 contribute no data (they skip the scatter phase, receivers never wait on
 them, and they skip collecting the averaged result; swarm/allreduce.py).
-The assist is PURE capacity: with N trainers + A assistants each trainer
-uploads N-1 parts of ``size/(N+A)`` instead of ``size/N``, and
-client-mode-heavy swarms gain routable part owners.
+The assist is PURE capacity, and what it buys is part-SERVING load, not
+raw per-trainer byte totals (those redistribute: scatter upload rises
+with the extra owner while gather upload falls): each assistant absorbs
+a ``1/(owners)`` share of the reduce fan-in and gather fan-out that the
+routable trainers would otherwise serve — decisive when volunteer
+up-links are the bottleneck (gather parts now come from the aux's fat
+pipe) and in client-mode-heavy swarms, where the few routable trainers
+are the only part owners until assistants join.
 
 An assistant that dies mid-round degrades exactly like any dead part
 owner (the elasticity path: its part falls back to each trainer's local
