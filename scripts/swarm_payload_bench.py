@@ -121,27 +121,41 @@ def run_threads(fns):
 
 
 def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
-                 budget: float = 60.0):
+                 budget: float = 60.0, n_assist: int = 0):
+    """``n_assist`` weight-0 averaging assistants (swarm/assist.py) join
+    the trainers' round as extra part owners at the full flagship
+    payload — the M44 mode at realistic scale."""
+    n_all = n_peers + n_assist
     nodes = []
-    for _ in range(n_peers):
+    for _ in range(n_all):
         peers = [nodes[0].visible_address] if nodes else []
         nodes.append(DHT(initial_peers=peers, identity=Identity.generate(),
                          rpc_timeout=5.0))
     timers = PhaseTimers()
     restore = timers.patch()
     t_match_s = time.monotonic()
+    # min_group_size counts CONTRIBUTORS (assistants don't), so asking
+    # for n_all keeps the early-exit quorum unsatisfiable and forces the
+    # full window — the 3-member group forms deterministically instead
+    # of racing the assistant's announce against the trainers' polls
     groups = run_threads([
         (lambda i=i: make_group(
-            nodes[i], f"payload_{mode}", 0, weight=1.0,
-            matchmaking_time=4.0, min_group_size=n_peers, encrypt=True))
-        for i in range(n_peers)])
+            nodes[i], f"payload_{mode}", 0,
+            weight=1.0 if i < n_peers else 0.0,
+            matchmaking_time=4.0, min_group_size=n_all, encrypt=True))
+        for i in range(n_all)])
     t_match = time.monotonic() - t_match_s
-    assert all(g is not None and g.size == n_peers for g in groups)
+    assert all(g is not None and g.size == n_all for g in groups)
 
     compressors = [PowerSGDCompressor(rank=4) for _ in range(n_peers)]
-    reports = [dict() for _ in range(n_peers)]
+    reports = [dict() for _ in range(n_all)]
 
     def peer(i):
+        if i >= n_peers:  # averaging assistant: zero template, weight 0
+            template = [np.zeros(total_elems, np.float32)]
+            return run_allreduce(
+                nodes[i], groups[i], f"payload_{mode}", 0, template,
+                weight=0.0, allreduce_timeout=budget, report=reports[i])
         if mode == "power_sgd":
             def reduce_fn(tensors, phase):
                 rep = {}
@@ -161,16 +175,18 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
         return out
 
     t0 = time.monotonic()
-    results = run_threads([lambda i=i: peer(i) for i in range(n_peers)])
+    results = run_threads([lambda i=i: peer(i) for i in range(n_all)])
     wall = time.monotonic() - t0
     restore()
     for n in nodes:
         n.shutdown()
 
-    # correctness: every peer ends with (approximately) the group mean
+    # correctness: every TRAINER ends with (approximately) the mean of
+    # the trainers' data (assistants contribute nothing and collect
+    # nothing — their returned value is their own discarded input)
     expected = sum(flatten_tensors(a) for a in arrays_per_peer) / n_peers
     worst = 0.0
-    for res in results:
+    for res in results[:n_peers]:
         flat = flatten_tensors([np.asarray(r) for r in res])
         worst = max(worst, float(np.max(np.abs(flat - expected))))
     scale = float(np.max(np.abs(expected)))
@@ -178,17 +194,20 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
     mb = total_elems * 4 / 1e6
     # slowest peer's per-phase wall times (phases overlap across peers on
     # this one-core VM, so the per-peer view is what a real host sees)
-    slowest = max((r.get("phases", {}) for r in reports),
+    slowest = max((r.get("phases", {}) for r in reports[:n_peers]),
                   key=lambda p: sum(p.values()), default={})
+    label = (f"{mode}, {n_peers} peers"
+             + (f" + {n_assist} assist" if n_assist else ""))
     row = {
-        "metric": f"swarm payload allreduce ({mode}, {n_peers} peers)",
+        "metric": f"swarm payload allreduce ({label})",
         "payload_mb_f32": round(mb, 1),
         "epoch_wall_s": round(wall, 2),
         "matchmaking_s": round(t_match, 2),
         "encode_s": round(timers.encode, 2),
         "decode_s": round(timers.decode, 2),
         "aead_s": round(timers.aead, 2),
-        "complete": all(r.get("complete", False) for r in reports),
+        "complete": all(r.get("complete", False)
+                        for r in reports[:n_peers]),
         "slowest_peer_phases": slowest,
         "max_err_vs_mean": round(worst, 5),
         "err_scale": round(scale, 3),
@@ -199,8 +218,14 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
 
 
 def main():
-    peer_counts = [int(a) for a in sys.argv[1:]] or [2, 4]
-    max_n = max(peer_counts)
+    bad = [a for a in sys.argv[1:] if not a.isdigit() and a != "assist"]
+    if bad:
+        raise SystemExit(f"unknown arguments: {bad} "
+                         "(expected peer counts and/or 'assist')")
+    peer_counts = [int(a) for a in sys.argv[1:]
+                   if a.isdigit()] or [2, 4]
+    # the assist and power_sgd rows are fixed 2-trainer configs
+    max_n = max(max(peer_counts), 2)
     print("# generating flagship-shaped gradient sets...", file=sys.stderr)
     arrays, total = [], 0
     for i in range(max_n):
@@ -216,6 +241,11 @@ def main():
         # budget and report wall/N as the per-peer number a real host sees
         rows.append(bench_config(n, "size_adaptive", arrays[:n], total,
                                  budget=60.0 * max(1, n // 2)))
+    if "assist" in sys.argv[1:]:
+        # M44 averaging-assist at the full flagship payload: 2 trainers
+        # + 1 weight-0 assistant owning a third of the parts
+        rows.append(bench_config(2, "size_adaptive", arrays[:2], total,
+                                 budget=90.0, n_assist=1))
     rows.append(bench_config(2, "power_sgd", arrays[:2], total))
 
     print("\n| mode | peers | payload | epoch | matchmake | encode | "
