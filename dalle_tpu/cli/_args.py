@@ -16,8 +16,12 @@ from typing import Any, Dict, Optional, Sequence, Tuple, Type
 
 
 def _unwrap_optional(tp):
+    import types
+
     origin = typing.get_origin(tp)
-    if origin is typing.Union:
+    # typing.Union covers Optional[X]; types.UnionType covers PEP 604
+    # ``X | None`` annotations — argparse needs the bare callable either way
+    if origin is typing.Union or origin is types.UnionType:
         args = [a for a in typing.get_args(tp) if a is not type(None)]
         if len(args) == 1:
             return args[0]

@@ -48,6 +48,14 @@ def build_parser() -> argparse.ArgumentParser:
     ss.add_argument("--preset", choices=("tiny", "flagship"),
                     default="tiny")
     ss.add_argument("--seed", type=int, default=0)
+    ss.add_argument(
+        "--structured", action="store_true",
+        help="codes follow a deterministic caption->texture grammar "
+             "(8x8 motif tiling) instead of uniform noise: conditional "
+             "code entropy given the caption is ~0 and the per-image "
+             "alphabet is 64 codes, so a training run can drive the loss "
+             "far below the ~9.0 uniform-entropy floor — the end-to-end "
+             "learning-proof dataset (VERDICT r4 next #4)")
     return parser
 
 
@@ -67,6 +75,38 @@ def train_tokenizer(args) -> None:
                 args.out)
 
 
+def structured_codes(caption: str, cfg, motif_bank) -> "np.ndarray":
+    """Deterministic caption->codes grammar: the image grid is an 8x8
+    texture motif (chosen by the caption's first word) tiled across the
+    grid, value-shifted by the second word and row-sheared by the word
+    count. Fully determined by the caption with a 64-code alphabet per
+    image — a model that learns the grammar drives its image loss toward
+    zero, far below the uniform floor ln(vocab)~9.0 that r4's uniform
+    shards could never cross (the learning-proof dataset)."""
+    import hashlib
+
+    import numpy as np
+
+    words = caption.split()
+    h = [int.from_bytes(hashlib.sha256(w.encode()).digest()[:4], "big")
+         for w in words[:3]] + [0, 0, 0]
+    motif = motif_bank[h[0] % len(motif_bank)]          # (8, 8)
+    shift = h[1] % cfg.vocab_image
+    shear = len(words) % 8
+    g = cfg.image_grid
+    r = np.arange(g)[:, None]
+    c = np.arange(g)[None, :]
+    grid = motif[(r + shear * (c // 8)) % 8, c % 8]
+    return ((grid + shift) % cfg.vocab_image).astype("<i2").reshape(-1)
+
+
+def make_motif_bank(vocab_image: int, n: int = 16, seed: int = 7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab_image, size=(8, 8)) for _ in range(n)]
+
+
 def synthetic_shards(args) -> None:
     import os
 
@@ -80,20 +120,26 @@ def synthetic_shards(args) -> None:
     rng = np.random.default_rng(args.seed)
     words = ["red", "blue", "green", "cat", "dog", "tree", "house", "sky",
              "boat", "mountain", "tiny", "large", "painting", "photo"]
+    motif_bank = make_motif_bank(cfg.vocab_image) if args.structured \
+        else None
     os.makedirs(args.out, exist_ok=True)
     for s in range(args.shards):
         records = []
         for _ in range(args.records):
             n = int(rng.integers(3, 8))
             caption = " ".join(rng.choice(words, size=n))
-            codes = rng.integers(0, cfg.vocab_image,
-                                 size=cfg.image_seq_len).astype("<i2")
+            if args.structured:
+                codes = structured_codes(caption, cfg, motif_bank)
+            else:
+                codes = rng.integers(0, cfg.vocab_image,
+                                     size=cfg.image_seq_len).astype("<i2")
             records.append({"caption": caption, "codes": codes.tobytes(),
                             "NSFW": "UNLIKELY",
                             "width": 256, "height": 256})
         path = os.path.join(args.out, f"shard_{s:05d}.msgpack")
         write_shard(path, records)
-        logger.info("wrote %s (%d records)", path, len(records))
+        logger.info("wrote %s (%d records%s)", path, len(records),
+                    ", structured" if args.structured else "")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -109,7 +109,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ckpt_mgr = None
     if aux.checkpoint_dir:
         from dalle_tpu.training.checkpoint import CheckpointManager
-        ckpt_mgr = CheckpointManager(aux.checkpoint_dir)
+        # sync writes: the aux peer is already off the training path (the
+        # reference's whole point, run_aux_peer.py:59-76), and the upload
+        # worker reads the file right after save returns
+        ckpt_mgr = CheckpointManager(aux.checkpoint_dir,
+                                     async_writes=False)
     # averaging assist: the reference declares-but-stubs this mode (its
     # run_aux_peer.py:99-104 raises NotImplementedError); here it is
     # implemented — weight-0 part ownership in every gradient round

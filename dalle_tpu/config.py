@@ -102,6 +102,16 @@ class ModelConfig:
     # flagship step (profiled r3); unroll N divides it by N at the cost
     # of an N-times-larger compiled body.
     scan_unroll: int = 1
+    # Hoist the f32->bf16 parameter casts OUT of the weight-shared scan
+    # (and its remat region): the scan body then reads pre-cast bf16
+    # weights — the per-iteration casts and their remat replays disappear
+    # (4.1% of the r3 flagship profile) and the shared-grad scan carry
+    # accumulates in BF16, halving the carry read-modify-write bytes
+    # (the remaining ~9% after scan_unroll=2). The cost is bf16
+    # round-nearest gradient accumulation across the cycle repetitions
+    # (master params/LAMB stay f32) — measure trajectory drift before
+    # enabling for a long run (PERF.md r5 records both).
+    param_cast_hoist: bool = False
     # Fused Pallas GEGLU feed-forward (ops/pallas/geglu_kernels.py): the
     # (B*T, ff_mult*dim) intermediates stay in VMEM tiles and backward
     # saves only the FF input. "plain" fuses the non-rematted blocks
@@ -230,6 +240,12 @@ class OptimizerConfig:
     # Reference offloads optimizer state to host (offload.py, task.py:130);
     # on TPU the idiomatic default is sharded on-device state.
     offload: bool = False
+    # dense_scan stacked-leaf leading-axis size (ModelConfig
+    # .dense_scan_reps()), threaded in by the task wiring so LAMB's
+    # per-slice trust ratios are CONFIG-derived, not inferred from
+    # parameter names (ADVICE r4). 0 = the model has no stacked leaves;
+    # None = infer by path heuristic (standalone optimizer construction).
+    stacked_reps: "int | None" = None
 
 
 @dataclass(frozen=True)
@@ -319,6 +335,13 @@ class PeerConfig:
     # issued by ``python -m dalle_tpu.cli.issue_token``.
     auth_authority: Optional[str] = None
     auth_token_path: Optional[str] = None
+    # Rendezvous bootstrap (swarm/rendezvous.py) — the offline-exercisable
+    # analogue of the reference's IPFS-assisted bootstrap (use_ipfs,
+    # arguments.py:100-106): a shared file (NFS / mounted bucket) where
+    # routable peers advertise and joiners with an empty initial_peers
+    # list find their first contact; peers also advertise in the DHT
+    # under {prefix}_rendezvous for list-repair after first contact.
+    rendezvous_path: Optional[str] = None
 
 
 @dataclass(frozen=True)

@@ -133,8 +133,16 @@ class FusedLayerNorm(nn.Module):
         m = 1
         for s in shape[:-1]:
             m *= s
-        if attn_mod._pallas_by_default() and ln_supported(m, d):
-            y = layer_norm(x.reshape(m, d).astype(_dtype(cfg)), scale,
+        # one numerical contract (flax's): statistics are formed in f32
+        # from the ORIGINAL input. The kernel reads activation-dtype
+        # tiles, so it is used only when the input is ALREADY in
+        # activation dtype (the model's steady state — the cast below is
+        # then a no-op); a wider input (f32 into a bf16 model) takes the
+        # inline fallback, whose f32 stats match nn.LayerNorm exactly
+        # (ADVICE r4: the two paths previously diverged on such inputs)
+        if (attn_mod._pallas_by_default() and ln_supported(m, d)
+                and x.dtype == jnp.dtype(_dtype(cfg))):
+            y = layer_norm(x.reshape(m, d), scale,
                            bias, 1e-6, 256, attn_mod._PALLAS_INTERPRET)
             return y.reshape(shape)
         xf = x.astype(jnp.float32)

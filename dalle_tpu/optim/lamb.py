@@ -53,7 +53,7 @@ def default_wd_mask(params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def default_stacked_mask(params) -> Any:
+def default_stacked_mask(params, reps: Optional[int] = None) -> Any:
     """True for dense_scan's STACKED per-iteration leaves (transformer.py:
     scan with ``variable_axes={"params": 0}``): leaves under the scanned
     ``cycle`` whose rank exceeds their kind's canonical rank (kernel 2;
@@ -61,14 +61,29 @@ def default_stacked_mask(params) -> Any:
     LAMB's per-tensor trust ratio must then be computed PER SLICE so the
     stacked model optimizes identically to its unrolled equivalent —
     one shared ratio across 16 independent layers would silently change
-    convergence dynamics vs the model dense_scan merely re-stages."""
+    convergence dynamics vs the model dense_scan merely re-stages.
+
+    ``reps`` is the config-derived stacked-axis size
+    (``ModelConfig.dense_scan_reps()``, threaded through
+    ``OptimizerConfig.stacked_reps`` by the task wiring): 0 means the
+    model has NO stacked leaves (every leaf gets the ordinary per-tensor
+    ratio regardless of its name), and a positive value additionally
+    requires the leading axis to equal it — so a future rank-3 kernel or
+    odd-rank param under the cycle scope cannot silently opt into
+    per-slice ratios (ADVICE r4). ``reps=None`` keeps the name+rank
+    inference for callers without model context."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree_util.tree_structure(params)
     out = []
     for path, leaf in flat:
         keys = [getattr(p, "key", str(p)).lower() for p in path]
         canonical = 2 if keys and keys[-1] == "kernel" else 1
-        out.append("cycle" in keys and leaf.ndim > canonical)
+        stacked = "cycle" in keys and leaf.ndim > canonical
+        if reps is not None:
+            stacked = (stacked and reps > 0
+                       and leaf.ndim == canonical + 1
+                       and leaf.shape[0] == reps)
+        out.append(stacked)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -107,6 +122,7 @@ def lamb(learning_rate: ScalarOrSchedule,
          clamp_value: float = 10000.0,
          max_grad_norm: Optional[float] = 4.0,
          wd_mask_fn: Callable[[Any], Any] = default_wd_mask,
+         stacked_reps: Optional[int] = None,
          ) -> optax.GradientTransformation:
 
     def init_fn(params):
@@ -134,7 +150,7 @@ def lamb(learning_rate: ScalarOrSchedule,
         lr = learning_rate(state.count) if callable(learning_rate) \
             else learning_rate
         wd_mask = wd_mask_fn(params)
-        stacked_mask = default_stacked_mask(params)
+        stacked_mask = default_stacked_mask(params, stacked_reps)
 
         def leaf_update(p, m, v, decay, stacked):
             return lamb_leaf_update(
@@ -169,4 +185,4 @@ def make_optimizer_fp32(cfg: OptimizerConfig) -> optax.GradientTransformation:
         learning_rate=make_lr_schedule(cfg),
         b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
         weight_decay=cfg.weight_decay, clamp_value=cfg.clamp_value,
-        max_grad_norm=cfg.max_grad_norm)
+        max_grad_norm=cfg.max_grad_norm, stacked_reps=cfg.stacked_reps)

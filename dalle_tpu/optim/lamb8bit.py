@@ -62,6 +62,7 @@ def lamb8bit(learning_rate: ScalarOrSchedule,
              block_size: int = DEFAULT_BLOCK,
              min_8bit_size: int = 65536,
              wd_mask_fn: Callable[[Any], Any] = default_wd_mask,
+             stacked_reps: Optional[int] = None,
              ) -> optax.GradientTransformation:
 
     def _quantize_moment(x: jax.Array, signed: bool):
@@ -92,7 +93,8 @@ def lamb8bit(learning_rate: ScalarOrSchedule,
         m_leaves = treedef.flatten_up_to(state.mu)
         v_leaves = treedef.flatten_up_to(state.nu)
         d_leaves = treedef.flatten_up_to(wd_mask_fn(params))
-        s_leaves = treedef.flatten_up_to(default_stacked_mask(params))
+        s_leaves = treedef.flatten_up_to(
+            default_stacked_mask(params, stacked_reps))
 
         g_leaves = [g.astype(jnp.float32) for g in g_leaves]
         if max_grad_norm is not None:
@@ -128,7 +130,7 @@ def make_optimizer_8bit(cfg: OptimizerConfig) -> optax.GradientTransformation:
         b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
         weight_decay=cfg.weight_decay, clamp_value=cfg.clamp_value,
         max_grad_norm=cfg.max_grad_norm, block_size=cfg.block_size,
-        min_8bit_size=cfg.min_8bit_size)
+        min_8bit_size=cfg.min_8bit_size, stacked_reps=cfg.stacked_reps)
 
 
 def optimizer_state_bytes(state) -> int:

@@ -48,6 +48,7 @@ from __future__ import annotations
 import concurrent.futures
 import hashlib
 import struct
+import threading as _threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -221,49 +222,50 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         remaining = max(0.1, deadline - time.monotonic())
         return dht.send(addr, tag, wire_body, timeout=remaining)
 
-    def send_chunk(addr: str, tag: int, body: bytes) -> bool:
-        return send_raw(addr, tag, maybe_encrypt(gkey, body))
-
-    def recv_chunk(tag: int, timeout: float) -> Optional[bytes]:
-        return maybe_decrypt(gkey, dht.recv(tag, timeout=timeout))
-
     def fetch_chunk(addr: str, tag: int, timeout: float) -> Optional[bytes]:
         return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
     # --- scatter: my data for part k -> owner k, chunk by chunk ---------
     # weight-0 members (averaging assistants / 0-sample trainers) have
-    # nothing to contribute: they send no scatter chunks
-    with concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(8, len(owners))) as pool:
+    # nothing to contribute: they send no scatter chunks.
+    # The WHOLE per-chunk production — compress, sign, encrypt, send —
+    # runs as one pool task per chunk, so the codec work for chunk i+1
+    # overlaps the wire of chunk i AND the receive thread enters the
+    # reduce phase immediately instead of after serializing every encode
+    # (VERDICT r4 weak #7: encode-serial rounds spent half their wall on
+    # the codec). chunk_idx places each frame; order is irrelevant.
+    def produce_scatter(addr: str, tag: int, ctx: bytes, alo: int,
+                        ahi: int, ci: int, n_chunks: int
+                        ) -> Tuple[str, int, bytes, bool]:
+        piece = flat[alo:ahi]
+        c = part_codec(piece.size)
+        body = _make_frame(dht.identity, ctx, group.group_hash,
+                           group.my_index, weight, piece.size, c,
+                           compression.compress(piece, c),
+                           chunk=ci, n_chunks=n_chunks)
+        wire_body = maybe_encrypt(gkey, body)
+        return addr, tag, wire_body, send_raw(addr, tag, wire_body)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool, \
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=4) as dec_pool:
         futures = []
-        sends: List[Tuple[str, int, bytes]] = []  # for the one retry pass
         scatter_to = list(enumerate(owners)) if weight > 0 else []
         for k, owner in scatter_to:
             if k == my_part:
                 continue
             lo, hi = slices[k]
-            part = flat[lo:hi]
-            chunks = _chunk_slices(part.size, chunk_elems)
+            chunks = _chunk_slices(hi - lo, chunk_elems)
             ctx = _sign_ctx(prefix, epoch, "scatter", owner.peer_id)
             tag = _tag(prefix, epoch, "scatter", owner.peer_id)
             for ci, (clo, chi) in enumerate(chunks):
-                piece = part[clo:chi]
-                c = part_codec(piece.size)
-                body = _make_frame(dht.identity, ctx, group.group_hash,
-                                   group.my_index, weight, piece.size, c,
-                                   compression.compress(piece, c),
-                                   chunk=ci, n_chunks=len(chunks))
-                # one future per chunk: encode of chunk i+1 overlaps the
-                # wire of chunk i (the pool serializes per-endpoint sends
-                # through the connection pool, preserving order is not
-                # required — chunk_idx places each frame)
-                sends.append((owner.addr, tag, body))
-                futures.append(pool.submit(send_chunk, owner.addr, tag,
-                                           body))
+                futures.append(pool.submit(
+                    produce_scatter, owner.addr, tag, ctx,
+                    lo + clo, lo + chi, ci, len(chunks)))
         t_built = time.monotonic()
         phases["scatter_build_s"] = round(t_built - t0, 3)
 
-        # --- reduce my part while scatter sends run ---------------------
+        # --- reduce my part while scatter encode+sends run --------------
         averaged_mine: Optional[np.ndarray] = None
         if my_part is not None:
             lo, hi = slices[my_part]
@@ -284,37 +286,56 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             got: Dict[int, set] = {}
             my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
             my_ctx = _sign_ctx(prefix, epoch, "scatter", me.peer_id)
+
+            def decode_reduce(raw_enc: bytes):
+                # decrypt+verify+decompress off the receive thread: the
+                # wire read of chunk i+1 overlaps the decode of chunk i
+                raw = maybe_decrypt(gkey, raw_enc)
+                if raw is None:
+                    return None
+                return _parse(raw, group, my_chunks, my_ctx)
+
+            decoding: List[concurrent.futures.Future] = []
             last_progress = time.monotonic()
             while expected:
                 now = time.monotonic()
                 if now >= reduce_deadline:
                     break  # gather keeps the remaining budget
-                if now - last_progress >= sender_timeout:
+                if (now - last_progress >= sender_timeout
+                        and not decoding):
                     break  # no chunk for a while: remaining senders banned
-                raw = recv_chunk(my_tag, timeout=min(
-                    0.5, max(0.05, reduce_deadline - now)))
-                if raw is None:
-                    continue
-                parsed = _parse(raw, group, my_chunks, my_ctx)
-                if parsed is None:
-                    continue
-                sender, w, ci, data = parsed
-                if sender not in expected:
-                    continue  # duplicate or already-complete sender
-                if sender not in bufs:
-                    bufs[sender] = np.zeros(n_mine, np.float32)
-                    got[sender] = set()
-                if ci in got[sender]:
-                    continue  # duplicate chunk
-                clo, chi = my_chunks[ci]
-                bufs[sender][clo:chi] = data
-                got[sender].add(ci)
-                if len(got[sender]) == len(my_chunks):
-                    acc += bufs.pop(sender) * w
-                    got.pop(sender)
-                    total_w += w
-                    expected.discard(sender)
-                last_progress = time.monotonic()
+                still: List[concurrent.futures.Future] = []
+                for f in decoding:
+                    if not f.done():
+                        still.append(f)
+                        continue
+                    parsed = f.result()
+                    if parsed is None:
+                        continue
+                    sender, w, ci, data = parsed
+                    if sender not in expected:
+                        continue  # duplicate or already-complete sender
+                    if sender not in bufs:
+                        bufs[sender] = np.zeros(n_mine, np.float32)
+                        got[sender] = set()
+                    if ci in got[sender]:
+                        continue  # duplicate chunk
+                    clo, chi = my_chunks[ci]
+                    bufs[sender][clo:chi] = data
+                    got[sender].add(ci)
+                    if len(got[sender]) == len(my_chunks):
+                        acc += bufs.pop(sender) * w
+                        got.pop(sender)
+                        total_w += w
+                        expected.discard(sender)
+                    last_progress = time.monotonic()
+                decoding = still
+                if not expected:
+                    break
+                raw = dht.recv(my_tag, timeout=min(
+                    0.2, max(0.05, reduce_deadline - now)))
+                if raw is not None:
+                    decoding.append(dec_pool.submit(decode_reduce, raw))
             if expected and report is not None:
                 report["complete"] = False
             if report is not None:
@@ -344,11 +365,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         # (swarm.cc rpc, ADVICE r3), but at THIS layer a resend is safe —
         # receivers de-duplicate by (sender, chunk_idx) — so a dropped
         # connection costs one retry instead of this peer's whole
-        # contribution being banned at the owner.
-        retries = [s for f, s in zip(futures, sends)
-                   if not f.cancelled() and not f.result()]
+        # contribution being banned at the owner. The produced wire body
+        # rides the future result, so the retry skips the codec.
+        retries = [f.result()[:3] for f in futures
+                   if not f.cancelled() and not f.result()[3]]
         if retries and time.monotonic() < deadline:
-            retry_futs = [pool.submit(send_chunk, *s) for s in retries]
+            retry_futs = [pool.submit(send_raw, *s) for s in retries]
             concurrent.futures.wait(retry_futs)
         phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
 
@@ -360,10 +382,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     out = flat.copy() if weight > 0 else flat
 
     t_gather = time.monotonic()
-    with concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(8, group.size)) as pool:
+    send_lock = _threading.Lock()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool, \
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=4) as codec_pool, \
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=4) as dec_pool:
         futures = []
         sends = []
+        produce_futs = []
         # averaged_mine is None only for an assistant that received no
         # contributions: withhold the part (see the reduce phase)
         if my_part is not None and averaged_mine is not None:
@@ -377,12 +404,18 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             push_to = [m for m in group.members
                        if m.peer_id != me.peer_id and m.addr
                        and m.weight > 0]
-            for ci, (clo, chi) in enumerate(my_chunks):
+
+            def produce_gather(ci: int, clo: int, chi: int) -> None:
+                # compress + local-apply + sign + encrypt on a codec
+                # worker; the sends fan out through the send pool, so the
+                # codec of chunk i+1 overlaps the wire of chunk i AND the
+                # receive thread starts collecting other parts at once
                 piece = averaged_mine[clo:chi]
                 c = part_codec(piece.size)
                 wire = compression.compress(piece, c)
                 # apply the same lossy wire bytes locally so all members
                 # end the round with byte-identical values for this part
+                # (chunks write disjoint slices of out: thread-safe)
                 out[lo + clo:lo + chi] = compression.decompress(
                     wire, c, piece.size)
                 body = _make_frame(dht.identity, gather_ctx,
@@ -393,11 +426,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # per chunk, not once per recipient (the scatter path must
                 # stay per-receiver, its bodies differ)
                 wire_body = maybe_encrypt(gkey, body)
-                for m in push_to:
-                    gtag = _tag(prefix, epoch, "gather", m.peer_id)
-                    sends.append((m.addr, gtag, wire_body))
-                    futures.append(pool.submit(send_raw, m.addr, gtag,
-                                               wire_body))
+                with send_lock:
+                    for m in push_to:
+                        gtag = _tag(prefix, epoch, "gather", m.peer_id)
+                        sends.append((m.addr, gtag, wire_body))
+                        futures.append(pool.submit(send_raw, m.addr, gtag,
+                                                   wire_body))
                 if have_clients:
                     # client-mode members can't receive pushes: publish
                     # each chunk of the averaged part in this owner's
@@ -407,6 +441,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                              wire_body,
                              expiration_time=time.time()
                              + 2 * allreduce_timeout)
+
+            for ci, (clo, chi) in enumerate(my_chunks):
+                produce_futs.append(
+                    codec_pool.submit(produce_gather, ci, clo, chi))
 
         # weight-0 assistants collect no result at all (nothing to apply
         # it to — and a routable assistant must NOT fall into the
@@ -427,35 +465,60 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 group.members.index(m): owner_index[m.peer_id]
                 for m in owners}
             gather_tag = _tag(prefix, epoch, "gather", me.peer_id)
+
+            def decode_gather(raw_enc: bytes):
+                # decrypt+verify+decompress on a decode worker; the
+                # receive thread keeps draining the wire meanwhile
+                raw = maybe_decrypt(gkey, raw_enc)
+                if raw is None:
+                    return None
+                head = _peek(raw, group)
+                if head is None:
+                    return None
+                part = sender_to_part.get(head[0])
+                if part is None:
+                    return None
+                parsed = _parse(raw, group, part_chunks[part], gather_ctx)
+                if parsed is None:
+                    return None
+                return part, parsed
+
+            decoding: List[concurrent.futures.Future] = []
             last_progress = max(time.monotonic(), gather_baseline)
             while pending:
                 now = time.monotonic()
-                if now >= deadline or now - last_progress >= sender_timeout:
+                if now >= deadline or (not decoding and
+                                       now - last_progress
+                                       >= sender_timeout):
                     break  # dead owners: their parts keep local values
-                raw = recv_chunk(gather_tag, timeout=min(
-                    0.5, max(0.05, deadline - now)))
-                if raw is None:
-                    continue
-                head = _peek(raw, group)
-                if head is None:
-                    continue
-                sender, _w = head
-                part = sender_to_part.get(sender)
-                if part is None or part not in pending:
-                    continue
-                parsed = _parse(raw, group, part_chunks[part], gather_ctx)
-                if parsed is None:
-                    continue
-                _, _, ci, data = parsed
-                if ci not in pending[part]:
-                    continue  # duplicate chunk
-                lo, hi = slices[part]
-                clo, chi = part_chunks[part][ci]
-                out[lo + clo:lo + chi] = data
-                pending[part].discard(ci)
-                if not pending[part]:
-                    del pending[part]
-                last_progress = time.monotonic()
+                still: List[concurrent.futures.Future] = []
+                for f in decoding:
+                    if not f.done():
+                        still.append(f)
+                        continue
+                    res = f.result()
+                    if res is None:
+                        continue
+                    part, (_s, _w, ci, data) = res
+                    if part not in pending or ci not in pending[part]:
+                        continue  # duplicate chunk / completed part
+                    # NB: fresh names — produce_gather's codec threads read
+                    # the enclosing lo/clo/chi lazily; rebinding them here
+                    # would corrupt the local-apply offsets (r5 bug)
+                    plo, _phi = slices[part]
+                    pclo, pchi = part_chunks[part][ci]
+                    out[plo + pclo:plo + pchi] = data
+                    pending[part].discard(ci)
+                    if not pending[part]:
+                        del pending[part]
+                    last_progress = time.monotonic()
+                decoding = still
+                if not pending:
+                    break
+                raw = dht.recv(gather_tag, timeout=min(
+                    0.2, max(0.05, deadline - now)))
+                if raw is not None:
+                    decoding.append(dec_pool.submit(decode_gather, raw))
             # chunks never received keep this peer's local values (owner
             # died mid-round): degraded but well-defined
             if pending and report is not None:
@@ -503,6 +566,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             if pending and report is not None:
                 report["complete"] = False
 
+        concurrent.futures.wait(produce_futs)
+        for f in produce_futs:
+            f.result()  # surface codec bugs instead of dropping the part
         concurrent.futures.wait(futures)
         # same application-layer retry as scatter: gather chunks are
         # de-duplicated by (part, chunk_idx) at every receiver

@@ -141,7 +141,15 @@ class AveragingAssistant(threading.Thread):
         logger.info("averaging assistant up: %d grad elements (%.1f MB "
                     "f32 parts pool)", self._n_elements,
                     self._n_elements * 4 / 1e6)
-        last_epoch = -1
+        # last epoch this assistant is DONE with — set on "assisted" AND
+        # on "empty" (a group formed; whatever it was, this epoch's
+        # announces are spent): re-joining the same epoch would only
+        # matchmake against the round's stale announces and burn another
+        # window, possibly costing trainers an elasticity timeout each
+        # time (ADVICE r4). "idle" keeps retrying — the epoch's real
+        # round may simply not have started yet, and camping through the
+        # window is how the assistant's announce makes the roster.
+        last_handled = -1
         empty_streak = 0
         while not self._stop_event.is_set():
             try:
@@ -154,11 +162,7 @@ class AveragingAssistant(threading.Thread):
                     # first progress report to matchmaking in a second.
                     self._stop_event.wait(0.5)
                     continue
-                if progress.epoch <= last_epoch:
-                    # already assisted this epoch: trainers run one round
-                    # per epoch, so rejoining would only matchmake with
-                    # the round's STALE announces (they outlive the round
-                    # by design) and burn an elasticity timeout
+                if progress.epoch <= last_handled:
                     self._stop_event.wait(0.5)
                     continue
                 outcome = assist_one_round(self.dht, self.cfg,
@@ -166,12 +170,13 @@ class AveragingAssistant(threading.Thread):
                                            self.authorizer, codec=codec)
                 if outcome == "assisted":
                     self.rounds_assisted += 1
-                    last_epoch = progress.epoch
+                    last_handled = progress.epoch
                     empty_streak = 0
                     logger.info("assisted epoch %d (total %d rounds)",
                                 progress.epoch, self.rounds_assisted)
                 elif outcome == "empty":
                     empty_streak += 1
+                    last_handled = progress.epoch
                     if empty_streak >= 3:
                         # groups form but NOTHING this assistant can
                         # parse ever arrives: almost certainly this aux
@@ -182,13 +187,11 @@ class AveragingAssistant(threading.Thread):
                         # occupying a part slot while unparseable is
                         # WORSE than not assisting.
                         logger.error(
-                            "%d consecutive assisted rounds received no "
-                            "parseable contribution — likely a model "
-                            "config mismatch with the trainers (this "
-                            "peer expects %d grad elements), or this "
-                            "assistant keeps matchmaking against stale "
-                            "announces of already-finished rounds. "
-                            "Backing off 60s",
+                            "%d consecutive DISTINCT epochs' assisted "
+                            "rounds received no parseable contribution — "
+                            "almost certainly a model config mismatch "
+                            "with the trainers (this peer expects %d "
+                            "grad elements). Backing off 60s",
                             empty_streak, self._n_elements)
                         self._stop_event.wait(60.0)
             except Exception:  # noqa: BLE001 - a failed round must not

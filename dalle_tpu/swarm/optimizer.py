@@ -27,6 +27,7 @@ per-epoch state averaging; periodic state averaging
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any, Callable, List, Optional
 
@@ -47,6 +48,33 @@ logger = logging.getLogger(__name__)
 
 _CODECS = {"none": compression.NONE, "float16": compression.FLOAT16,
            "uniform8bit": compression.UNIFORM8BIT, "size_adaptive": None}
+
+
+class _PendingRound:
+    """An overlapped swarm round in flight on a background thread.
+
+    Holds the gradient accumulator handed off at launch (``leaves``, still
+    on device) and receives the wire outcome (``result`` = averaged host
+    arrays, or None for an ALONE epoch whose device grads flow straight to
+    the apply). The worker thread only touches the wire + host pulls; all
+    train-state mutation happens at reconcile time on the training thread.
+    """
+
+    def __init__(self, epoch: int, treedef, leaves: List[Any],
+                 weight: float, weight_int: int):
+        self.epoch = epoch
+        self.treedef = treedef
+        self.leaves = leaves
+        self.weight = weight
+        self.weight_int = weight_int          # frozen progress report value
+        self.result: Optional[List[np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.group_size = 1
+        self.timings: dict = {}
+        self.overlapped_steps = 0             # grad steps run during round
+        self.hidden_s = 0.0                   # round wall hidden from chip
+        self.done = threading.Event()
+        self.thread: Optional[threading.Thread] = None
 
 
 class _FollowerEMA:
@@ -138,6 +166,7 @@ class CollaborativeOptimizer:
         self._accumulate = jax.jit(
             lambda acc, g, s: jax.tree.map(
                 lambda a, b: a + b.astype(jnp.float32) * s, acc, g))
+        self._pending: Optional[_PendingRound] = None
         self._next_resync = 0.0
         self.last_timings: dict = {}
         self._apply_timings: dict = {}
@@ -194,13 +223,35 @@ class CollaborativeOptimizer:
 
     def step(self, grads: Any, batch_size: int) -> bool:
         """Record one local accumulation step; run a global step when the
-        swarm is ready. Returns True iff a global step happened.
+        swarm is ready. Returns True iff a global step (the optimizer
+        apply) happened during this call.
+
+        With ``cfg.delay_optimizer_step`` (the reference's default,
+        task.py:129-131) the swarm round — matchmaking + all-reduce — runs
+        on a background thread while step() keeps accumulating gradients
+        for the NEXT epoch into a fresh buffer, so the chip never idles
+        through the 15 s matchmaking + up-to-60 s all-reduce window. The
+        epoch counter and the tracker's published progress stay frozen at
+        the launch values until the round's result is applied (reconciled)
+        at a later step() boundary — to every other peer the DHT looks
+        identical to a synchronous round in progress, so stragglers still
+        join the in-flight round instead of resyncing. Samples accumulated
+        during the round were computed against the pre-apply params and
+        count toward the next epoch: the one-step staleness
+        delay_optimizer_step trades for zero device idle.
 
         In a multi-host slice every process calls step() in lockstep (the
         jitted grad step is itself a global collective); the coordinator's
         decision is broadcast so followers run the identical control flow.
+        Overlap is disabled there: followers cannot join broadcasts from a
+        background thread, so slices run the synchronous path.
         """
         from dalle_tpu.parallel.multihost import broadcast_decision
+
+        did_global = False
+        if self._pending is not None and self._pending.done.is_set():
+            self._finish_pending()
+            did_global = True
 
         if self._grad_acc is None:
             self._grad_acc = jax.tree.map(
@@ -208,6 +259,15 @@ class CollaborativeOptimizer:
         self._grad_acc = self._accumulate(
             self._grad_acc, grads, float(batch_size))
         self.local_samples += int(batch_size)
+        if self._pending is not None:
+            # round in flight: report the FROZEN pre-round progress (pure
+            # liveness — publishing the restarted counter would deflate the
+            # swarm's sample total and flip ready_to_update off for peers
+            # still deciding to join); decisions wait for the reconcile
+            self._pending.overlapped_steps += 1
+            self.tracker.report_local_progress(
+                self.local_epoch, self._pending.weight_int)
+            return did_global
         self.tracker.report_local_progress(
             self.local_epoch, self.local_samples)
 
@@ -233,11 +293,160 @@ class CollaborativeOptimizer:
                     "behind the swarm (local %d < global %d): resyncing",
                     self.local_epoch, min_epoch)
             self.load_state_from_peers(min_epoch=min_epoch)
-            return False
+            return did_global
         if decision == self._GLOBAL_STEP:
+            if self._delay_rounds:
+                self._launch_round()
+                return did_global  # the apply lands at a later reconcile
             self._run_global_step()
             return True
-        return False
+        return did_global
+
+    # -- overlapped rounds (delay_optimizer_step) -------------------------
+
+    @property
+    def _delay_rounds(self) -> bool:
+        """Overlapped rounds run only where the wire thread can act alone:
+        single-process peers that speak the swarm protocol. Multi-host
+        slices keep the synchronous path (followers must join broadcasts
+        in lockstep with the coordinator's training thread)."""
+        from dalle_tpu.parallel.multihost import process_count
+        return (self.cfg.delay_optimizer_step and self.role.swarm_enabled
+                and process_count() == 1)
+
+    def _launch_round(self) -> None:
+        """Hand the gradient accumulator to a background wire thread and
+        start a fresh buffer; the epoch advances when the round's result
+        is applied (``_finish_pending``)."""
+        pending = _PendingRound(
+            epoch=self.local_epoch,
+            treedef=jax.tree_util.tree_structure(self._grad_acc),
+            leaves=jax.tree_util.tree_leaves(self._grad_acc),
+            weight=float(max(self.local_samples, 1)),
+            weight_int=self.local_samples)
+        self._grad_acc = None
+        self.local_samples = 0
+        pending.thread = threading.Thread(
+            target=self._round_worker, args=(pending,),
+            name="swarm-round", daemon=True)
+        self._pending = pending
+        pending.thread.start()
+
+    def _round_worker(self, pending: _PendingRound) -> None:
+        """Wire half of an overlapped round: matchmaking + all-reduce.
+        Touches the DHT and host copies of the handed-off gradients only —
+        never ``self.state`` (the training thread owns it)."""
+        t0 = time.monotonic()
+        try:
+            group = make_group(
+                self.dht, f"{self.cfg.run_id}_grads", pending.epoch,
+                weight=pending.weight,
+                matchmaking_time=self.cfg.matchmaking_time,
+                min_group_size=self.matchmaking_min_group,
+                client_mode=self.client_mode, authorizer=self.authorizer,
+                encrypt=self.cfg.encrypt_data_plane)
+            t_match = time.monotonic()
+            pending.timings["matchmaking_s"] = round(t_match - t0, 4)
+            if group is not None and group.size > 1:
+                budget = min(self.cfg.allreduce_timeout,
+                             max(1.0, self.cfg.averaging_timeout
+                                 - (t_match - t0)))
+                if self._powersgd is not None:
+                    grads_local = [g / pending.weight
+                                   for g in pending.leaves]
+                    from dalle_tpu.swarm.powersgd import \
+                        average_with_powersgd
+                    averaged = average_with_powersgd(
+                        self._powersgd, grads_local,
+                        self._powersgd_reduce_fn(group, pending.weight,
+                                                 budget, sharded=False),
+                        epoch=pending.epoch)
+                else:
+                    t_pull = time.monotonic()
+                    grads_local = [np.asarray(g) / pending.weight
+                                   for g in pending.leaves]
+                    pending.timings["grad_pull_s"] = round(
+                        time.monotonic() - t_pull, 4)
+                    averaged = run_allreduce(
+                        self.dht, group, f"{self.cfg.run_id}_grads",
+                        pending.epoch, grads_local, weight=pending.weight,
+                        allreduce_timeout=budget, codec=self._grad_codec,
+                        adaptive_threshold=self.cfg.size_adaptive_threshold)
+                pending.result = averaged
+                pending.timings["allreduce_s"] = round(
+                    time.monotonic() - t_match, 4)
+            if group is not None:
+                pending.group_size = group.size
+        except BaseException as e:  # noqa: BLE001 - reported at reconcile
+            pending.error = e
+        finally:
+            pending.hidden_s = time.monotonic() - t0
+            pending.done.set()
+
+    def _finish_pending(self, block: bool = False,
+                        discard: bool = False) -> None:
+        """Reconcile an overlapped round on the training thread: apply its
+        averaged gradients (or, for an ALONE / failed round, the handed-off
+        device gradients — the synchronous path's exact fallback) and
+        advance the epoch. ``block`` waits for the wire thread (bounded by
+        the round's own matchmaking/averaging deadlines); ``discard``
+        drops the round instead of applying (resync/teardown paths)."""
+        pending = self._pending
+        if pending is None:
+            return
+        if not pending.done.is_set():
+            if not block:
+                return
+            pending.thread.join()
+        else:
+            pending.thread.join()
+        self._pending = None
+        if discard:
+            return
+        if pending.error is not None:
+            logger.warning(
+                "overlapped round for epoch %d failed (%r): applying "
+                "local gradients", pending.epoch, pending.error)
+        averaged = pending.result
+        if averaged is None:
+            # ALONE epoch (or wire failure): the accumulated grads never
+            # left the device — they flow straight into the jitted apply
+            averaged = [g / pending.weight for g in pending.leaves]
+        self._apply_averaged(pending.treedef, averaged,
+                             preserve_accumulator=True)
+        # keep the per-phase schema identical to the synchronous path
+        # (metrics consumers key on these fields)
+        pending.timings.setdefault("grad_pull_s", 0.0)
+        pending.timings.setdefault("allreduce_s", 0.0)
+        self.last_timings = {
+            **pending.timings, **self._apply_timings,
+            "overlapped_steps": pending.overlapped_steps,
+            "hidden_s": round(pending.hidden_s, 4),
+        }
+        logger.info(
+            "overlapped global step -> epoch %d (group=%d, %d grad steps "
+            "ran during the %.2fs round, %s)", self.local_epoch,
+            pending.group_size, pending.overlapped_steps, pending.hidden_s,
+            self.last_timings)
+
+    def finalize(self) -> bool:
+        """Block until an in-flight overlapped round (if any) is applied.
+        Call at the end of training so the last epoch's averaging is not
+        lost. Returns True iff a round was applied."""
+        if self._pending is None:
+            return False
+        self._finish_pending(block=True)
+        return True
+
+    def drop_pending_round(self) -> None:
+        """Abandon the current trajectory's swarm work WITHOUT applying
+        it — the rollback paths' hook: discard an in-flight overlapped
+        round AND the live gradient accumulator. Both were computed
+        against pre-rollback (divergent) params; averaging either onto
+        restored state would defeat the rollback (r5 review findings)."""
+        self._finish_pending(block=True, discard=True)
+        self._grad_acc = None
+        self.local_samples = 0
 
     # _run_global_step exchange modes, broadcast coordinator -> followers
     # on slices whose gradients are sharded across processes
@@ -420,12 +629,15 @@ class CollaborativeOptimizer:
 
         return reduce_fn
 
-    def _apply_averaged(self, treedef, averaged) -> None:
+    def _apply_averaged(self, treedef, averaged,
+                        preserve_accumulator: bool = False) -> None:
         """The post-exchange half of a global step, identical on every
         process of a slice: apply the averaged gradients, advance the
         epoch, and run the (broadcast-synchronized) state averaging.
         Fills ``self._apply_timings`` with the apply/state-averaging
-        split."""
+        split. ``preserve_accumulator`` (overlapped rounds): the live
+        accumulator holds the NEXT epoch's gradients collected during the
+        round — it must survive the reconcile."""
         t0 = time.monotonic()
         grads_tree = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(a) for a in averaged])
@@ -434,8 +646,9 @@ class CollaborativeOptimizer:
         t_applied = time.monotonic()
 
         self.local_epoch += 1
-        self.local_samples = 0
-        self._grad_acc = None
+        if not preserve_accumulator:
+            self.local_samples = 0
+            self._grad_acc = None
         self.tracker.reset_epoch(self.local_epoch)
 
         if (self.cfg.average_state_every > 0
@@ -562,6 +775,10 @@ class CollaborativeOptimizer:
         from dalle_tpu.parallel.multihost import (broadcast_arrays,
                                                   broadcast_decision)
 
+        # an in-flight overlapped round averages gradients for state this
+        # download is about to replace: drain and discard it first
+        self._finish_pending(block=True, discard=True)
+
         epoch, arrays = -1, None
         if self.role.swarm_enabled:
             result = load_state_from_peers(
@@ -614,6 +831,7 @@ class CollaborativeOptimizer:
         return True
 
     def shutdown(self) -> None:
+        self._finish_pending(block=True, discard=True)
         if self._server is not None:
             self._server.stop()
             self._server = None

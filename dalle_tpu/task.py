@@ -58,13 +58,39 @@ class TrainingTask:
     def dht(self):
         """This peer's swarm node (reference ``task.py:101-119``)."""
         from dalle_tpu.swarm.dht import DHT
+        initial_peers = list(self.peer_cfg.initial_peers)
+        rdv = None
+        if self.peer_cfg.rendezvous_path:
+            # IPFS-bootstrap analogue (reference arguments.py:100-106):
+            # an empty --initial-peers list falls back to the shared
+            # rendezvous file's fresh advertisements
+            from dalle_tpu.swarm.rendezvous import RendezvousFile
+            rdv = RendezvousFile(self.peer_cfg.rendezvous_path)
+            if not initial_peers:
+                # exclude our own (possibly stale, pre-restart)
+                # advertisement: a seed peer restarting within the TTL
+                # must not dial itself and report a bootstrapped swarm
+                initial_peers = rdv.fresh_peers(
+                    exclude_peer_id=self.identity.node_id.hex())
+                if initial_peers:
+                    logger.info("rendezvous bootstrap: %d peer(s) from %s",
+                                len(initial_peers),
+                                self.peer_cfg.rendezvous_path)
         dht = DHT(host=self.peer_cfg.host,
                   port=self.peer_cfg.port,
-                  initial_peers=self.peer_cfg.initial_peers,
+                  initial_peers=initial_peers,
                   client_mode=self.peer_cfg.client_mode,
                   identity=self.identity,
                   record_validators=make_validators(
                       self.identity, self.peer_cfg.experiment_prefix))
+        # advertise now and RE-advertise on a background cadence —
+        # rendezvous records/lines expire (DEFAULT_TTL), so a one-shot
+        # publish would strand joiners arriving later than the TTL
+        from dalle_tpu.swarm.rendezvous import RendezvousAdvertiser
+        self._rdv_advertiser = RendezvousAdvertiser(
+            dht, self.peer_cfg.experiment_prefix, rdv_file=rdv)
+        self._rdv_advertiser.publish_once()
+        self._rdv_advertiser.start()
         logger.info("swarm node up: peer_id=%s addr=%s",
                     dht.peer_id[:16], dht.visible_address)
         return dht
@@ -118,8 +144,16 @@ class TrainingTask:
 
     @functools.cached_property
     def tx(self):
+        import dataclasses
+
         from dalle_tpu.optim import make_optimizer
-        return make_optimizer(self.opt_cfg)
+        # thread the model's stacked-axis size so the per-slice trust
+        # ratio mask is config-derived, not name-inferred (ADVICE r4)
+        cfg = self.opt_cfg
+        if cfg.stacked_reps is None:
+            cfg = dataclasses.replace(
+                cfg, stacked_reps=self.model_cfg.dense_scan_reps())
+        return make_optimizer(cfg)
 
     @functools.cached_property
     def train_state(self):
@@ -213,6 +247,8 @@ class TrainingTask:
     def shutdown(self) -> None:
         if "collab_optimizer" in self.__dict__:
             self.collab_optimizer.shutdown()
+        if getattr(self, "_rdv_advertiser", None) is not None:
+            self._rdv_advertiser.stop()
         if "dht" in self.__dict__:
             self.dht.shutdown()
 

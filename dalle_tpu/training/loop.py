@@ -151,6 +151,11 @@ def train_loop(task: TrainingTask,
                         logger.warning(
                             "non-finite params after epoch %d: rolling "
                             "back to the local backup", epoch)
+                        # a round launched in the same step() that
+                        # reconciled the NaN-producing apply carries the
+                        # divergent trajectory's gradients: discard it
+                        # before restoring (never apply it post-rollback)
+                        collab.drop_pending_round()
                         restored = ckpt.restore_backup(collab.state)
                         if restored is None:
                             restored = ckpt.restore_latest(collab.state)
@@ -219,9 +224,23 @@ def train_loop(task: TrainingTask,
                 if on_epoch is not None:
                     on_epoch(report)
                 loss_sum, mini_steps = 0.0, 0
+        # an overlapped round (delay_optimizer_step) may still be in
+        # flight when the loop exits: apply it rather than lose the
+        # epoch's averaging (shutdown() would discard it)
+        if collab.finalize():
+            reports.append(EpochReport(
+                epoch=collab.local_epoch,
+                loss=loss_sum / max(mini_steps, 1),
+                mini_steps=mini_steps,
+                samples_per_second=(
+                    collab.tracker.performance_ema.samples_per_second)))
+            if ckpt is not None and params_are_finite(collab.state.params):
+                ckpt.save_backup(collab.state, collab.local_epoch)
     finally:
         # the trace from a crashed run is the artifact you want most
         profiler.close()
+        if ckpt is not None:
+            ckpt.close()  # drain async checkpoint writes before returning
     return reports
 
 

@@ -42,6 +42,21 @@ class TrainState(flax.struct.PyTreeNode):
 
 
 def _loss_fn(model, params, batch):
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and getattr(cfg, "param_cast_hoist", False):
+        # Hoist the f32->activation-dtype parameter casts to the TOP of
+        # the loss: every in-block cast (flax dtype promotion) becomes a
+        # no-op, so nothing re-casts inside remat replays (4.1% of the r3
+        # flagship profile), and the weight-shared scan's gradient carry
+        # accumulates in the ACTIVATION dtype — the cast's VJP converts
+        # the summed cotangent back to f32 once per microbatch. Master
+        # params, LAMB, and the cross-microbatch accumulator stay f32;
+        # only in-scan gradient accumulation narrows (config.py
+        # param_cast_hoist documents the measured trade).
+        adt = jnp.dtype(cfg.dtype)
+        params = jax.tree.map(
+            lambda p: p.astype(adt)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
     loss, aux = model.apply(params, batch["text"], batch["image"],
                             loss_mask=batch.get("mask"))
     return loss, aux

