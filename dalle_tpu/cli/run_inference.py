@@ -96,7 +96,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     model = DALLE(cfg)
     template = init_params(model, jax.random.PRNGKey(0))
     restored = CheckpointManager(
-        args.checkpoint_dir).restore_params_latest(template)
+        args.checkpoint_dir,
+        async_writes=False).restore_params_latest(template)
     if restored is None:
         logger.error("no loadable checkpoint under %s", args.checkpoint_dir)
         return 1

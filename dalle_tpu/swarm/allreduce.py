@@ -476,7 +476,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 if head is None:
                     return None
                 part = sender_to_part.get(head[0])
-                if part is None:
+                # skip the multi-MB verify+decompress for parts already
+                # complete (retried duplicates). Reading `pending` from
+                # the pool races benignly with the receive thread: a
+                # stale read only costs one wasted decode — correctness
+                # stays with the authoritative dedup at apply time.
+                if part is None or part not in pending:
                     return None
                 parsed = _parse(raw, group, part_chunks[part], gather_ctx)
                 if parsed is None:

@@ -86,11 +86,19 @@ class TrainingTask:
         # advertise now and RE-advertise on a background cadence —
         # rendezvous records/lines expire (DEFAULT_TTL), so a one-shot
         # publish would strand joiners arriving later than the TTL
-        from dalle_tpu.swarm.rendezvous import RendezvousAdvertiser
+        from dalle_tpu.swarm.rendezvous import (RendezvousAdvertiser,
+                                                discover)
         self._rdv_advertiser = RendezvousAdvertiser(
             dht, self.peer_cfg.experiment_prefix, rdv_file=rdv)
         self._rdv_advertiser.publish_once()
         self._rdv_advertiser.start()
+        # list REPAIR through the DHT rendezvous key: any one live
+        # contact reveals the rest of the advertised swarm, so a stale
+        # or partial --initial-peers list heals on join
+        known = set(initial_peers)
+        for addr in discover(dht, self.peer_cfg.experiment_prefix):
+            if addr not in known:
+                dht.bootstrap(addr)
         logger.info("swarm node up: peer_id=%s addr=%s",
                     dht.peer_id[:16], dht.visible_address)
         return dht

@@ -228,12 +228,17 @@ def train_loop(task: TrainingTask,
         # flight when the loop exits: apply it rather than lose the
         # epoch's averaging (shutdown() would discard it)
         if collab.finalize():
-            reports.append(EpochReport(
-                epoch=collab.local_epoch,
-                loss=loss_sum / max(mini_steps, 1),
-                mini_steps=mini_steps,
-                samples_per_second=(
-                    collab.tracker.performance_ema.samples_per_second)))
+            if mini_steps > 0:
+                # with zero grad steps since the last report (the round
+                # launched in the same call that reconciled its
+                # predecessor), there is no honest loss to attach — the
+                # apply still happened, only the report is skipped
+                reports.append(EpochReport(
+                    epoch=collab.local_epoch,
+                    loss=loss_sum / mini_steps,
+                    mini_steps=mini_steps,
+                    samples_per_second=(
+                        collab.tracker.performance_ema.samples_per_second)))
             if ckpt is not None and params_are_finite(collab.state.params):
                 ckpt.save_backup(collab.state, collab.local_epoch)
     finally:

@@ -53,6 +53,13 @@ ROWS = {
     # levers combined
     "hoist_ctx_m6": (dict(param_cast_hoist=True, remat_policy="save_ctx",
                           remat_skip_blocks=0), [(6, 42)]),
+    # round-2 follow-ups after save_attn/micro4 won the first grid pass
+    "hoist_attn_m4": (dict(param_cast_hoist=True,
+                           remat_policy="save_attn"), [(4, 64)]),
+    "attn_m4_skip0": (dict(remat_policy="save_attn",
+                           remat_skip_blocks=0), [(4, 64)]),
+    "attn_m4_skip2": (dict(remat_policy="save_attn",
+                           remat_skip_blocks=2), [(4, 64)]),
 }
 
 
