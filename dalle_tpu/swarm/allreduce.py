@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import hashlib
+import os
 import struct
 import threading as _threading
 import time
@@ -74,6 +75,15 @@ _PREFIX_LEN = _HDR.size + PK_LEN + SIG_LEN
 #: Multiple of the u8 codec's 256-element block so chunk boundaries do not
 #: change the quantization math.
 CHUNK_ELEMS = 1 << 22
+
+
+def _pool_workers(cap: int) -> int:
+    """Worker count for the codec/send/decode pools, bounded by HOST
+    parallelism: the pipelining exists to overlap codec with wire, but
+    on a small host extra threads only add scheduler thrash — measured
+    on the 1-core bench box, 16 threads/peer REGRESSED the flagship
+    N=4 epoch wall 40->66 s vs sizing the pools to the core count."""
+    return max(1, min(cap, os.cpu_count() or 1))
 
 
 def _sign_ctx(prefix: str, epoch: int, phase: str,
@@ -246,9 +256,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         wire_body = maybe_encrypt(gkey, body)
         return addr, tag, wire_body, send_raw(addr, tag, wire_body)
 
-    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool, \
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=_pool_workers(8)) as pool, \
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=4) as dec_pool:
+                max_workers=_pool_workers(4)) as dec_pool:
         futures = []
         scatter_to = list(enumerate(owners)) if weight > 0 else []
         for k, owner in scatter_to:
@@ -383,11 +394,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
 
     t_gather = time.monotonic()
     send_lock = _threading.Lock()
-    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool, \
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=_pool_workers(8)) as pool, \
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=4) as codec_pool, \
+                max_workers=_pool_workers(4)) as codec_pool, \
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=4) as dec_pool:
+                max_workers=_pool_workers(4)) as dec_pool:
         futures = []
         sends = []
         produce_futs = []

@@ -226,8 +226,14 @@ def train_loop(task: TrainingTask,
                 loss_sum, mini_steps = 0.0, 0
         # an overlapped round (delay_optimizer_step) may still be in
         # flight when the loop exits: apply it rather than lose the
-        # epoch's averaging (shutdown() would discard it)
-        if collab.finalize():
+        # epoch's averaging (shutdown() would discard it) — EXCEPT when
+        # the epoch budget is already spent (the same-call relaunch can
+        # leave a round for epoch max_epochs+1 pending; applying it
+        # would overshoot the caller's contract)
+        if (max_epochs is not None
+                and collab.local_epoch >= max_epochs):
+            collab.drop_pending_round()
+        elif collab.finalize():
             if mini_steps > 0:
                 # with zero grad steps since the last report (the round
                 # launched in the same call that reconciled its

@@ -9,9 +9,14 @@ line per global step: the loss curve, step-time variance, NaN/rollback
 count and checkpoint cadence the reference's operators read off their
 wandb dashboards (SURVEY.md section 4).
 
-Run:  python scripts/sustained_run.py [minutes] [out_prefix]
-Artifacts: SUSTAINED_RUN.jsonl (per-step log) + SUSTAINED_RUN.json
-(driver-readable summary line).
+Run:  python scripts/sustained_run.py [minutes] [out_prefix] \
+          [data_dir] [tokenizer_path] [warmup_steps] [total_steps]
+(data_dir/tokenizer_path: prepared shards through the production
+CodesDataset — pair with ``prepare_data synthetic-shards --structured``
+for the learning-proof run; warmup/total size the LR schedule to the
+run length instead of the reference's 31250-step production schedule.)
+Artifacts: {prefix}.jsonl (per-step log) + {prefix}.json (driver-readable
+summary line).
 """
 
 import json
@@ -30,6 +35,13 @@ def main():
     # for the learning-proof run, VERDICT r4 next #4)
     data_dir = sys.argv[3] if len(sys.argv) > 3 else None
     tokenizer_path = sys.argv[4] if len(sys.argv) > 4 else None
+    # LR schedule sized to the RUN, not to the reference's 31250-step
+    # production schedule: a 55-minute run lives entirely inside the
+    # 3125-step warmup (lr <= 5e-5 throughout — the r4 runs' loss could
+    # not move decisively regardless of the data). Defaults keep the r4
+    # production schedule; the learning-proof run passes ~[20, 300].
+    warmup_steps = int(sys.argv[5]) if len(sys.argv) > 5 else 3125
+    total_steps = int(sys.argv[6]) if len(sys.argv) > 6 else 31250
 
     import jax
 
@@ -54,7 +66,10 @@ def main():
                           matchmaking_time=3.0, average_state_every=0)
     # a solo FULL peer: swarm of one, every epoch takes the ALONE path
     # (LAMB apply + sweep + checkpoints all run; no wire traffic)
-    task = TrainingTask(model, OptimizerConfig(), trainer, collab,
+    task = TrainingTask(model,
+                        OptimizerConfig(warmup_steps=warmup_steps,
+                                        total_steps=total_steps),
+                        trainer, collab,
                         PeerConfig(), data_path=data_dir,
                         tokenizer_path=tokenizer_path)
 
@@ -151,6 +166,8 @@ def main():
         "checkpoints": ckpts,
         "log": log_path,
         "data": data_dir or "synthetic-affine (in-memory)",
+        "lr_schedule": {"warmup_steps": warmup_steps,
+                        "total_steps": total_steps},
         # overlapped-round telemetry: epochs whose swarm round ran on the
         # background thread, the wall they hid, and the grad steps that
         # executed during those windows (VERDICT r4 next #1's artifact)
