@@ -12,14 +12,17 @@ driver-readable artifact (XL_STEP.json):
   hold its state" sizing note in config.py referred to practical
   training with headroom; this proves the memory plan's arithmetic.
 - backend == cpu  -> the SHARDED path at the true XL width (dim 1792,
-  28 heads — the axes fsdp/tp actually split), one 2-virtual-device run
-  per axis (fsdp=2, then tp=2), with depth/sequence reduced (and
-  recorded in the artifact): depth 5 keeps the full unique-parameter
-  set (4 shared blocks + w_conv), seq 32 keeps text+image segments.
-  Shard shapes scale linearly in depth/seq, so the per-device memory
-  plan extrapolates directly. (4+ virtual devices at this size trip
-  XLA:CPU's hard 40 s collective-rendezvous limit on a one-core host:
-  waiters SPIN, and crossed fsdp x tp subgroup collectives livelock.)
+  28 heads — the axes fsdp/tp actually split), with depth/sequence
+  reduced (and recorded in the artifact): depth 5 keeps the full
+  unique-parameter set (4 shared blocks + w_conv). Three runs: one
+  2-virtual-device run per axis (fsdp=2, then tp=2; seq 32 keeps
+  text+image segments) and — r5 — the COMBINED fsdp=2 x tp=2 mesh on 4
+  virtual devices. The combined mesh's crossed subgroup collectives
+  must clear XLA:CPU's spinning collective rendezvous between OS
+  preemptions on the one-core host: at seq 32 they die inside it, at
+  seq 12 (text 8, image grid 2) they pass with near-stall warnings
+  that all resolve. Shard shapes scale linearly in depth/seq, so the
+  per-device memory plan extrapolates directly.
 
 Run:  python scripts/xl_step.py            (TPU via the axon tunnel)
       JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
@@ -61,20 +64,16 @@ def run(out_path="XL_STEP.json", cpu_axis="fsdp"):
         mesh_desc = f"dp={jax.local_device_count()} (single chip)"
     else:
         # f32 activations: CPU bf16 is emulated (~10x slower). Sharded
-        # execution on the 1-core host must respect XLA:CPU's hard 40 s
-        # collective-rendezvous limit with SPINNING waiters: a crossed
-        # pair of subgroup collectives (fsdp x tp on 4 devices) livelocks
-        # the core, so each axis is proven in its own 2-device run
-        # (cpu_axis = "fsdp" then "tp"). depth 5 = the 4 shared blocks +
-        # w_conv (the full unique-parameter set at full dim 1792 / 28
-        # heads); seq 32 keeps both text and image segments present.
-        # the combined 4-device mesh quarters each device's compute but
-        # CROSSES fsdp x tp subgroup collectives on the 1-core host; at
-        # the 2-device shape (text 16 / grid 4) it dies inside XLA:CPU's
-        # spinning collective rendezvous — the sequence is halved again
-        # so each collective fits between OS preemptions. Full dim 1792 /
-        # 28 heads / the 5-uid parameter set are preserved either way
-        # (the axes fsdp and tp actually split).
+        # execution on the 1-core host must respect XLA:CPU's spinning
+        # collective rendezvous: per-axis proofs run 2 devices each at
+        # seq 32; the combined fsdp x tp mesh (4 devices, crossed
+        # subgroup collectives) needs seq 12 to clear the rendezvous
+        # between OS preemptions (see the shape override below). depth 5
+        # = the 4 shared blocks + w_conv (the full unique-parameter set
+        # at full dim 1792 / 28 heads).
+        # combined-mesh shape: text 8 + image 2x2 = seq 12 (vs the
+        # per-axis runs' text 16 + 4x4 = seq 32, which the crossed
+        # collectives cannot survive — see the docstring)
         seq_kw = (dict(text_seq_len=8, image_grid=2)
                   if cpu_axis == "fsdp_tp"
                   else dict(text_seq_len=16, image_grid=4))
