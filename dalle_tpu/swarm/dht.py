@@ -527,6 +527,12 @@ class DHT:
         return peers
 
     def shutdown(self) -> None:
+        """Destroy the native node. ORDERING CONTRACT: anything that may
+        still be calling into this DHT from another thread — a
+        CollaborativeOptimizer's overlapped round worker, a StateServer,
+        an AveragingAssistant, a RendezvousAdvertiser — must be shut
+        down FIRST (``task.shutdown()`` does this); a call into a
+        destroyed node is a native use-after-free."""
         if self._node:
             self._lib.swarm_node_destroy(self._node)
             self._node = None

@@ -73,6 +73,7 @@ print(json.dumps({"pid": pid, "epoch": opt.local_epoch,
                   "w0": float(w.flat[0]),
                   "digest": __import__("hashlib").sha256(
                       w.tobytes()).hexdigest()}))
+opt.shutdown()  # drain any background round BEFORE the native node dies
 if dht is not None:
     dht.shutdown()
 """
@@ -187,6 +188,7 @@ print(json.dumps({"pid": pid, "epoch": opt.local_epoch, "steps": steps,
                   "w0": float(w.flat[0]), "b0": float(b.flat[0]),
                   "digest": __import__("hashlib").sha256(
                       w.tobytes() + b.tobytes()).hexdigest()}))
+opt.shutdown()  # drain any background round BEFORE the native node dies
 if dht is not None:
     dht.shutdown()
 """
@@ -239,6 +241,10 @@ w = np.asarray(opt.state.params["w"])
 b = np.asarray(opt.state.params["b"])
 print(json.dumps({"pid": "peer", "epoch": opt.local_epoch, "steps": steps,
                   "w0": float(w.flat[0]), "b0": float(b.flat[0])}))
+# overlapped rounds (delay_optimizer_step) may still be on the wire:
+# the optimizer MUST shut down before the native DHT node is destroyed
+# (task.shutdown's ordering) or the round thread touches freed memory
+opt.shutdown()
 dht.shutdown()
 """
 
