@@ -109,10 +109,13 @@ def main() -> None:
         # (config.FLAGSHIP_TUNED: remat_skip_blocks=1, head_chunk=2048,
         # scan_unroll=2) — the fallback rungs must explicitly drop the
         # partial remat, which COSTS memory (the fallbacks exist because
-        # memory ran out). accum 64 amortizes the LAMB apply further and
-        # matches a realistic per-peer share of the swarm's 4096-sample
-        # epoch (measured: 11.18 img/s at accum 64 vs 10.86 at 32).
+        # memory ran out). accum 128 (512 samples/peer/epoch — an 8-peer
+        # share of the swarm's 4096-sample epoch) amortizes the LAMB
+        # apply further: under blanket remat accum 64->128 plateaued
+        # (r3: 11.184 vs 11.178), but at the r5 save_attn+hoist config
+        # it measured 11.735 vs 11.599 (PERF_GRID.json).
         for micro, accum, overrides in (
+                (4, 128, {}),
                 (4, 64, {}),
                 (4, 32, {}),
                 (8, 16, {"remat_skip_blocks": 0}),

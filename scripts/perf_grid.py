@@ -60,6 +60,11 @@ ROWS = {
                            remat_skip_blocks=0), [(4, 64)]),
     "attn_m4_skip2": (dict(remat_policy="save_attn",
                            remat_skip_blocks=2), [(4, 64)]),
+    # round-3 follow-ups: the two cells adjacent to the shipped winner
+    "hoist_attn_m6_skip1": (dict(param_cast_hoist=True,
+                                 remat_policy="save_attn"), [(6, 42)]),
+    "hoist_attn_m4_a128": (dict(param_cast_hoist=True,
+                                remat_policy="save_attn"), [(4, 128)]),
 }
 
 
