@@ -306,6 +306,28 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     return None
                 return _parse(raw, group, my_chunks, my_ctx)
 
+            def apply_reduce(parsed) -> bool:
+                nonlocal acc, total_w
+                if parsed is None:
+                    return False
+                sender, w, ci, data = parsed
+                if sender not in expected:
+                    return False  # duplicate or already-complete sender
+                if sender not in bufs:
+                    bufs[sender] = np.zeros(n_mine, np.float32)
+                    got[sender] = set()
+                if ci in got[sender]:
+                    return False  # duplicate chunk
+                clo, chi = my_chunks[ci]
+                bufs[sender][clo:chi] = data
+                got[sender].add(ci)
+                if len(got[sender]) == len(my_chunks):
+                    acc += bufs.pop(sender) * w
+                    got.pop(sender)
+                    total_w += w
+                    expected.discard(sender)
+                return True
+
             decoding: List[concurrent.futures.Future] = []
             last_progress = time.monotonic()
             while expected:
@@ -320,26 +342,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     if not f.done():
                         still.append(f)
                         continue
-                    parsed = f.result()
-                    if parsed is None:
-                        continue
-                    sender, w, ci, data = parsed
-                    if sender not in expected:
-                        continue  # duplicate or already-complete sender
-                    if sender not in bufs:
-                        bufs[sender] = np.zeros(n_mine, np.float32)
-                        got[sender] = set()
-                    if ci in got[sender]:
-                        continue  # duplicate chunk
-                    clo, chi = my_chunks[ci]
-                    bufs[sender][clo:chi] = data
-                    got[sender].add(ci)
-                    if len(got[sender]) == len(my_chunks):
-                        acc += bufs.pop(sender) * w
-                        got.pop(sender)
-                        total_w += w
-                        expected.discard(sender)
-                    last_progress = time.monotonic()
+                    if apply_reduce(f.result()):
+                        last_progress = time.monotonic()
                 decoding = still
                 if not expected:
                     break
@@ -347,6 +351,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     0.2, max(0.05, reduce_deadline - now)))
                 if raw is not None:
                     decoding.append(dec_pool.submit(decode_reduce, raw))
+            # chunks already received (and possibly mid-decode) when the
+            # deadline fired still count: dropping them would discard a
+            # fully-delivered sender's whole buffered contribution. The
+            # grace is bounded — decodes are ms-scale CPU work.
+            if decoding and expected:
+                concurrent.futures.wait(decoding, timeout=2.0)
+                for f in decoding:
+                    if f.done():
+                        apply_reduce(f.result())
             if expected and report is not None:
                 report["complete"] = False
             if report is not None:
@@ -500,6 +513,23 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     return None
                 return part, parsed
 
+            def apply_gather(res) -> bool:
+                if res is None:
+                    return False
+                part, (_s, _w, ci, data) = res
+                if part not in pending or ci not in pending[part]:
+                    return False  # duplicate chunk / completed part
+                # NB: fresh names — produce_gather's codec threads read
+                # the enclosing lo/clo/chi lazily; rebinding them here
+                # would corrupt the local-apply offsets (r5 bug)
+                plo, _phi = slices[part]
+                pclo, pchi = part_chunks[part][ci]
+                out[plo + pclo:plo + pchi] = data
+                pending[part].discard(ci)
+                if not pending[part]:
+                    del pending[part]
+                return True
+
             decoding: List[concurrent.futures.Future] = []
             last_progress = max(time.monotonic(), gather_baseline)
             while pending:
@@ -513,22 +543,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     if not f.done():
                         still.append(f)
                         continue
-                    res = f.result()
-                    if res is None:
-                        continue
-                    part, (_s, _w, ci, data) = res
-                    if part not in pending or ci not in pending[part]:
-                        continue  # duplicate chunk / completed part
-                    # NB: fresh names — produce_gather's codec threads read
-                    # the enclosing lo/clo/chi lazily; rebinding them here
-                    # would corrupt the local-apply offsets (r5 bug)
-                    plo, _phi = slices[part]
-                    pclo, pchi = part_chunks[part][ci]
-                    out[plo + pclo:plo + pchi] = data
-                    pending[part].discard(ci)
-                    if not pending[part]:
-                        del pending[part]
-                    last_progress = time.monotonic()
+                    if apply_gather(f.result()):
+                        last_progress = time.monotonic()
                 decoding = still
                 if not pending:
                     break
@@ -536,6 +552,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     0.2, max(0.05, deadline - now)))
                 if raw is not None:
                     decoding.append(dec_pool.submit(decode_gather, raw))
+            # drain decodes still in flight at the deadline — the chunks
+            # were already delivered; losing them would regress the
+            # round's completeness for wire-level no reason
+            if decoding and pending:
+                concurrent.futures.wait(decoding, timeout=2.0)
+                for f in decoding:
+                    if f.done():
+                        apply_gather(f.result())
             # chunks never received keep this peer's local values (owner
             # died mid-round): degraded but well-defined
             if pending and report is not None:

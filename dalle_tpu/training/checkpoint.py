@@ -134,8 +134,14 @@ class _AsyncWriter:
     At most one write per kind ('ckpt'/'backup') is queued behind the one
     in flight; a newer request of the same kind replaces the queued one
     (latest-wins — intermediate backups are droppable by design, exactly
-    like the reference aux peer's upload cadence). Write errors are logged
-    and surfaced via ``last_error``; training never dies on a checkpoint.
+    like the reference aux peer's upload cadence; a superseded NUMBERED
+    checkpoint is logged at WARNING because its returned path will never
+    materialize). Memory bound: up to THREE device snapshots can be
+    alive at once (one in flight + one queued per kind) when writes are
+    slower than both save cadences — ~2.2 GB of stale flagship state
+    worst-case; at the production cadence (backup every 5 epochs, ckpt
+    every 10) the common case is one. Write errors are logged and
+    surfaced via ``last_error``; training never dies on a checkpoint.
     """
 
     def __init__(self) -> None:
@@ -158,8 +164,13 @@ class _AsyncWriter:
         with self._lock:
             for i, (k, _f, lbl) in enumerate(self._queued):
                 if k == kind:
-                    logger.info("checkpoint writer busy: superseding "
-                                "queued %s with %s", lbl, label)
+                    # a dropped numbered checkpoint breaks save()'s
+                    # returned-path promise — make the supersession loud
+                    log = (logger.warning if kind == "ckpt"
+                           else logger.info)
+                    log("checkpoint writer busy: superseding queued "
+                        "%s with %s (the superseded file will never "
+                        "be written)", lbl, label)
                     del self._queued[i]
                     break
             self._queued.append((kind, fn, label))
@@ -167,7 +178,8 @@ class _AsyncWriter:
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every queued/in-flight write has landed."""
-        deadline = (time.monotonic() + timeout) if timeout else None
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
         with self._lock:
             while self._queued or self._in_flight:
                 remaining = None
@@ -245,7 +257,12 @@ class CheckpointManager:
         """Numbered checkpoint; ``backup=True`` also refreshes the rolling
         backup from the same serialized bytes (the state is device_get +
         packed exactly once). Async by default: returns immediately with
-        the destination path; call :meth:`flush` to wait for the bytes."""
+        the DESTINATION path — the bytes land later, and under a write
+        backlog a still-queued save can be superseded by a newer one
+        (logged at WARNING; the superseded path then never exists).
+        Callers that act on the returned path (upload, stat) must
+        :meth:`flush` first or construct the manager with
+        ``async_writes=False`` (as the aux peer does)."""
         path = self._ckpt_path(epoch)
         if self._writer is not None:
             state = _device_snapshot(state)  # donation-proof (see writer)
