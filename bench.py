@@ -114,6 +114,7 @@ def main() -> None:
         # apply further: under blanket remat accum 64->128 plateaued
         # (r3: 11.184 vs 11.178), but at the r5 save_attn+hoist config
         # it measured 11.735 vs 11.599 (PERF_GRID.json).
+        regime_rows = {}
         for micro, accum, overrides in (
                 (4, 128, {}),
                 (4, 64, {}),
@@ -127,6 +128,19 @@ def main() -> None:
                 ips = _bench(cfg, micro, accum, warmup=1, iters=3)
                 result = ("dalle-1.3b train images/sec/chip (tpu)", ips,
                           ips / BASELINE_IMAGES_PER_SEC_PER_CHIP)
+                regime_rows[f"accum{accum}"] = round(ips, 3)
+                # Pin the bench regime (VERDICT r5 weak #6: the r4->r5
+                # headline mixed an accum 64->128 change into the code
+                # delta): when the headline lands at accum 128, also
+                # measure the SAME code at accum 64 so round-over-round
+                # comparisons have a regime-matched row on both sides.
+                if accum == 128:
+                    try:
+                        regime_rows["accum64"] = round(
+                            _bench(cfg, micro, 64, warmup=1, iters=3), 3)
+                    except Exception as e:  # noqa: BLE001 - OOM only
+                        if not _is_oom(e):
+                            raise
                 break
             except Exception as e:  # noqa: BLE001 - re-raised unless OOM
                 if not _is_oom(e):
@@ -143,14 +157,20 @@ def main() -> None:
         ips = _bench(cfg, per_chip_micro=8, accum=1, warmup=1, iters=3)
         result = (f"dalle-tiny train images/sec/chip ({backend} fallback)",
                   ips, 0.0)
+        regime_rows = {}
 
     metric, value, vs = result
-    print(json.dumps({
+    row = {
         "metric": metric,
         "value": round(value, 3),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 4),
-    }))
+    }
+    if len(regime_rows) > 1:
+        # both accumulation regimes of the SAME code, so round-over-
+        # round deltas are regime-pinned (VERDICT r5 weak #6)
+        row["regime_rows"] = regime_rows
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

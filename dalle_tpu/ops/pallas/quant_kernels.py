@@ -97,3 +97,62 @@ def quantize_blockwise_pallas(x: jax.Array, block_size: int = 4096,
         interpret=interpret,
     )(blocks, thr)
     return codes[:n_blocks], absmax[:n_blocks]
+
+
+# -- linear (wire) u8 quantizer ------------------------------------------
+
+WIRE_QBLOCK = 256  # the wire codec's block (compression._QBLOCK) = 2 lanes
+
+
+def _wire_quant_kernel(x_ref, d_ref, codes_ref, scale_ref):
+    """Blockwise symmetric uniform u8 (the swarm wire codec): per 256-elem
+    block, scale = absmax/127, code = clip(rint(x/scale), -128, 127)+128.
+    All IEEE f32 elementwise VPU ops in the same order as the host numpy
+    and XLA paths (swarm/compression.py, swarm/device_codec.py), so the
+    three produce byte-identical codes and scales — including at
+    round-half-even ties. The 127 divisor arrives as a runtime scalar
+    (SMEM) so no compiler can strength-reduce the divide into a
+    reciprocal multiply (1 ulp off for ~3% of absmax values — enough to
+    flip wire bytes; see device_codec's parity note)."""
+    x = x_ref[:]                               # (rows, WIRE_QBLOCK) f32
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / d_ref[0]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.rint(x / safe), -128.0, 127.0) + 128.0
+    codes_ref[:] = q.astype(jnp.uint8)
+    scale_ref[:] = scale
+
+
+def wire_quantize_u8_pallas(x: jax.Array, interpret: bool = False):
+    """(codes uint8 (n,), scales f32 (ceil(n/256),)) in the swarm wire
+    format's block geometry — the device encode half of
+    swarm/device_codec.py, as a VPU kernel. The tail block is zero-padded
+    exactly like the host codec, so its scale and codes match."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    n_blocks = -(-n // WIRE_QBLOCK)
+    # pad rows up to a tile multiple (padded rows are all-zero: scale 0,
+    # codes 128, sliced off below)
+    rows = -(-n_blocks // ROWS_PER_TILE) * ROWS_PER_TILE
+    blocks = jnp.zeros((rows, WIRE_QBLOCK), jnp.float32).at[:n_blocks].set(
+        jnp.pad(flat, (0, n_blocks * WIRE_QBLOCK - n)).reshape(
+            n_blocks, WIRE_QBLOCK))
+    codes, scales = pl.pallas_call(
+        _wire_quant_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, WIRE_QBLOCK), jnp.uint8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        grid=(rows // ROWS_PER_TILE,),
+        in_specs=[
+            pl.BlockSpec((ROWS_PER_TILE, WIRE_QBLOCK), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((ROWS_PER_TILE, WIRE_QBLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_TILE, 1), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(blocks, jnp.full((1,), 127.0, jnp.float32))
+    return (codes[:n_blocks].reshape(-1)[:n],
+            scales[:n_blocks].reshape(-1))

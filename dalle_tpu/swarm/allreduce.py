@@ -169,7 +169,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   compression.SIZE_ADAPTIVE_THRESHOLD,
                   sender_timeout: Optional[float] = None,
                   report: Optional[dict] = None,
-                  chunk_elems: int = CHUNK_ELEMS) -> List[np.ndarray]:
+                  chunk_elems: int = CHUNK_ELEMS,
+                  codec_backend: str = compression.HOST_BACKEND
+                  ) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
     ``report`` (optional dict) receives ``{"complete": bool}``: True iff
@@ -189,18 +191,44 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     ``encrypt=True``), every chunk on the wire — pushes and mailbox posts
     alike — is AEAD-wrapped with it (crypto.py), so gradients are opaque to
     anyone outside the round's membership.
+
+    ``codec_backend="device"`` runs the u8/f16 wire codec as jitted
+    device programs (swarm/device_codec.py): ``tensors`` may be jax
+    device arrays (flattened on device, no per-leaf host pull), each
+    scatter/gather part is quantized in ONE device call with only the
+    packed u8/scale buffers crossing to the host, and receive-side
+    decodes dispatch to the device from the same decode pools — the
+    pipelined drain structure is identical to the host backend, and so
+    are the wire bytes (byte-compatible codecs, mixed-backend groups are
+    fine).
     """
     from dalle_tpu.swarm.crypto import maybe_decrypt, maybe_encrypt
     gkey = group.group_key
+    codec_mod = compression.backend_module(codec_backend)
+    use_device = codec_mod is not compression
+    device_codec = codec_mod if use_device else None
     phases: Dict[str, float] = {}
     if report is not None:
         report["complete"] = True  # falsified below on any missing chunk
         report["phases"] = phases  # wall time per protocol phase
-    t_flat = time.monotonic()
-    flat = flatten_tensors(tensors)
     owners = [m for m in group.members if m.addr]  # part owners
-    if group.size <= 1 or not owners or flat.size == 0:
+    total_elems = sum(int(np.prod(np.shape(t))) if np.shape(t) else 1
+                      for t in tensors)
+    if group.size <= 1 or not owners or total_elems == 0:
+        # degenerate round: nothing crosses the wire — skip the flatten
+        # (in device mode that would be a jitted concat plus a full
+        # payload device-to-host copy, for nothing)
         return [np.array(t, np.float32, copy=True) for t in tensors]
+    t_flat = time.monotonic()
+    if use_device:
+        # flatten on device; the one host copy below feeds the reduce
+        # accumulate and the gather fallback template (it must be
+        # writable — device pulls surface as read-only views)
+        flat_dev = device_codec.flatten_device(tensors)
+        flat = np.array(flat_dev, np.float32)
+    else:
+        flat_dev = None
+        flat = flatten_tensors(tensors)
 
     me = group.members[group.my_index]
     owner_index = {m.peer_id: k for k, m in enumerate(owners)}
@@ -235,6 +263,26 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     def fetch_chunk(addr: str, tag: int, timeout: float) -> Optional[bytes]:
         return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
+    # Device-codec parts: the whole part is quantized in ONE device call,
+    # shared lazily by its chunk producers (the first pool task to need
+    # it pays the dispatch, so part encodes overlap the wire exactly like
+    # per-chunk host encodes do). Only valid when chunk boundaries land
+    # on the u8 codec's 256-element blocks — CHUNK_ELEMS does; a caller
+    # with an unaligned chunk_elems falls back to per-chunk device
+    # encodes, which produce the same bytes at more dispatches.
+    part_aligned = chunk_elems % compression._QBLOCK == 0
+
+    def lazy_part_enc(src, lo: int, hi: int):
+        holder: dict = {}
+        lock = _threading.Lock()
+
+        def get():
+            with lock:
+                if "enc" not in holder:
+                    holder["enc"] = device_codec.encode_part(src, lo, hi)
+                return holder["enc"]
+        return get
+
     # --- scatter: my data for part k -> owner k, chunk by chunk ---------
     # weight-0 members (averaging assistants / 0-sample trainers) have
     # nothing to contribute: they send no scatter chunks.
@@ -244,14 +292,18 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     # reduce phase immediately instead of after serializing every encode
     # (VERDICT r4 weak #7: encode-serial rounds spent half their wall on
     # the codec). chunk_idx places each frame; order is irrelevant.
-    def produce_scatter(addr: str, tag: int, ctx: bytes, alo: int,
-                        ahi: int, ci: int, n_chunks: int
+    def produce_scatter(addr: str, tag: int, ctx: bytes, lo: int, clo: int,
+                        chi: int, ci: int, n_chunks: int, enc_get
                         ) -> Tuple[str, int, bytes, bool]:
-        piece = flat[alo:ahi]
-        c = part_codec(piece.size)
+        nelem = chi - clo
+        c = part_codec(nelem)
+        if enc_get is not None and c == compression.UNIFORM8BIT:
+            payload = device_codec.part_payload(enc_get(), clo, chi)
+        else:
+            src = flat_dev if use_device else flat
+            payload = codec_mod.compress(src[lo + clo:lo + chi], c)
         body = _make_frame(dht.identity, ctx, group.group_hash,
-                           group.my_index, weight, piece.size, c,
-                           compression.compress(piece, c),
+                           group.my_index, weight, nelem, c, payload,
                            chunk=ci, n_chunks=n_chunks)
         wire_body = maybe_encrypt(gkey, body)
         return addr, tag, wire_body, send_raw(addr, tag, wire_body)
@@ -269,10 +321,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             chunks = _chunk_slices(hi - lo, chunk_elems)
             ctx = _sign_ctx(prefix, epoch, "scatter", owner.peer_id)
             tag = _tag(prefix, epoch, "scatter", owner.peer_id)
+            enc_get = (lazy_part_enc(flat_dev, lo, hi)
+                       if use_device and part_aligned else None)
             for ci, (clo, chi) in enumerate(chunks):
                 futures.append(pool.submit(
                     produce_scatter, owner.addr, tag, ctx,
-                    lo + clo, lo + chi, ci, len(chunks)))
+                    lo, clo, chi, ci, len(chunks), enc_get))
         t_built = time.monotonic()
         phases["scatter_build_s"] = round(t_built - t0, 3)
 
@@ -301,10 +355,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             def decode_reduce(raw_enc: bytes):
                 # decrypt+verify+decompress off the receive thread: the
                 # wire read of chunk i+1 overlaps the decode of chunk i
+                # (device backend: the decompress dispatches to the
+                # accelerator from this same pool — the drain structure
+                # is backend-independent)
                 raw = maybe_decrypt(gkey, raw_enc)
                 if raw is None:
                     return None
-                return _parse(raw, group, my_chunks, my_ctx)
+                return _parse(raw, group, my_chunks, my_ctx, codec_mod)
 
             def apply_reduce(parsed) -> bool:
                 nonlocal acc, total_w
@@ -354,9 +411,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             # chunks already received (and possibly mid-decode) when the
             # deadline fired still count: dropping them would discard a
             # fully-delivered sender's whole buffered contribution. The
-            # grace is bounded — decodes are ms-scale CPU work.
+            # grace is bounded by the round's remaining overall budget —
+            # a flat grace here let a round overrun allreduce_timeout by
+            # up to ~4 s across the two drain points (ADVICE r5).
             if decoding and expected:
-                concurrent.futures.wait(decoding, timeout=2.0)
+                concurrent.futures.wait(decoding, timeout=max(
+                    0.0, min(2.0, deadline - time.monotonic())))
                 for f in decoding:
                     if f.done():
                         apply_reduce(f.result())
@@ -430,22 +490,37 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                        if m.peer_id != me.peer_id and m.addr
                        and m.weight > 0]
 
+            # device backend: the averaged part is quantized in one
+            # device call shared by its chunk producers, and the local
+            # apply reads the device dequantize of the same buffers
+            gather_enc_get = (lazy_part_enc(averaged_mine, 0,
+                                            averaged_mine.size)
+                              if use_device and part_aligned else None)
+
             def produce_gather(ci: int, clo: int, chi: int) -> None:
                 # compress + local-apply + sign + encrypt on a codec
                 # worker; the sends fan out through the send pool, so the
                 # codec of chunk i+1 overlaps the wire of chunk i AND the
                 # receive thread starts collecting other parts at once
-                piece = averaged_mine[clo:chi]
-                c = part_codec(piece.size)
-                wire = compression.compress(piece, c)
+                nelem = chi - clo
+                c = part_codec(nelem)
                 # apply the same lossy wire bytes locally so all members
                 # end the round with byte-identical values for this part
                 # (chunks write disjoint slices of out: thread-safe)
-                out[lo + clo:lo + chi] = compression.decompress(
-                    wire, c, piece.size)
+                if gather_enc_get is not None \
+                        and c == compression.UNIFORM8BIT:
+                    enc = gather_enc_get()
+                    wire = device_codec.part_payload(enc, clo, chi)
+                    out[lo + clo:lo + chi] = device_codec.part_decode(
+                        enc, clo, chi)
+                else:
+                    piece = averaged_mine[clo:chi]
+                    wire = codec_mod.compress(piece, c)
+                    out[lo + clo:lo + chi] = codec_mod.decompress(
+                        wire, c, nelem)
                 body = _make_frame(dht.identity, gather_ctx,
                                    group.group_hash, group.my_index, 1.0,
-                                   piece.size, c, wire,
+                                   nelem, c, wire,
                                    chunk=ci, n_chunks=len(my_chunks))
                 # the gather body is receiver-independent: encrypt ONCE
                 # per chunk, not once per recipient (the scatter path must
@@ -508,7 +583,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # stays with the authoritative dedup at apply time.
                 if part is None or part not in pending:
                     return None
-                parsed = _parse(raw, group, part_chunks[part], gather_ctx)
+                parsed = _parse(raw, group, part_chunks[part], gather_ctx,
+                                codec_mod)
                 if parsed is None:
                     return None
                 return part, parsed
@@ -552,11 +628,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     0.2, max(0.05, deadline - now)))
                 if raw is not None:
                     decoding.append(dec_pool.submit(decode_gather, raw))
-            # drain decodes still in flight at the deadline — the chunks
-            # were already delivered; losing them would regress the
-            # round's completeness for wire-level no reason
+            # salvage decodes that COMPLETED during the last recv poll —
+            # without waiting: this point is only reachable at the
+            # overall deadline (the no-progress break requires an empty
+            # decode queue), and the deadline is a promise to the caller
+            # (ADVICE r5: the old flat 2.0 s grace here let a round
+            # overrun allreduce_timeout). Chunks still mid-decode this
+            # late are dropped; the round reports incomplete and the
+            # parts keep local values — the normal degraded path.
             if decoding and pending:
-                concurrent.futures.wait(decoding, timeout=2.0)
                 for f in decoding:
                     if f.done():
                         apply_gather(f.result())
@@ -589,7 +669,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         if raw is None:
                             continue
                         parsed = _parse(raw, group, part_chunks[k],
-                                        gather_ctx)
+                                        gather_ctx, codec_mod)
                         if parsed is None:
                             continue
                         _, _, pci, data = parsed
@@ -640,14 +720,16 @@ def _peek(raw: bytes, group: AveragingGroup
 
 
 def _parse(raw: bytes, group: AveragingGroup,
-           chunks: List[Tuple[int, int]], ctx: bytes
+           chunks: List[Tuple[int, int]], ctx: bytes,
+           codec_mod=compression
            ) -> Optional[Tuple[int, float, int, np.ndarray]]:
     """-> (sender, weight, chunk_idx, decoded chunk) or None.
 
     ``chunks`` is the receiver-side chunking of the part this tag carries
     (both sides derive it from the part size, so chunk_idx and the chunk's
     element count must both agree — a frame chunked differently is
-    malformed and dropped)."""
+    malformed and dropped). ``codec_mod`` is the decompress backend
+    (compression or device_codec — identical wire semantics)."""
     head = _peek(raw, group)
     if head is None:
         return None
@@ -662,7 +744,7 @@ def _parse(raw: bytes, group: AveragingGroup,
         return None  # forged or replayed chunk: drop
     body = raw[_PREFIX_LEN:]
     try:
-        data = compression.decompress(body, codec, n)
+        data = codec_mod.decompress(body, codec, n)
     except (ValueError, struct.error):
         return None
     return sender, float(w), ci, data

@@ -87,11 +87,16 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
     if not any(m.weight > 0 for m in group.members):
         return "idle"  # a lobby of assistants has nothing to average
     report: dict = {}
+    # assistants honor the configured codec backend too: an aux host
+    # with an accelerator runs its (large) share of codec work there
+    from dalle_tpu.swarm.device_codec import resolve_backend
     run_allreduce(dht, group, f"{cfg.run_id}_grads", epoch, [template],
                   weight=0.0, allreduce_timeout=cfg.allreduce_timeout,
                   codec=codec,
                   adaptive_threshold=cfg.size_adaptive_threshold,
-                  report=report)
+                  report=report,
+                  codec_backend=resolve_backend(
+                      getattr(cfg, "wire_codec_backend", "auto")))
     return "assisted" if report.get("reduced_senders", 0) > 0 else "empty"
 
 

@@ -124,6 +124,35 @@ def decompress(buf: bytes, codec: int, n: int) -> np.ndarray:
     raise ValueError(f"unknown codec {codec}")
 
 
+# -- codec backend registry ---------------------------------------------
+# The same wire format has two execution backends: "host" (the numpy
+# functions above — any peer, no jax warmup) and "device" (jitted JAX
+# programs in swarm/device_codec.py — the codec runs where the gradients
+# live and only packed u8/scale buffers cross to the host). Both produce
+# byte-identical wire buffers; the backend is a per-peer execution choice,
+# never a protocol version.
+
+HOST_BACKEND = "host"
+DEVICE_BACKEND = "device"
+
+
+def backend_module(name: str):
+    """The module implementing codec backend ``name`` — each exposes the
+    same ``compress(x, codec) -> bytes`` / ``decompress(buf, codec, n)``
+    surface over the same wire bytes. Consumers (swarm/allreduce.py)
+    call through the returned module's attributes, so instrumentation
+    that patches them (scripts/swarm_payload_bench.py) keeps seeing
+    every call; ``device`` imports lazily so host-only peers never pay
+    the jax import."""
+    if name == HOST_BACKEND:
+        import dalle_tpu.swarm.compression as host_mod
+        return host_mod
+    if name == DEVICE_BACKEND:
+        from dalle_tpu.swarm import device_codec
+        return device_codec
+    raise ValueError(f"unknown codec backend {name!r}")
+
+
 def pack_array(x: np.ndarray, codec: int) -> bytes:
     """Self-describing frame: u8 codec, u32 n_elements, payload."""
     flat = np.asarray(x, np.float32).reshape(-1)

@@ -28,11 +28,20 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # hosts without the wheel: bundled RFC 7748/8439
+    # fallback — NOTE its AEAD is not wire-compatible with the real
+    # ChaCha20-Poly1305 (see _fallback_crypto docstring); mixed fleets
+    # need encrypt_data_plane=False
+    from dalle_tpu.swarm._fallback_crypto import (  # type: ignore
+        ChaCha20Poly1305, HKDF, X25519PrivateKey, X25519PublicKey, hashes,
+        serialization, warn_once)
+    warn_once()
 
 _NONCE = 12
 _EPK = 32

@@ -1,0 +1,268 @@
+"""Device-side wire codec: quantize/dequantize swarm gradients on the
+accelerator, leave the host to frame, sign, and ship bytes.
+
+VERDICT r5 weak #1: at the flagship's 502 MB gradient payload an N=4
+all-reduce epoch burned 20.1 s encoding + 13.8 s decoding in pure host
+numpy while the TPU idled. The codec math — blockwise symmetric u8
+quantization and f16 casts — is exactly the elementwise work accelerators
+exist for (EQuARX and 8-bit Optimizers both run the quantized-collective
+codec on the device, PAPERS.md), so this module runs it as jitted JAX
+programs: the quantize direction gets a Pallas VPU kernel on TPU
+(:func:`dalle_tpu.ops.pallas.quant_kernels.wire_quantize_u8_pallas`,
+same family as the existing dynamic-codebook kernel) with an XLA
+fallback everywhere else (CPU peers, CI), and the dequantize direction
+is a multiply XLA fuses fine on every backend.
+
+**Byte compatibility is the contract.** Every function here produces and
+consumes the *existing* wire format of :mod:`dalle_tpu.swarm.compression`
+— big-endian u32 element count, ceil(n/256) native-endian f32 scales,
+n u8 codes (code 128 = zero, scale = absmax/127) for UNIFORM8BIT;
+IEEE-f16 payloads for FLOAT16 — so device-codec peers interoperate on
+the wire with host-codec peers chunk by chunk. Parity is exact, not
+approximate: both sides use the same IEEE f32 divide / round-half-even /
+clip sequence on the same block geometry, so codes and scales agree
+byte-for-byte and f16 payloads are bit-identical
+(tests/test_device_codec.py pins both directions).
+
+**Whole-part encode.** :func:`encode_part` quantizes an entire all-reduce
+part in ONE device call and returns an :class:`EncodedPart` holding the
+packed u8/scale buffers (still on device — dispatch is async). Only those
+packed buffers ever cross to the host: :func:`part_payload` pulls them
+once and then frames each CHUNK_ELEMS wire chunk by pure byte slicing
+(chunk boundaries are multiples of the 256-element quant block, so the
+part-level blocks ARE the chunk-level blocks), and :func:`part_decode`
+dequantizes the part's own lossy bytes on device for the gather phase's
+local apply. The host never touches a float of codec math.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.swarm import compression
+
+_QBLOCK = compression._QBLOCK
+
+_F16_MIN = float(np.finfo(np.float16).min)
+_F16_MAX = float(np.finfo(np.float16).max)
+
+
+def resolve_backend(name: Optional[str]) -> str:
+    """Map a config value to a concrete codec backend. ``auto`` picks
+    ``device`` when this process drives an accelerator (the codec then
+    runs where the gradients already live) and ``host`` on CPU-only
+    peers, where jitted XLA still wins over numpy but a volunteer's
+    aux/client processes shouldn't pay jit warmup for it by default."""
+    if name in (None, "auto"):
+        return "device" if jax.default_backend() == "tpu" else "host"
+    if name not in ("host", "device"):
+        raise ValueError(f"unknown wire codec backend {name!r}")
+    return name
+
+
+# -- jitted codec programs (XLA path) ------------------------------------
+# Bit-parity note: the op sequence mirrors compression.compress_u8 /
+# decompress_u8 exactly — absmax, scale = absmax/127, safe = where(>0),
+# divide, rint (round-half-even), clip, +128 — all IEEE f32 elementwise,
+# so XLA, Pallas and numpy produce identical codes/scales for identical
+# input bytes. Do not "simplify" the order (e.g. folding /127 into the
+# divide): it changes rounding and breaks cross-peer wire parity.
+#
+# The 127 divisor is passed as a RUNTIME operand, never a literal: XLA's
+# simplifier strength-reduces divide-by-constant into multiply-by-
+# reciprocal, which is 1 ulp off the IEEE divide for ~3% of absmax
+# values — enough to flip wire scale bytes vs the host codec (caught by
+# the parity tests at n=2^16). A traced operand keeps the true divide.
+
+_D127: Optional[jax.Array] = None
+
+
+def _d127() -> jax.Array:
+    global _D127
+    if _D127 is None:
+        _D127 = jnp.asarray(np.float32(127.0))
+    return _D127
+
+
+@jax.jit
+def _enc_u8_xla_impl(flat: jax.Array, d127: jax.Array):
+    n = flat.shape[0]
+    n_blocks = -(-n // _QBLOCK)
+    blocks = jnp.pad(flat, (0, n_blocks * _QBLOCK - n)).reshape(
+        n_blocks, _QBLOCK)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = absmax / d127
+    safe = jnp.where(scales > 0, scales, 1.0)
+    q = jnp.clip(jnp.rint(blocks / safe[:, None]), -128.0, 127.0) + 128.0
+    return q.astype(jnp.uint8).reshape(-1)[:n], scales
+
+
+def _enc_u8_xla(flat: jax.Array):
+    return _enc_u8_xla_impl(flat, _d127())
+
+
+@jax.jit
+def _dec_u8(codes: jax.Array, scales: jax.Array) -> jax.Array:
+    n = codes.shape[0]
+    n_blocks = scales.shape[0]
+    c = jnp.pad(codes, (0, n_blocks * _QBLOCK - n)).astype(jnp.float32)
+    c = c - 128.0
+    out = c.reshape(n_blocks, _QBLOCK) * scales[:, None]
+    return out.reshape(-1)[:n]
+
+
+@jax.jit
+def _enc_f16(flat: jax.Array) -> jax.Array:
+    return jnp.clip(flat, _F16_MIN, _F16_MAX).astype(jnp.float16)
+
+
+@jax.jit
+def _dec_f16(h: jax.Array) -> jax.Array:
+    return h.astype(jnp.float32)
+
+
+@jax.jit
+def _concat_f32(leaves):
+    return jnp.concatenate([x.reshape(-1).astype(jnp.float32)
+                            for x in leaves])
+
+
+def _as_flat_f32(x) -> jax.Array:
+    if not isinstance(x, jax.Array):
+        x = jnp.asarray(np.asarray(x))
+    return x.reshape(-1).astype(jnp.float32)
+
+
+def _encode_u8(flat: jax.Array):
+    """(codes (n,) u8, scales (nblocks,) f32) — Pallas VPU kernel on TPU,
+    XLA elsewhere. Both derive from the same op sequence, so the choice
+    never changes wire bytes."""
+    if jax.default_backend() == "tpu" and flat.shape[0] > 0:
+        from dalle_tpu.ops.pallas.quant_kernels import \
+            wire_quantize_u8_pallas
+        return wire_quantize_u8_pallas(flat)
+    return _enc_u8_xla(flat)
+
+
+def flatten_device(tensors: Sequence) -> jax.Array:
+    """Device-side flatten_tensors: one jitted concat, no host pull.
+    Accepts a mix of device and host arrays (host leaves are pushed)."""
+    leaves = [jnp.asarray(np.asarray(t)) if not isinstance(t, jax.Array)
+              else t for t in tensors]
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return _concat_f32(leaves)
+
+
+# -- single-buffer wire codec (registry entries) -------------------------
+
+def compress(x, codec: int) -> bytes:
+    """Device twin of :func:`compression.compress`: same signature, same
+    bytes; ``x`` may be a device array (no host pull of the floats) or a
+    host array (pushed once)."""
+    if codec == compression.NONE:
+        return np.asarray(x, np.float32).tobytes()
+    flat = _as_flat_f32(x)
+    if codec == compression.FLOAT16:
+        return np.asarray(_enc_f16(flat)).tobytes()
+    if codec == compression.UNIFORM8BIT:
+        codes, scales = _encode_u8(flat)
+        codes_np, scales_np = jax.device_get((codes, scales))
+        return (struct.pack(">I", codes_np.size)
+                + scales_np.astype(np.float32, copy=False).tobytes()
+                + codes_np.tobytes())
+    raise ValueError(f"unknown codec {codec}")
+
+
+def decompress(buf: bytes, codec: int, n: int) -> np.ndarray:
+    """Device twin of :func:`compression.decompress`: parses the wire
+    header on the host, dequantizes on device, returns host f32."""
+    if codec == compression.NONE:
+        return np.frombuffer(buf, np.float32, count=n).copy()
+    if codec == compression.FLOAT16:
+        h = np.frombuffer(buf, np.float16, count=n)
+        return np.asarray(_dec_f16(jnp.asarray(h)))
+    if codec == compression.UNIFORM8BIT:
+        (n_hdr,) = struct.unpack(">I", buf[:4])
+        n_blocks = (n_hdr + _QBLOCK - 1) // _QBLOCK
+        scales = np.frombuffer(buf, np.float32, count=n_blocks, offset=4)
+        codes = np.frombuffer(buf, np.uint8, count=n_hdr,
+                              offset=4 + 4 * n_blocks)
+        out = np.asarray(_dec_u8(jnp.asarray(codes), jnp.asarray(scales)))
+        if out.size != n:
+            raise ValueError(f"decoded {out.size} elements, expected {n}")
+        return out
+    raise ValueError(f"unknown codec {codec}")
+
+
+# -- whole-part encode for the all-reduce hot path -----------------------
+
+class EncodedPart:
+    """A u8-quantized all-reduce part: packed device buffers from one
+    encode call, materialized to host AT MOST once (lock-guarded — chunk
+    producers race on it from the send pool), then framed per chunk by
+    byte slicing. ``decoded`` caches the device dequantize of the same
+    buffers for the gather phase's local apply, so the applied values are
+    exactly the wire bytes' values."""
+
+    def __init__(self, codes: jax.Array, scales: jax.Array, n: int):
+        self._codes_dev = codes
+        self._scales_dev = scales
+        self.n = n
+        self._lock = threading.Lock()
+        self._codes: Optional[np.ndarray] = None
+        self._scales: Optional[np.ndarray] = None
+        self._decoded: Optional[np.ndarray] = None
+
+    def _materialize(self) -> None:
+        with self._lock:
+            if self._codes is None:
+                self._codes, self._scales = jax.device_get(
+                    (self._codes_dev, self._scales_dev))
+
+    def _decode(self) -> np.ndarray:
+        with self._lock:
+            if self._decoded is None:
+                self._decoded = np.asarray(
+                    _dec_u8(self._codes_dev, self._scales_dev))
+            return self._decoded
+
+
+def encode_part(src, lo: int, hi: int) -> "EncodedPart":
+    """Quantize ``src[lo:hi]`` blockwise-u8 in ONE device call (async
+    dispatch — returns immediately with the device buffers in flight).
+    ``src`` is the device-flattened gradient vector; a host array works
+    too (pushed once, e.g. the gather phase's host-accumulated part)."""
+    piece = _as_flat_f32(src[lo:hi])
+    codes, scales = _encode_u8(piece)
+    return EncodedPart(codes, scales, hi - lo)
+
+
+def part_payload(enc: EncodedPart, clo: int, chi: int) -> bytes:
+    """Wire payload of the chunk ``[clo, chi)`` of an encoded part —
+    byte-identical to ``compression.compress(part[clo:chi], UNIFORM8BIT)``
+    provided ``clo`` is a multiple of the 256-element quant block (the
+    caller guarantees it: CHUNK_ELEMS is). Pure byte slicing after the
+    one-time materialize."""
+    assert clo % _QBLOCK == 0, "chunk start must align to the quant block"
+    enc._materialize()
+    b_lo = clo // _QBLOCK
+    b_hi = (chi + _QBLOCK - 1) // _QBLOCK
+    return (struct.pack(">I", chi - clo)
+            + enc._scales[b_lo:b_hi].tobytes()
+            + enc._codes[clo:chi].tobytes())
+
+
+def part_decode(enc: EncodedPart, clo: int, chi: int) -> np.ndarray:
+    """The dequantized values of chunk ``[clo, chi)`` — the same lossy
+    values every receiver of :func:`part_payload`'s bytes decodes, for
+    the part owner's local apply. One device dequantize per part, then
+    host views."""
+    return enc._decode()[clo:chi]

@@ -150,13 +150,28 @@ class CollaborativeOptimizer:
             self.tracker = _FollowerTracker()
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
+        # Wire-codec execution backend (swarm/device_codec.py): "device"
+        # quantizes/dequantizes on the accelerator and keeps gradient
+        # leaves on device until the codec consumes them; the wire bytes
+        # are identical either way. Resolved once — the backend is a
+        # property of this process's hardware, not of the round.
+        from dalle_tpu.swarm.device_codec import resolve_backend
+        self._codec_backend = resolve_backend(
+            getattr(cfg, "wire_codec_backend", "auto"))
+        # device-array handoff is only valid when every leaf lives whole
+        # on this process (multi-process slices pull via the collective
+        # host_global path regardless of codec backend)
+        self._device_grad_handoff = (
+            self._codec_backend == compression.DEVICE_BACKEND
+            and jax.process_count() == 1)
         if cfg.grad_compression == "power_sgd":
             # rank-r low-rank factor exchange (swarm/powersgd.py); the
             # factors themselves ride the wire as fp16
             from dalle_tpu.swarm.powersgd import PowerSGDCompressor
             self._powersgd = PowerSGDCompressor(
                 cfg.powersgd_rank,
-                host_orthogonalize=cfg.powersgd_host_orthogonalize)
+                host_orthogonalize=cfg.powersgd_host_orthogonalize,
+                keep_factors_on_device=self._device_grad_handoff)
             self._grad_codec = compression.FLOAT16
         else:
             self._powersgd = None
@@ -368,15 +383,25 @@ class CollaborativeOptimizer:
                         epoch=pending.epoch)
                 else:
                     t_pull = time.monotonic()
-                    grads_local = [np.asarray(g) / pending.weight
-                                   for g in pending.leaves]
+                    if self._device_grad_handoff:
+                        # hand device arrays to the codec: the divide,
+                        # flatten and quantize all run on device; the
+                        # round's one bulk host copy (reduce accumulate
+                        # + gather template) lands in allreduce's
+                        # flatten phase instead of per-leaf pulls here
+                        grads_local = [g / pending.weight
+                                       for g in pending.leaves]
+                    else:
+                        grads_local = [np.asarray(g) / pending.weight
+                                       for g in pending.leaves]
                     pending.timings["grad_pull_s"] = round(
                         time.monotonic() - t_pull, 4)
                     averaged = run_allreduce(
                         self.dht, group, f"{self.cfg.run_id}_grads",
                         pending.epoch, grads_local, weight=pending.weight,
                         allreduce_timeout=budget, codec=self._grad_codec,
-                        adaptive_threshold=self.cfg.size_adaptive_threshold)
+                        adaptive_threshold=self.cfg.size_adaptive_threshold,
+                        codec_backend=self._codec_backend)
                 pending.result = averaged
                 pending.timings["allreduce_s"] = round(
                     time.monotonic() - t_match, 4)
@@ -514,9 +539,15 @@ class CollaborativeOptimizer:
             broadcast_decision(mode)
         pull_s = t_pull - t0
         if exchanging:
-            if grads_local is None:  # deferred pull: the wire needs host
-                t_lazy = time.monotonic()
-                grads_local = [a / weight for a in host_global(leaves)]
+            if grads_local is None:  # deferred pull: the wire needs the
+                t_lazy = time.monotonic()  # grads outside the accumulator
+                if self._device_grad_handoff:
+                    # device codec: the grads stay device arrays — the
+                    # round flattens and quantizes them there (its one
+                    # bulk host copy shows up in its flatten phase)
+                    grads_local = [g / weight for g in leaves]
+                else:
+                    grads_local = [a / weight for a in host_global(leaves)]
                 pull_s += time.monotonic() - t_lazy  # keep attribution
             budget = min(self.cfg.allreduce_timeout,
                          max(1.0, self.cfg.averaging_timeout
@@ -533,7 +564,8 @@ class CollaborativeOptimizer:
                     self.dht, group, f"{self.cfg.run_id}_grads",
                     self.local_epoch, grads_local, weight=weight,
                     allreduce_timeout=budget, codec=self._grad_codec,
-                    adaptive_threshold=self.cfg.size_adaptive_threshold)
+                    adaptive_threshold=self.cfg.size_adaptive_threshold,
+                    codec_backend=self._codec_backend)
         else:
             # alone this epoch: with a deferred pull the grads never left
             # the device — they flow straight into the jitted apply
@@ -621,7 +653,7 @@ class CollaborativeOptimizer:
                     allreduce_timeout=budget / 2,
                     codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
-                    report=rep)
+                    report=rep, codec_backend=self._codec_backend)
                 if not rep.get("complete", False):
                     ok = 0
             if sharded:
@@ -742,7 +774,8 @@ class CollaborativeOptimizer:
                     self.local_epoch, floats, weight=1.0,
                     allreduce_timeout=self.cfg.allreduce_timeout,
                     codec=self._state_codec,
-                    adaptive_threshold=self.cfg.size_adaptive_threshold)
+                    adaptive_threshold=self.cfg.size_adaptive_threshold,
+                    codec_backend=self._codec_backend)
         if not broadcast_decision(0 if averaged is None else 1):
             return
         if floats is None:  # follower of a slice whose coordinator averaged

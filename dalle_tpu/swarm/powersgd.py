@@ -192,11 +192,18 @@ class PowerSGDCompressor:
 
     def __init__(self, rank: int = 4, seed: int = 0,
                  min_ratio: float = MIN_COMPRESSION_RATIO,
-                 host_orthogonalize: bool = True):
+                 host_orthogonalize: bool = True,
+                 keep_factors_on_device: bool = False):
         self.rank = rank
         self.seed = seed
         self.min_ratio = min_ratio
         self.host_orthogonalize = host_orthogonalize
+        # Hand the P/Q factors to the wire as DEVICE arrays instead of
+        # host-pulling them (the device wire codec consumes them where
+        # they live — swarm/device_codec.py). Single-process peers only:
+        # on sharded slices host_global is the collective that makes the
+        # factors global, and it must keep running in lockstep.
+        self.keep_factors_on_device = keep_factors_on_device
         self._errors: Dict[int, jax.Array] = {}
         self._mat_cache: Dict[int, jax.Array] = {}
         self._p_orth: Dict[int, jax.Array] = {}
@@ -245,6 +252,8 @@ class PowerSGDCompressor:
         mats_e, ps = _dev_phase1(mats, errs, qs)
         for p, me in zip(plans, mats_e):
             self._mat_cache[p.index] = me
+        if self.keep_factors_on_device:
+            return list(ps)  # the device wire codec consumes them as-is
         # collective-safe host pull: on multi-host slices the factor
         # outputs inherit the gradients' cross-process sharding
         from dalle_tpu.parallel.multihost import host_global
@@ -267,6 +276,8 @@ class PowerSGDCompressor:
                                       [jnp.asarray(pa) for pa in host_ps])
         for p, po in zip(plans, p_orths):
             self._p_orth[p.index] = po
+        if self.keep_factors_on_device:
+            return list(qs)
         from dalle_tpu.parallel.multihost import host_global
         return host_global(qs)
 
@@ -330,9 +341,16 @@ def average_with_powersgd(
         ps = compressor.phase1_ps(leaves, plans, epoch)
         averaged_ps = reduce_fn(ps, "p") if ps else []
         qs = compressor.phase2_qs(plans, averaged_ps)
-        from dalle_tpu.parallel.multihost import host_global
-        raw = [a.astype(np.float32, copy=False) for a in host_global(
-            [leaves[i] for i in range(len(leaves)) if i not in planned])]
+        unplanned = [leaves[i] for i in range(len(leaves))
+                     if i not in planned]
+        if compressor.keep_factors_on_device:
+            # the raw tail rides the wire from wherever it lives — the
+            # device codec flattens/pushes as needed, no eager pull
+            raw = unplanned
+        else:
+            from dalle_tpu.parallel.multihost import host_global
+            raw = [a.astype(np.float32, copy=False)
+                   for a in host_global(unplanned)]
         averaged_tail = reduce_fn(qs + raw, "q") if (qs or raw) else []
     except IncompleteRound:
         compressor.abandon_round()
@@ -345,6 +363,7 @@ def average_with_powersgd(
     it = iter(averaged_raw)
     for i in range(len(out)):
         if i not in planned:
-            out[i] = np.asarray(next(it)).reshape(
-                np.asarray(leaves[i]).shape)
+            # np.shape avoids materializing device leaves just for
+            # their geometry
+            out[i] = np.asarray(next(it)).reshape(np.shape(leaves[i]))
     return out

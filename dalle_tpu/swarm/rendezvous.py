@@ -31,6 +31,9 @@ from dalle_tpu.swarm.dht import DHT, get_dht_time
 
 logger = logging.getLogger(__name__)
 
+#: one-time flag for the lockless-filesystem warning in publish()
+_FLOCK_WARNED = False
+
 #: rendezvous records expire like the reference's statistics records
 #: (arguments.py:129-131) so dead peers age out of discovery
 DEFAULT_TTL = 600.0
@@ -151,8 +154,20 @@ class RendezvousFile:
             try:
                 import fcntl
                 fcntl.flock(lockf, fcntl.LOCK_EX)
-            except (ImportError, OSError):
-                pass  # best-effort on filesystems without lock support
+            except (ImportError, OSError) as e:
+                # best-effort on filesystems without lock support — but
+                # say so ONCE: the unlocked read-modify-write can lose
+                # concurrent publishers' lines (ADVICE r5), and operators
+                # on e.g. NFS-without-lockd should know rendezvous may
+                # silently drop peers
+                global _FLOCK_WARNED
+                if not _FLOCK_WARNED:
+                    _FLOCK_WARNED = True
+                    logger.warning(
+                        "file lock unavailable for %s (%s): rendezvous "
+                        "publish falls back to unlocked read-modify-"
+                        "write; concurrent publishers may lose lines",
+                        self.path, e)
             now = time.time()
             lines = [(t, pid, a) for t, pid, a in self._read_lines()
                      if pid != peer_id and now - t <= self.max_age]

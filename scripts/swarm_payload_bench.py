@@ -9,7 +9,15 @@ signatures, ChaCha20-Poly1305 AEAD — and reports per-phase wall time
 against the reference's 60 s all-reduce budget (arguments.py:69-74).
 
 Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
-      python scripts/swarm_payload_bench.py [n_peers ...]
+      python scripts/swarm_payload_bench.py [n_peers ...] [assist] \
+          [--device-codec]
+
+``--device-codec`` runs every row through the device wire codec
+(swarm/device_codec.py, ``codec_backend="device"``): parts are
+quantized as jitted whole-part programs and only packed u8/scale
+buffers cross to the host — encode_s/decode_s then measure the host
+wall spent in the device codec hooks (dispatch + the one materialize
+pull per part) instead of numpy math.
 
 Prints one JSON line per configuration (driver-readable) plus the table
 SWARM_SCALE.md records. Note the VM has ONE host core: encode/decode of
@@ -72,10 +80,13 @@ class PhaseTimers:
         self._lock = threading.Lock()
 
     def patch(self):
-        from dalle_tpu.swarm import crypto
+        from dalle_tpu.swarm import crypto, device_codec
 
         orig_c, orig_d = compression.compress, compression.decompress
         orig_e, orig_x = crypto.maybe_encrypt, crypto.maybe_decrypt
+        dev_orig = (device_codec.compress, device_codec.decompress,
+                    device_codec.encode_part, device_codec.part_payload,
+                    device_codec.part_decode)
 
         def timed(orig, attr):
             def wrapper(*a, **kw):
@@ -91,12 +102,24 @@ class PhaseTimers:
         compression.decompress = timed(orig_d, "decode")
         crypto.maybe_encrypt = timed(orig_e, "aead")
         crypto.maybe_decrypt = timed(orig_x, "aead")
+        # device codec: encode = dispatch + the one materialize pull per
+        # part (inside the first part_payload call); decode = the jitted
+        # dequantize paths. Host wall spent in these hooks is the honest
+        # "what does the host still pay" number the A/B compares.
+        device_codec.compress = timed(dev_orig[0], "encode")
+        device_codec.decompress = timed(dev_orig[1], "decode")
+        device_codec.encode_part = timed(dev_orig[2], "encode")
+        device_codec.part_payload = timed(dev_orig[3], "encode")
+        device_codec.part_decode = timed(dev_orig[4], "decode")
         # allreduce imports `compression` as a module and crypto inside
         # the function body, so module-attr patching reaches it
 
         def restore():
             compression.compress, compression.decompress = orig_c, orig_d
             crypto.maybe_encrypt, crypto.maybe_decrypt = orig_e, orig_x
+            (device_codec.compress, device_codec.decompress,
+             device_codec.encode_part, device_codec.part_payload,
+             device_codec.part_decode) = dev_orig
         return restore
 
 
@@ -121,10 +144,12 @@ def run_threads(fns):
 
 
 def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
-                 budget: float = 60.0, n_assist: int = 0):
+                 budget: float = 60.0, n_assist: int = 0,
+                 codec_backend: str = "host"):
     """``n_assist`` weight-0 averaging assistants (swarm/assist.py) join
     the trainers' round as extra part owners at the full flagship
-    payload — the M44 mode at realistic scale."""
+    payload — the M44 mode at realistic scale. ``codec_backend="device"``
+    routes every peer's codec through the jitted device path."""
     n_all = n_peers + n_assist
     nodes = []
     for _ in range(n_all):
@@ -155,14 +180,15 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
             template = [np.zeros(total_elems, np.float32)]
             return run_allreduce(
                 nodes[i], groups[i], f"payload_{mode}", 0, template,
-                weight=0.0, allreduce_timeout=budget, report=reports[i])
+                weight=0.0, allreduce_timeout=budget, report=reports[i],
+                codec_backend=codec_backend)
         if mode == "power_sgd":
             def reduce_fn(tensors, phase):
                 rep = {}
                 out = run_allreduce(
                     nodes[i], groups[i], f"payload_{mode}_{phase}", 0,
                     tensors, weight=1.0, allreduce_timeout=budget / 2,
-                    report=rep)
+                    report=rep, codec_backend=codec_backend)
                 reports[i] = rep
                 if not rep.get("complete", False):
                     raise IncompleteRound(phase)
@@ -171,7 +197,8 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
                 compressors[i], arrays_per_peer[i], reduce_fn, epoch=0)
         out = run_allreduce(
             nodes[i], groups[i], f"payload_{mode}", 0, arrays_per_peer[i],
-            weight=1.0, allreduce_timeout=budget, report=reports[i])
+            weight=1.0, allreduce_timeout=budget, report=reports[i],
+            codec_backend=codec_backend)
         return out
 
     t0 = time.monotonic()
@@ -197,7 +224,8 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
     slowest = max((r.get("phases", {}) for r in reports[:n_peers]),
                   key=lambda p: sum(p.values()), default={})
     label = (f"{mode}, {n_peers} peers"
-             + (f" + {n_assist} assist" if n_assist else ""))
+             + (f" + {n_assist} assist" if n_assist else "")
+             + (", device codec" if codec_backend == "device" else ""))
     row = {
         "metric": f"swarm payload allreduce ({label})",
         "payload_mb_f32": round(mb, 1),
@@ -218,12 +246,15 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
 
 
 def main():
-    bad = [a for a in sys.argv[1:] if not a.isdigit() and a != "assist"]
+    device = "--device-codec" in sys.argv[1:]
+    args = [a for a in sys.argv[1:] if a != "--device-codec"]
+    bad = [a for a in args if not a.isdigit() and a != "assist"]
     if bad:
         raise SystemExit(f"unknown arguments: {bad} "
-                         "(expected peer counts and/or 'assist')")
-    peer_counts = [int(a) for a in sys.argv[1:]
-                   if a.isdigit()] or [2, 4]
+                         "(expected peer counts, 'assist' and/or "
+                         "'--device-codec')")
+    backend = "device" if device else "host"
+    peer_counts = [int(a) for a in args if a.isdigit()] or [2, 4]
     # the assist and power_sgd rows are fixed 2-trainer configs
     max_n = max(max(peer_counts), 2)
     print("# generating flagship-shaped gradient sets...", file=sys.stderr)
@@ -240,13 +271,16 @@ def main():
         # serializes all N peers on one core, so give N>2 a proportional
         # budget and report wall/N as the per-peer number a real host sees
         rows.append(bench_config(n, "size_adaptive", arrays[:n], total,
-                                 budget=60.0 * max(1, n // 2)))
-    if "assist" in sys.argv[1:]:
+                                 budget=60.0 * max(1, n // 2),
+                                 codec_backend=backend))
+    if "assist" in args:
         # M44 averaging-assist at the full flagship payload: 2 trainers
         # + 1 weight-0 assistant owning a third of the parts
         rows.append(bench_config(2, "size_adaptive", arrays[:2], total,
-                                 budget=90.0, n_assist=1))
-    rows.append(bench_config(2, "power_sgd", arrays[:2], total))
+                                 budget=90.0, n_assist=1,
+                                 codec_backend=backend))
+    rows.append(bench_config(2, "power_sgd", arrays[:2], total,
+                             codec_backend=backend))
 
     print("\n| mode | peers | payload | epoch | matchmake | encode | "
           "decode | aead |")

@@ -1,6 +1,8 @@
 """Checkpoint/backup/NaN-rollback/resume tests (reference callback.py
 semantics)."""
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -219,6 +221,35 @@ class TestAsyncWrites:
         mgr.save_backup(state, epoch=1)
         mgr.flush()  # returns; does not raise
         assert mgr.last_write_error is not None
+
+    def test_close_bounded_on_wedged_write(self, tmp_path, caplog):
+        """A wedged filesystem write must not block shutdown forever
+        (ADVICE r5): close() bounds its flush and abandons the backlog
+        with a warning."""
+        import threading
+        import time
+        mgr = CheckpointManager(str(tmp_path))
+        release = threading.Event()
+
+        def wedged():
+            release.wait(30)
+
+        mgr._writer.submit("backup", wedged, "wedged@1")
+        t0 = time.monotonic()
+        with caplog.at_level(logging.WARNING,
+                             logger="dalle_tpu.training.checkpoint"):
+            mgr.close(flush_timeout=0.3)
+        assert time.monotonic() - t0 < 5.0
+        assert any("did not drain" in r.message for r in caplog.records)
+        release.set()
+
+    def test_close_default_drains_cleanly(self, tmp_path):
+        _, _, _, state = _state()
+        mgr = CheckpointManager(str(tmp_path))
+        path = mgr.save(state, epoch=2)
+        mgr.close()  # default timeout: drains the queued write first
+        import os
+        assert os.path.exists(path)
 
 
 class TestLargeCheckpoint:

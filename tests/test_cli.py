@@ -15,6 +15,8 @@ import sys
 import time
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -102,6 +104,7 @@ def launch_aux(port: int, metrics_file: Path, ckpt_dir: Path,
 
 
 class TestTrainerCLI:
+    @pytest.mark.slow
     def test_swarm_cotrains_with_aux_monitor(self, tmp_path):
         """Two trainer processes co-train on localhost while an aux peer
         bootstraps the DHT, aggregates their signed metrics, and archives
@@ -203,6 +206,7 @@ class TestFleetCLI:
 
 
 class TestProfiler:
+    @pytest.mark.slow
     def test_profile_dir_gets_a_trace(self, tmp_path):
         """--profile-dir writes a JAX profiler trace during early steps
         (single-peer run, no swarm partner needed)."""

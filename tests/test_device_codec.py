@@ -1,0 +1,358 @@
+"""Device-side wire codec (swarm/device_codec.py): byte parity with the
+host codec in BOTH directions, checked-in wire-format goldens, the Pallas
+wire-quant kernel, the bundled crypto fallback's RFC vectors, and the
+device-backend butterfly all-reduce end-to-end on CPU (the CI face of the
+TPU path — same jitted programs, same pipelined decode drain)."""
+
+import logging
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.swarm import DHT, Identity, compression, device_codec
+from dalle_tpu.swarm.allreduce import flatten_tensors, run_allreduce
+from dalle_tpu.swarm.matchmaking import make_group
+
+U8 = compression.UNIFORM8BIT
+F16 = compression.FLOAT16
+
+
+def _payload(rng, n):
+    """Mixed-magnitude data exercising subnormal-adjacent scales, exact
+    zeros, and round-half-even ties inside one buffer."""
+    x = (rng.normal(size=n) * rng.choice([1e-6, 1.0, 100.0], size=n)
+         ).astype(np.float32)
+    x[: n // 3] = 0.0
+    return x
+
+
+class TestByteParity:
+    # sizes hit: single partial block, exact block, block+1 (padding
+    # tail), many blocks + tail (non-multiple-of-block-size), and the
+    # SizeAdaptive threshold neighborhood
+    SIZES = [1, 5, 255, 256, 257, 1000, 2 ** 16, 2 ** 16 + 7]
+
+    @pytest.mark.parametrize("n", SIZES)
+    @pytest.mark.parametrize("codec", [U8, F16, compression.NONE])
+    def test_encode_bytes_identical(self, n, codec):
+        x = _payload(np.random.default_rng(n), n)
+        assert device_codec.compress(x, codec) == \
+            compression.compress(x, codec)
+
+    @pytest.mark.parametrize("n", [255, 256, 257, 5000])
+    @pytest.mark.parametrize("codec", [U8, F16])
+    def test_cross_decode_both_directions(self, n, codec):
+        x = _payload(np.random.default_rng(n + 1), n)
+        host_buf = compression.compress(x, codec)
+        dev_buf = device_codec.compress(x, codec)
+        # device-encoded buffers decode with the host decompress...
+        np.testing.assert_array_equal(
+            compression.decompress(dev_buf, codec, n),
+            compression.decompress(host_buf, codec, n))
+        # ...and host-encoded buffers decode with the device decompress,
+        # to identical floats
+        np.testing.assert_array_equal(
+            device_codec.decompress(host_buf, codec, n),
+            compression.decompress(host_buf, codec, n))
+
+    def test_zero_block_and_all_zero(self):
+        z = np.zeros(600, np.float32)
+        assert device_codec.compress(z, U8) == compression.compress(z, U8)
+        np.testing.assert_array_equal(
+            device_codec.decompress(compression.compress(z, U8), U8, 600),
+            0.0)
+        # one zero block among live blocks (scale-0 safe-divide path)
+        x = _payload(np.random.default_rng(9), 1024)
+        x[256:512] = 0.0
+        assert device_codec.compress(x, U8) == compression.compress(x, U8)
+
+    def test_round_half_even_ties(self):
+        # absmax 127 -> scale exactly 1.0: integer+0.5 values are exact
+        # codebook midpoints, so any rounding-rule drift flips bytes
+        t = np.tile(np.array([0.5, 1.5, 2.5, -0.5, -1.5, 127.0, -127.0,
+                              63.5], np.float32), 64)
+        assert device_codec.compress(t, U8) == compression.compress(t, U8)
+
+    def test_device_array_input(self):
+        x = _payload(np.random.default_rng(3), 4096)
+        d = jnp.asarray(x)
+        for codec in (U8, F16):
+            assert device_codec.compress(d, codec) == \
+                compression.compress(x, codec)
+
+    def test_f16_bit_exact_roundtrip(self):
+        x = _payload(np.random.default_rng(4), 1000)
+        buf = device_codec.compress(x, F16)
+        assert buf == np.clip(x, np.finfo(np.float16).min,
+                              np.finfo(np.float16).max
+                              ).astype(np.float16).tobytes()
+
+    def test_bad_codec_and_short_buffer(self):
+        with pytest.raises(ValueError):
+            device_codec.compress(np.zeros(4, np.float32), 99)
+        with pytest.raises(ValueError):
+            device_codec.decompress(b"\x00\x00\x01\x00", U8, 256)
+
+
+class TestWireGolden:
+    """Checked-in tiny buffers: an accidental wire-format change (header
+    width, scale placement, block size, endianness) fails HERE first,
+    not in a cross-peer run."""
+
+    X = np.array([0.0, 0.5, -1.0, 127.0, -127.0, 63.5], np.float32)
+    GOLD_U8 = bytes.fromhex("000000060000803f80807fff01c0")
+    GOLD_F16 = bytes.fromhex("0000003800bcf057f0d7f053")
+    Y = np.array([3e-5, -2.5e-5, 1e-5, 0.0], np.float32)
+    GOLD_U8_SMALL = bytes.fromhex("00000004caa37d34ff16aa80")
+
+    @pytest.mark.parametrize("impl", [compression, device_codec])
+    def test_u8_golden(self, impl):
+        assert impl.compress(self.X, U8) == self.GOLD_U8
+        assert impl.compress(self.Y, U8) == self.GOLD_U8_SMALL
+
+    @pytest.mark.parametrize("impl", [compression, device_codec])
+    def test_f16_golden(self, impl):
+        assert impl.compress(self.X, F16) == self.GOLD_F16
+
+    @pytest.mark.parametrize("impl", [compression, device_codec])
+    def test_golden_decodes(self, impl):
+        got = impl.decompress(self.GOLD_U8[:], U8, 6)
+        # code 128+k decodes to exactly k * scale with scale 1.0 here
+        np.testing.assert_array_equal(
+            got, np.array([0, 0, -1, 127, -127, 64], np.float32))
+
+
+class TestEncodedPart:
+    """Whole-part device encode: chunk payload slicing and the local-
+    apply decode must match per-chunk host compression byte for byte."""
+
+    def test_chunk_payloads_match_host(self):
+        rng = np.random.default_rng(0)
+        flat = _payload(rng, 3000)
+        enc = device_codec.encode_part(jnp.asarray(flat), 100, 2900)
+        part = flat[100:2900]
+        chunks = [(0, 512), (512, 1024), (1024, 2560), (2560, 2800)]
+        for clo, chi in chunks:
+            assert device_codec.part_payload(enc, clo, chi) == \
+                compression.compress(part[clo:chi], U8)
+            np.testing.assert_array_equal(
+                device_codec.part_decode(enc, clo, chi),
+                compression.decompress(
+                    compression.compress(part[clo:chi], U8), U8,
+                    chi - clo))
+
+    def test_unaligned_chunk_start_rejected(self):
+        enc = device_codec.encode_part(jnp.zeros(1024, jnp.float32),
+                                       0, 1024)
+        with pytest.raises(AssertionError):
+            device_codec.part_payload(enc, 100, 612)
+
+    def test_host_source(self):
+        flat = _payload(np.random.default_rng(1), 700)
+        enc = device_codec.encode_part(flat, 0, 700)
+        assert device_codec.part_payload(enc, 0, 700) == \
+            compression.compress(flat, U8)
+
+
+class TestPallasWireKernel:
+    def test_matches_xla_exactly(self):
+        from dalle_tpu.ops.pallas.quant_kernels import \
+            wire_quantize_u8_pallas
+        x = jnp.asarray(_payload(np.random.default_rng(2), 10_007))
+        codes_p, scales_p = wire_quantize_u8_pallas(x, interpret=True)
+        codes_x, scales_x = device_codec._enc_u8_xla(x)
+        np.testing.assert_array_equal(np.asarray(codes_p),
+                                      np.asarray(codes_x))
+        np.testing.assert_array_equal(np.asarray(scales_p),
+                                      np.asarray(scales_x))
+
+
+class TestFallbackCrypto:
+    """The bundled pure-Python/numpy crypto fallback must match its RFCs
+    (8032/7748/8439) regardless of whether this host uses it."""
+
+    def test_rfc_vectors(self):
+        from dalle_tpu.swarm import _fallback_crypto
+        ok, what = _fallback_crypto.self_test()
+        assert ok, what
+
+    def test_pem_roundtrip_and_agreement(self):
+        from dalle_tpu.swarm import _fallback_crypto as fc
+        k = fc.Ed25519PrivateKey.from_private_bytes(b"\x07" * 32)
+        pem = k.private_bytes(fc.serialization.Encoding.PEM,
+                              fc.serialization.PrivateFormat.PKCS8,
+                              fc.serialization.NoEncryption())
+        k2 = fc.serialization.load_pem_private_key(pem, password=None)
+        msg = b"m" * 32
+        assert k2.sign(msg) == k.sign(msg)
+        a, b = fc.X25519PrivateKey.generate(), fc.X25519PrivateKey.generate()
+        assert a.exchange(b.public_key()) == b.exchange(a.public_key())
+
+
+def _loopback_swarm(n):
+    """Loopback DHT peers with DETERMINISTIC identities: the butterfly
+    assigns parts by peer-id sort order, and a part owner's own
+    contribution enters its part's average uncompressed (everyone else's
+    arrives codec-rounded) — so two rounds are value-comparable only
+    when the owner assignment matches."""
+    from dalle_tpu.swarm.identity import Ed25519PrivateKey
+    nodes = []
+    for i in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([61 + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=5.0))
+    return nodes
+
+
+def _run_round(nodes, groups, arrays_per_peer, backend, chunk_elems,
+               codec=None, prefix="dev"):
+    import threading
+    results, reports = [None] * len(nodes), [dict() for _ in nodes]
+    errs = []
+
+    def peer(i):
+        try:
+            results[i] = run_allreduce(
+                nodes[i], groups[i], prefix, 0, arrays_per_peer[i],
+                weight=1.0 + i, allreduce_timeout=30.0, codec=codec,
+                report=reports[i], chunk_elems=chunk_elems,
+                codec_backend=backend)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=peer, args=(i,))
+          for i in range(len(nodes))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return results, reports
+
+
+class TestAllreduceDeviceBackend:
+    """The jitted codec exercised end-to-end through allreduce.py on CPU:
+    device-encoded parts ride the same chunked wire, receive-side decodes
+    dispatch from the same decode pools, and the bytes (hence the
+    averaged values) are identical to the host backend's."""
+
+    def _tensors(self, seed, device=False):
+        rng = np.random.default_rng(seed)
+        arrs = [_payload(rng, 3000).reshape(50, 60),
+                _payload(rng, 700),
+                np.zeros(300, np.float32)]
+        if device:
+            return [jnp.asarray(a) for a in arrs]
+        return arrs
+
+    @pytest.mark.parametrize("chunk_elems,codec", [
+        (512, U8),     # aligned chunks, forced u8: the whole-part
+                       # EncodedPart path (part_payload + part_decode)
+        (512, None),   # aligned, SizeAdaptive (f16 at these sizes)
+        (300, U8),     # UNALIGNED chunks: the per-chunk device fallback
+    ])
+    def test_matches_host_backend(self, chunk_elems, codec):
+        # both backends must produce the same wire bytes, so a 2-peer
+        # round gives IDENTICAL averages under either backend
+        results = {}
+        for backend in ("host", "device"):
+            nodes = _loopback_swarm(2)
+            try:
+                import threading
+                gs = [None, None]
+
+                def mk(i):
+                    gs[i] = make_group(nodes[i], "g", 0, weight=1.0 + i,
+                                       matchmaking_time=2.0,
+                                       min_group_size=2, encrypt=True)
+                ts = [threading.Thread(target=mk, args=(i,))
+                      for i in range(2)]
+                [t.start() for t in ts]
+                [t.join() for t in ts]
+                assert all(g is not None and g.size == 2 for g in gs)
+                res, reps = _run_round(
+                    nodes, gs,
+                    [self._tensors(7, device=(backend == "device")),
+                     self._tensors(8)],
+                    backend, chunk_elems, codec=codec,
+                    prefix=f"p_{backend}_{chunk_elems}_{codec}")
+                assert all(r.get("complete") for r in reps)
+                results[backend] = res
+            finally:
+                for nd in nodes:
+                    nd.shutdown()
+        for r_host, r_dev in zip(results["host"], results["device"]):
+            for a, b in zip(r_host, r_dev):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+
+    def test_device_arrays_in_device_out_values(self):
+        # device-array handoff end to end; trainers end bit-identical
+        # and close to the true weighted mean
+        nodes = _loopback_swarm(3)
+        try:
+            import threading
+            gs = [None] * 3
+
+            def mk(i):
+                gs[i] = make_group(nodes[i], "g3", 0, weight=1.0 + i,
+                                   matchmaking_time=2.0,
+                                   min_group_size=3, encrypt=False)
+            ts = [threading.Thread(target=mk, args=(i,)) for i in range(3)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert all(g is not None and g.size == 3 for g in gs)
+            tensors = [self._tensors(20 + i, device=True)
+                       for i in range(3)]
+            res, reps = _run_round(nodes, gs, tensors, "device", 512,
+                                   prefix="p3")
+            assert all(r.get("complete") for r in reps)
+            flats = [flatten_tensors([np.asarray(x) for x in r])
+                     for r in res]
+            for f in flats[1:]:
+                np.testing.assert_array_equal(flats[0], f)
+            want = sum((1.0 + i) * flatten_tensors(
+                [np.asarray(x) for x in tensors[i]])
+                for i in range(3)) / sum(1.0 + i for i in range(3))
+            scale = np.abs(want).max() + 1e-9
+            assert np.abs(flats[0] - want).max() / scale < 0.02
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+
+@pytest.mark.slow
+def test_payload_scale_device_backend():
+    """Moderate-payload (32 MB f32/peer) device-backend round: the
+    EncodedPart path at multi-chunk scale with AEAD on — the tier-1-
+    excluded face of scripts/swarm_payload_bench.py --device-codec."""
+    rng = np.random.default_rng(0)
+    n = 8 << 20
+    arrays = [[(rng.normal(size=n) * 0.01).astype(np.float32)]
+              for _ in range(2)]
+    nodes = _loopback_swarm(2)
+    try:
+        import threading
+        gs = [None, None]
+
+        def mk(i):
+            gs[i] = make_group(nodes[i], "big", 0, weight=1.0,
+                               matchmaking_time=2.0, min_group_size=2,
+                               encrypt=True)
+        ts = [threading.Thread(target=mk, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert all(g is not None and g.size == 2 for g in gs)
+        res, reps = _run_round(nodes, gs, arrays, "device",
+                               1 << 20, prefix="big")
+        assert all(r.get("complete") for r in reps)
+        np.testing.assert_array_equal(np.asarray(res[0][0]),
+                                      np.asarray(res[1][0]))
+    finally:
+        for nd in nodes:
+            nd.shutdown()

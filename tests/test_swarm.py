@@ -601,6 +601,32 @@ class TestRendezvous:
         f.publish("peerC", "")
         assert len(f.fresh_peers()) == 2
 
+    def test_flock_failure_warns_once(self, tmp_path, caplog, monkeypatch):
+        """A lockless filesystem (flock -> OSError) must be loud ONCE:
+        the unlocked fallback can lose concurrent publishers' lines
+        (ADVICE r5), and silent data-plane surprises are how rendezvous
+        debugging sessions start."""
+        import fcntl
+        import logging
+
+        from dalle_tpu.swarm import rendezvous
+
+        def broken_flock(*a, **k):
+            raise OSError("no lockd on this mount")
+
+        monkeypatch.setattr(fcntl, "flock", broken_flock)
+        monkeypatch.setattr(rendezvous, "_FLOCK_WARNED", False)
+        f = rendezvous.RendezvousFile(str(tmp_path / "rdv.txt"))
+        with caplog.at_level(logging.WARNING,
+                             logger="dalle_tpu.swarm.rendezvous"):
+            f.publish("peerA", "127.0.0.1:1111")
+            f.publish("peerB", "127.0.0.1:2222")
+        warns = [r for r in caplog.records
+                 if "lock unavailable" in r.message]
+        assert len(warns) == 1  # once, not per publish
+        # the publishes themselves still landed
+        assert len(f.fresh_peers()) == 2
+
     def test_stale_entries_age_out(self, tmp_path):
         from dalle_tpu.swarm.rendezvous import RendezvousFile
 
