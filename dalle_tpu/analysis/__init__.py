@@ -1,0 +1,29 @@
+"""graftlint: AST-based JAX/concurrency hazard analysis for this repo.
+
+Stdlib-``ast`` only. Two rule families:
+
+- **jax**: host-sync-in-jit, python-rng-in-device, nondet-pytree,
+  literal-divisor-in-quant — invariants of traced device code whose
+  violation breaks determinism or the cross-peer wire byte-parity
+  contract (see LINTS.md for the incident history).
+- **concurrency**: silent-except, blocking-in-async, thread-daemon-join,
+  mixed-lock-writes — lifecycle and locking discipline for the swarm's
+  background-thread layer.
+
+Entry points: ``scripts/lint.py`` (CLI with ``--check``/baseline) and
+``tests/test_static_analysis.py`` (tier-1 enforcement). Inline
+suppression: ``# graftlint: disable=<rule>[,<rule>...]`` on the flagged
+line or the line above it.
+"""
+
+from dalle_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    analyze_paths,
+    analyze_source,
+    diff_baseline,
+    fingerprint_findings,
+    load_baseline,
+    save_baseline,
+)
+from dalle_tpu.analysis import concurrency_rules, jax_rules  # noqa: F401

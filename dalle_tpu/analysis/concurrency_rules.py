@@ -1,0 +1,283 @@
+"""graftlint concurrency rule family: hazards in the swarm's thread layer.
+
+The trainer interleaves ~13k LoC of jitted device code with background
+threads (round workers, state servers, checkpoint writers, advertisers).
+These rules encode the lifecycle and locking discipline that keeps that
+layer shut-downable and debuggable: threads must be daemonized or
+joined, shared attributes guarded by a lock must be guarded everywhere,
+blocking calls stay out of async code, and a broad ``except Exception``
+must never silently eat a wire/round failure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dalle_tpu.analysis.core import (Finding, FileContext, dotted_name,
+                                     rule)
+
+_BROAD_EXC = {"Exception", "BaseException"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "subprocess.getoutput", "subprocess.getstatusoutput",
+    "socket.create_connection", "socket.getaddrinfo",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+    "urllib.request.urlopen",
+}
+
+
+# -- silent-except --------------------------------------------------------
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        d = dotted_name(t)
+        return d is not None and d.split(".")[-1] in _BROAD_EXC
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, (ast.Name, ast.Attribute))
+                   and (dotted_name(e) or "").split(".")[-1] in _BROAD_EXC
+                   for e in t.elts)
+    return False
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Silent = the handler body neither raises nor calls anything (no
+    logging, no cleanup, no fallback construction) — the failure leaves
+    zero trace. pass/continue/constant-returns/plain assignments count
+    as silent."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call)):
+                return False
+    return True
+
+
+@rule(
+    "silent-except", "concurrency",
+    "Broad `except Exception`/bare except whose body neither logs,"
+    " raises, nor calls anything: wire and round failures vanish without"
+    " a trace. Log with context (logger.warning + exc_info) or narrow"
+    " the exception; parser/crypto contracts that legitimately map any"
+    " failure to None may carry a justified"
+    " `# graftlint: disable=silent-except`.")
+def silent_except(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad_handler(node) \
+                and _handler_is_silent(node):
+            out.append(ctx.finding(
+                "silent-except", node,
+                "broad exception handler swallows the failure silently "
+                "(no log, no raise, no call) — add a logger.warning with "
+                "context or a justified disable"))
+    return [f for f in out if f is not None]
+
+
+# -- blocking-in-async ----------------------------------------------------
+
+@rule(
+    "blocking-in-async", "concurrency",
+    "Synchronous blocking call (time.sleep, subprocess, sync"
+    " socket/HTTP) inside `async def`: it stalls the whole event loop,"
+    " not just this coroutine — use the asyncio equivalents or a thread"
+    " executor.")
+def blocking_in_async(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for sub in ast.walk(node):
+            # nested sync defs inside the coroutine are someone else's
+            # call site — only direct coroutine-body calls are flagged
+            if isinstance(sub, ast.Call):
+                callee = dotted_name(sub.func)
+                if callee in _BLOCKING_CALLS or (
+                        callee is not None
+                        and callee.startswith("subprocess.")):
+                    out.append(ctx.finding(
+                        "blocking-in-async", sub,
+                        f"{callee}() blocks the event loop inside an "
+                        "async def"))
+    return [f for f in out if f is not None]
+
+
+# -- thread-daemon-join ---------------------------------------------------
+
+def _thread_ctor(node: ast.Call) -> bool:
+    callee = dotted_name(node.func)
+    return callee in {"threading.Thread", "Thread"}
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _join_targets(tree: ast.AST) -> Set[str]:
+    """Dotted receivers of `.join(...)` calls anywhere in ``tree``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "join":
+            recv = dotted_name(node.func.value)
+            if recv is not None:
+                out.add(recv)
+    return out
+
+
+@rule(
+    "thread-daemon-join", "concurrency",
+    "threading.Thread created with neither `daemon=` nor a reachable"
+    " `.join()` on the stored handle: a forgotten non-daemon thread"
+    " blocks interpreter exit; an unjoined one leaks past shutdown."
+    " Thread subclasses must set daemon in __init__ (super().__init__"
+    " (daemon=...) or self.daemon = ...).")
+def thread_daemon_join(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    joined = _join_targets(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _thread_ctor(node):
+            if _has_kwarg(node, "daemon"):
+                continue
+            parent = ctx.parents.get(node)
+            target: Optional[str] = None
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                target = dotted_name(parent.targets[0])
+            elif isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+                target = dotted_name(parent.target)
+            if target is not None and target in joined:
+                continue
+            out.append(ctx.finding(
+                "thread-daemon-join", node,
+                "thread has neither daemon= nor a reachable .join() on "
+                "its handle — it can outlive shutdown and block "
+                "interpreter exit"))
+        elif isinstance(node, ast.ClassDef):
+            bases = {(dotted_name(b) or "").split(".")[-1]
+                     for b in node.bases}
+            if "Thread" not in bases:
+                continue
+            init = next((n for n in node.body
+                         if isinstance(n, ast.FunctionDef)
+                         and n.name == "__init__"), None)
+            if init is None:
+                continue  # default daemon flag is the instantiator's call
+            sets_daemon = False
+            for sub in ast.walk(init):
+                if isinstance(sub, ast.Call) and _has_kwarg(sub, "daemon"):
+                    sets_daemon = True
+                elif isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Attribute) and t.attr == "daemon"
+                        for t in sub.targets):
+                    sets_daemon = True
+            if not sets_daemon:
+                out.append(ctx.finding(
+                    "thread-daemon-join", node,
+                    f"Thread subclass {node.name} never sets daemon in "
+                    "__init__ — instances default to non-daemon and "
+                    "block interpreter exit unless every caller joins"))
+    return [f for f in out if f is not None]
+
+
+# -- mixed-lock-writes ----------------------------------------------------
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self-attributes assigned from threading.Lock/RLock/Condition
+    anywhere in the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee and callee.split(".")[-1] in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out.add(t.attr)
+    return out
+
+
+def _self_attr_writes(stmt: ast.stmt) -> Iterable[Tuple[str, ast.AST]]:
+    """(attr-name, node) for every `self.X = ...`-style write in stmt,
+    including tuple-unpack targets and augmented assignment."""
+    def targets_of(node: ast.AST) -> Iterable[ast.AST]:
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        return []
+
+    for node in ast.walk(stmt):
+        for t in targets_of(node):
+            stack = [t]
+            while stack:
+                cur = stack.pop()
+                if isinstance(cur, (ast.Tuple, ast.List)):
+                    stack.extend(cur.elts)
+                elif isinstance(cur, ast.Attribute) \
+                        and isinstance(cur.value, ast.Name) \
+                        and cur.value.id == "self":
+                    yield cur.attr, node
+
+
+@rule(
+    "mixed-lock-writes", "concurrency",
+    "A self-attribute written both inside and outside `with self.<lock>`"
+    " blocks of the same class (outside __init__): the unlocked write"
+    " races every locked reader/writer — the DeviceCodec._lock"
+    " discipline done inconsistently.")
+def mixed_lock_writes(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        lock_names = {f"self.{lk}" for lk in locks}
+        locked: Dict[str, List[ast.AST]] = {}
+        unlocked: Dict[str, List[ast.AST]] = {}
+
+        def scan(stmt: ast.stmt, in_lock: bool) -> None:
+            if isinstance(stmt, ast.With):
+                holds = any((dotted_name(item.context_expr) or "")
+                            in lock_names for item in stmt.items)
+                for s in stmt.body:
+                    scan(s, in_lock or holds)
+                return
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                for attr, node in _self_attr_writes(stmt):
+                    (locked if in_lock else unlocked).setdefault(
+                        attr, []).append(node)
+                return
+            for field in ("body", "orelse", "finalbody"):
+                for s in getattr(stmt, field, None) or []:
+                    scan(s, in_lock)
+            for handler in getattr(stmt, "handlers", None) or []:
+                for s in handler.body:
+                    scan(s, in_lock)
+
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and meth.name not in ("__init__", "__new__",
+                                          "__del__"):
+                for s in meth.body:
+                    scan(s, False)
+        for attr in sorted(set(locked) & set(unlocked)):
+            for node in unlocked[attr]:
+                out.append(ctx.finding(
+                    "mixed-lock-writes", node,
+                    f"self.{attr} is written under a lock elsewhere in "
+                    f"{cls.name} but written here without it — every "
+                    "write to a lock-guarded attribute must hold the "
+                    "lock"))
+    return [f for f in out if f is not None]

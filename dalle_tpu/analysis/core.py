@@ -1,0 +1,325 @@
+"""graftlint core: findings, suppressions, baselines, and the per-file
+analysis context rules run against.
+
+The analyzer is stdlib-``ast`` only — no third-party parser, no
+subprocess fan-out — so the tier-1 lint test stays in the low seconds on
+a 2-core box and the CLI works on peers that never installed a dev
+toolchain. Rules register themselves via :func:`rule`; each receives a
+:class:`FileContext` (parsed tree, raw lines, parent links, the file's
+*jit scopes*, and module-role classification) and yields
+:class:`Finding`\\ s.
+
+Why "jit scope" is a first-class concept: half the JAX rule family only
+makes sense inside code that XLA traces — ``float()`` on a traced value
+is a host sync, a wall-clock read is a trace-time constant, a literal
+divisor is fair game for the strength-reduction that broke wire parity
+in PR 1. A function is jit scope when it is decorated or wrapped by
+``jax.jit``/``pjit`` (including through ``functools.partial``), handed
+to ``pallas_call`` as the kernel, or nested inside such a function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+#: dotted-name leaves that compile their function argument / decoratee
+_JIT_LEAVES = {"jit", "pjit"}
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+#: modules whose code runs (or is traced into) device programs — the
+#: scope of the Python-RNG rule even outside explicit jit decoration
+_DEVICE_MODULE_PREFIXES = (
+    "dalle_tpu/ops/",
+    "dalle_tpu/models/",
+    "dalle_tpu/optim/",
+)
+_DEVICE_MODULES = {"dalle_tpu/training/steps.py"}
+
+#: quantize-path modules where a literal divisor can silently break the
+#: cross-peer byte-parity contract (PR 1: XLA folds divide-by-constant
+#: into multiply-by-reciprocal, 1 ulp off for ~3% of absmax values).
+#: swarm/compression.py is deliberately NOT here: it is host numpy,
+#: which always executes the true IEEE divide at runtime.
+_QUANT_MODULES = {
+    "dalle_tpu/ops/quant.py",
+    "dalle_tpu/ops/pallas/quant_kernels.py",
+    "dalle_tpu/swarm/device_codec.py",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    snippet: str       # stripped source line (the fingerprint anchor)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True when ``node`` (a decorator or a callee) jit-compiles its
+    function argument: ``jax.jit``, ``pjit``, ``partial(jax.jit, ...)``,
+    or a call of any of those (``jax.jit(static_argnums=...)``)."""
+    d = dotted_name(node)
+    if d is not None and d.split(".")[-1] in _JIT_LEAVES:
+        return True
+    if isinstance(node, ast.Call):
+        if _is_jit_expr(node.func):
+            return True
+        callee = dotted_name(node.func)
+        if (callee is not None and callee.split(".")[-1] == "partial"
+                and node.args):
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class FileContext:
+    """Everything a rule needs to know about one parsed source file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._jit_roots = self._find_jit_roots()
+        self._jit_nodes: Set[int] = set()
+        for root in self._jit_roots:
+            for n in ast.walk(root):
+                self._jit_nodes.add(id(n))
+        self._suppressions = self._parse_suppressions()
+
+    # -- module roles -----------------------------------------------------
+
+    @property
+    def is_device_module(self) -> bool:
+        return (self.path.startswith(_DEVICE_MODULE_PREFIXES)
+                or self.path in _DEVICE_MODULES)
+
+    @property
+    def is_quant_module(self) -> bool:
+        return self.path in _QUANT_MODULES or "quant" in os.path.basename(
+            self.path)
+
+    # -- jit scopes -------------------------------------------------------
+
+    def _find_jit_roots(self) -> List[ast.AST]:
+        """Function/lambda nodes whose bodies are traced by XLA."""
+        roots: List[ast.AST] = []
+        wrapped_names: Set[str] = set()
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                if any(_is_jit_expr(d) for d in node.decorator_list):
+                    roots.append(node)
+            elif isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                leaf = callee.split(".")[-1] if callee else None
+                takes_fn = (leaf in _JIT_LEAVES
+                            or leaf == "pallas_call"
+                            or _is_jit_expr(node.func))
+                if takes_fn and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Lambda):
+                        roots.append(arg)
+                    elif isinstance(arg, ast.Name):
+                        wrapped_names.add(arg.id)
+        for name in wrapped_names:
+            roots.extend(defs_by_name.get(name, ()))
+        return roots
+
+    def in_jit_scope(self, node: ast.AST) -> bool:
+        return id(node) in self._jit_nodes
+
+    def jit_roots(self) -> List[ast.AST]:
+        return list(self._jit_roots)
+
+    # -- suppression ------------------------------------------------------
+
+    def _parse_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                out[i] = rules
+        return out
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """A ``# graftlint: disable=<rule>`` directive suppresses the
+        line it sits on and the line directly below it (so a directive
+        can ride a comment line above a long statement)."""
+        for src_line in (line, line - 1):
+            rules = self._suppressions.get(src_line)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    # -- finding construction --------------------------------------------
+
+    def finding(self, rule: str, node: ast.AST, message: str
+                ) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        if self.suppressed(line, rule):
+            return None
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.path, line=line,
+                       message=message, snippet=snippet)
+
+
+# -- rule registry --------------------------------------------------------
+
+RuleFn = Callable[[FileContext], Iterable[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str        # "jax" | "concurrency"
+    doc: str
+    fn: RuleFn
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, family: str, doc: str):
+    def register(fn: RuleFn) -> RuleFn:
+        RULES[rule_id] = Rule(id=rule_id, family=family, doc=doc, fn=fn)
+        return fn
+    return register
+
+
+def _load_rules() -> None:
+    # import for side effect: rule registration
+    from dalle_tpu.analysis import concurrency_rules, jax_rules  # noqa: F401
+
+
+# -- analysis drivers -----------------------------------------------------
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run (a subset of) the rules over one source string. ``path``
+    drives the module-role classification, so fixtures can pretend to
+    live in a device/quant module."""
+    _load_rules()
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=path,
+                        line=e.lineno or 1,
+                        message=f"file does not parse: {e.msg}",
+                        snippet="")]
+    selected = ([RULES[r] for r in rules] if rules is not None
+                else list(RULES.values()))
+    findings: List[Finding] = []
+    for r in selected:
+        findings.extend(f for f in r.fn(ctx) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return sorted(set(out))
+
+
+def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
+                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Analyze every ``*.py`` under ``paths``; finding paths are made
+    relative to ``root`` (default: cwd) so baselines are machine-
+    independent."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root)
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(analyze_source(source, path=rel, rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- baseline -------------------------------------------------------------
+# A baseline entry pins (rule, path, snippet, occurrence-index) — NOT the
+# line number — so unrelated edits above a triaged finding don't churn
+# the file. The occurrence index disambiguates identical snippets in the
+# same file (e.g. two `continue`-bodied handlers).
+
+def fingerprint_findings(findings: Iterable[Finding]
+                         ) -> List[Tuple[Finding, str]]:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out: List[Tuple[Finding, str]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path, f.snippet)
+        idx = counts.get(key, 0)
+        counts[key] = idx + 1
+        digest = hashlib.sha256(
+            f"{f.rule}|{f.path}|{f.snippet}|{idx}".encode()).hexdigest()
+        out.append((f, digest[:16]))
+    return out
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "snippet": f.snippet, "fingerprint": fp}
+               for f, fp in fingerprint_findings(findings)]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=1)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"] for e in data.get("findings", ())}
+
+
+def diff_baseline(findings: Iterable[Finding], baseline: Set[str]
+                  ) -> Tuple[List[Finding], Set[str]]:
+    """-> (unbaselined findings, stale fingerprints no longer seen)."""
+    seen: Set[str] = set()
+    fresh: List[Finding] = []
+    for f, fp in fingerprint_findings(findings):
+        seen.add(fp)
+        if fp not in baseline:
+            fresh.append(f)
+    return fresh, baseline - seen

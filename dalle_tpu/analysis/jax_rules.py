@@ -1,0 +1,243 @@
+"""graftlint JAX rule family: hazards specific to traced device code.
+
+These rules exist because the swarm behaves like one giant synchronous
+trainer only while every peer's jitted hot path stays deterministic and
+byte-reproducible (PARITY.md, EQuARX in PAPERS.md). Each rule encodes an
+invariant this repo already fought for once — see LINTS.md for the
+incident history behind each one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from dalle_tpu.analysis.core import (Finding, FileContext, dotted_name,
+                                     rule)
+
+_HOST_PULL_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_PULL_METHODS = {"item", "tolist"}
+_ASARRAY_LEAVES = {"asarray", "array"}
+_NUMPY_MODULES = {"np", "numpy"}
+_CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
+                "time.perf_counter", "datetime.now",
+                "datetime.datetime.now", "datetime.utcnow"}
+_SEEDABLE_RNG_CTORS = {"RandomState", "default_rng", "Generator"}
+
+
+def _walk_jit_scope(root: ast.AST):
+    """(node, param-names-in-scope) for every node under a jit root.
+    Parameter names accumulate through nested defs/lambdas, so a traced
+    value threaded into an inner function is still recognized."""
+    def arg_names(node) -> Set[str]:
+        a = node.args
+        names = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def visit(node: ast.AST, params: Set[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not root:
+            params = params | arg_names(node)
+        elif node is root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            params = params | arg_names(node)
+        yield node, params
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, params)
+
+    yield from visit(root, set())
+
+
+@rule(
+    "host-sync-in-jit", "jax",
+    "Host synchronization inside a jitted/pallas scope: .item()/.tolist(),"
+    " float()/int()/bool() on a traced argument, np.asarray()/np.array()"
+    " on a traced argument, or jax.device_get(). Each one blocks the"
+    " async dispatch queue and drags device values through the host on"
+    " every call.")
+def host_sync_in_jit(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    for root in ctx.jit_roots():
+        for node, params in _walk_jit_scope(root):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            # .item() / .tolist() on anything traced
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_PULL_METHODS):
+                out.append(ctx.finding(
+                    "host-sync-in-jit", node,
+                    f".{node.func.attr}() inside a jitted scope forces a "
+                    "device sync per call"))
+                continue
+            # float(x) etc. where x is a (possibly nested) parameter
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_PULL_BUILTINS
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params):
+                out.append(ctx.finding(
+                    "host-sync-in-jit", node,
+                    f"{node.func.id}() on traced value "
+                    f"'{node.args[0].id}' inside a jitted scope is a "
+                    "host sync (use jnp casts instead)"))
+                continue
+            if callee is None:
+                continue
+            parts = callee.split(".")
+            # np.asarray(traced) pulls the buffer to host numpy
+            if (len(parts) == 2 and parts[0] in _NUMPY_MODULES
+                    and parts[1] in _ASARRAY_LEAVES and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params):
+                out.append(ctx.finding(
+                    "host-sync-in-jit", node,
+                    f"{callee}() on traced value '{node.args[0].id}' "
+                    "inside a jitted scope pulls the buffer to the host "
+                    "(use jnp.asarray)"))
+                continue
+            if parts[-1] == "device_get":
+                out.append(ctx.finding(
+                    "host-sync-in-jit", node,
+                    "jax.device_get() inside a jitted scope is a host "
+                    "sync"))
+    return [f for f in out if f is not None]
+
+
+def _rng_call_finding(ctx: FileContext, node: ast.Call, where: str
+                      ) -> Optional[Finding]:
+    callee = dotted_name(node.func)
+    if callee is None:
+        return None
+    parts = callee.split(".")
+    if len(parts) >= 2 and parts[0] in _NUMPY_MODULES \
+            and parts[1] == "random":
+        leaf = parts[-1]
+        if leaf in _SEEDABLE_RNG_CTORS and (node.args or node.keywords):
+            return None  # explicitly seeded generator: reproducible
+        return ctx.finding(
+            "python-rng-in-device", node,
+            f"{callee}() in {where}: unseeded host RNG diverges across "
+            "peers (seed a np.random.default_rng/RandomState, or use "
+            "jax.random)")
+    if parts[0] == "random" and len(parts) == 2:
+        return ctx.finding(
+            "python-rng-in-device", node,
+            f"{callee}() in {where}: stdlib RNG state is per-process and "
+            "unseeded — device code must use jax.random (or a seeded "
+            "numpy Generator)")
+    return None
+
+
+@rule(
+    "python-rng-in-device", "jax",
+    "Python/numpy RNG in device-code modules or jitted scopes. Traced"
+    " RNG calls bake a trace-time constant into the compiled program;"
+    " host RNG in device modules diverges across peers. Seeded"
+    " RandomState/default_rng constructions are allowed.")
+def python_rng_in_device(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    flagged: Set[int] = set()
+    for root in ctx.jit_roots():
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) and id(node) not in flagged:
+                f = _rng_call_finding(ctx, node, "a jitted scope")
+                if f is not None:
+                    flagged.add(id(node))
+                    out.append(f)
+    if ctx.is_device_module:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and id(node) not in flagged:
+                f = _rng_call_finding(ctx, node, "a device-code module")
+                if f is not None:
+                    flagged.add(id(node))
+                    out.append(f)
+    return [f for f in out if f is not None]
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        return callee in {"set", "frozenset"}
+    return False
+
+
+@rule(
+    "nondet-pytree", "jax",
+    "Nondeterminism feeding traced structure: wall-clock reads inside a"
+    " jitted scope become trace-time constants (and recompile triggers);"
+    " set iteration inside a jitted scope orders pytree leaves by hash"
+    " seed, which differs across peer processes.")
+def nondet_pytree(ctx: FileContext) -> Iterable[Finding]:
+    out: List[Optional[Finding]] = []
+    for root in ctx.jit_roots():
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee in _CLOCK_CALLS:
+                    out.append(ctx.finding(
+                        "nondet-pytree", node,
+                        f"{callee}() inside a jitted scope is frozen at "
+                        "trace time (pass timestamps in as operands)"))
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _is_set_expr(it):
+                    out.append(ctx.finding(
+                        "nondet-pytree", node,
+                        "iterating a set inside a jitted scope: iteration "
+                        "order follows the per-process hash seed, so the "
+                        "traced structure (pytree leaf order) can differ "
+                        "across peers — iterate a sorted() or a list"))
+    return [f for f in out if f is not None]
+
+
+@rule(
+    "literal-divisor-in-quant", "jax",
+    "Literal divisor in a quantize-path module. XLA strength-reduces"
+    " divide-by-constant into multiply-by-reciprocal (1 ulp off the IEEE"
+    " divide for ~3% of absmax values) — the PR-1 wire-parity incident."
+    " Divisors in quant paths must ride as runtime operands"
+    " (see device_codec._d127 / the SMEM scalar in quant_kernels).")
+def literal_divisor_in_quant(ctx: FileContext) -> Iterable[Finding]:
+    if not ctx.is_quant_module:
+        return []
+    out: List[Optional[Finding]] = []
+    msg = ("division by the literal {lit!r} in a quantize path: XLA can "
+           "fold it into a reciprocal multiply and break cross-peer byte "
+           "parity — pass the divisor as a runtime operand")
+
+    def is_num(node: ast.AST) -> bool:
+        return isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div) \
+                and is_num(node.right):
+            out.append(ctx.finding(
+                "literal-divisor-in-quant", node,
+                msg.format(lit=node.right.value)))
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.op, ast.Div) and is_num(node.value):
+            out.append(ctx.finding(
+                "literal-divisor-in-quant", node,
+                msg.format(lit=node.value.value)))
+        elif isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee and callee.split(".")[-1] in ("divide",
+                                                    "true_divide") \
+                    and len(node.args) >= 2 and is_num(node.args[1]):
+                out.append(ctx.finding(
+                    "literal-divisor-in-quant", node,
+                    msg.format(lit=node.args[1].value)))
+    return [f for f in out if f is not None]

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the AST hazard analyzer over the codebase.
+
+Usage:
+    python scripts/lint.py [paths...]           # report all findings
+    python scripts/lint.py --check              # exit 1 on unbaselined
+    python scripts/lint.py --write-baseline     # triage current findings
+    python scripts/lint.py --list-rules
+
+Default path is ``dalle_tpu/``; the baseline lives at
+``lint_baseline.json`` in the repo root (override with --baseline).
+``--check`` is the tier-1 face (tests/test_static_analysis.py runs the
+same comparison in-process) and a fast pre-test hook: it parses ~70
+files with stdlib ast only — ~1 s on a 2-core box, no subprocesses.
+
+Suppression: ``# graftlint: disable=<rule>`` on the flagged line or the
+line above. Baseline entries pin (rule, path, snippet, occurrence), not
+line numbers, so unrelated edits don't churn the file. See LINTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from dalle_tpu.analysis import (RULES, analyze_paths, diff_baseline,  # noqa: E402
+                                load_baseline, save_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="*",
+                        default=[os.path.join(_REPO, "dalle_tpu")],
+                        help="files/directories to analyze "
+                             "(default: dalle_tpu/)")
+    parser.add_argument("--baseline",
+                        default=os.path.join(_REPO, "lint_baseline.json"),
+                        help="baseline file (default: repo root "
+                             "lint_baseline.json)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero if any finding is not in "
+                             "the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to the "
+                             "baseline file (triage step)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        help="restrict to specific rule id(s)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{rid}  [{r.family}]\n    {r.doc.strip()}\n")
+        return 0
+
+    findings = analyze_paths(args.paths, root=_REPO, rules=args.rules)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    fresh, stale = diff_baseline(findings, baseline)
+
+    if args.check:
+        for f in fresh:
+            print(f.format())
+            print(f"    {f.snippet}")
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
+                  "— shrink the baseline with --write-baseline)")
+        if fresh:
+            print(f"\n{len(fresh)} unbaselined finding(s). Fix them, "
+                  "suppress with '# graftlint: disable=<rule>' + a "
+                  "justification, or triage with --write-baseline.")
+            return 1
+        print(f"lint clean: {len(findings)} finding(s), all baselined "
+              f"({len(baseline)} baseline entries)")
+        return 0
+
+    for f in findings:
+        mark = " (baselined)" if f not in fresh else ""
+        print(f.format() + mark)
+        print(f"    {f.snippet}")
+    print(f"\n{len(findings)} finding(s), {len(fresh)} unbaselined")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

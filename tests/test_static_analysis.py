@@ -1,0 +1,364 @@
+"""graftlint (dalle_tpu/analysis): per-rule positive/negative fixtures,
+suppression + baseline mechanics, and the tier-1 enforcement scan of the
+real codebase against lint_baseline.json.
+
+The fixtures are the rules' regression harness: every rule must catch
+its violating snippet AND stay quiet on the idiomatic equivalent, so a
+refactor of the analyzer cannot silently lobotomize a rule. The repo
+scan is the enforcement face: any new unbaselined finding fails tier-1.
+
+Everything here is stdlib-ast work over in-memory strings plus one parse
+pass of ~70 files — no subprocesses, no jax tracing — so the whole
+module runs in low single-digit seconds on the 2-core CI box.
+"""
+
+import os
+import time
+
+import pytest
+
+from dalle_tpu.analysis import (RULES, analyze_paths, analyze_source,
+                                diff_baseline, fingerprint_findings,
+                                load_baseline, save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (rule, fixture path, violating source, idiomatic source). The path
+# matters for module-role rules: device-module fixtures pretend to live
+# under dalle_tpu/ops/, quant fixtures in a quant module.
+FIXTURES = [
+    (
+        "host-sync-in-jit",
+        "dalle_tpu/fake.py",
+        """
+import jax
+@jax.jit
+def f(x):
+    return float(x) + x.item()
+""",
+        """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return x.astype(jnp.float32) + jnp.sum(x)
+def host_helper(x):
+    return float(x)  # not traced: fine
+""",
+    ),
+    (
+        "host-sync-in-jit",
+        "dalle_tpu/fake_pallas.py",
+        """
+from jax.experimental import pallas as pl
+def _kern(x_ref, o_ref):
+    o_ref[:] = x_ref[:].tolist()
+def call(x):
+    return pl.pallas_call(_kern, out_shape=None)(x)
+""",
+        """
+from jax.experimental import pallas as pl
+def _kern(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+def call(x):
+    return pl.pallas_call(_kern, out_shape=None)(x)
+""",
+    ),
+    (
+        "python-rng-in-device",
+        "dalle_tpu/ops/fake.py",
+        """
+import numpy as np
+def init_mask(shape):
+    return np.random.rand(*shape) > 0.5
+""",
+        """
+import numpy as np
+import jax
+def init_mask(key, seed, shape):
+    rng = np.random.default_rng(seed)      # seeded: reproducible
+    jmask = jax.random.bernoulli(key, 0.5, shape)
+    return rng, jmask
+""",
+    ),
+    (
+        "python-rng-in-device",
+        "dalle_tpu/fake.py",
+        """
+import jax, random
+@jax.jit
+def f(x):
+    return x * random.random()
+""",
+        """
+import jax
+@jax.jit
+def f(key, x):
+    return x * jax.random.uniform(key)
+""",
+    ),
+    (
+        "nondet-pytree",
+        "dalle_tpu/fake.py",
+        """
+import jax, time
+@jax.jit
+def f(x):
+    return x + time.time()
+""",
+        """
+import jax
+@jax.jit
+def f(x, now):
+    return x + now          # wall clock rides in as an operand
+""",
+    ),
+    (
+        "nondet-pytree",
+        "dalle_tpu/fake.py",
+        """
+import jax
+@jax.jit
+def f(tree):
+    return [tree[k] for k in {"w", "b"}]
+""",
+        """
+import jax
+@jax.jit
+def f(tree):
+    return [tree[k] for k in sorted(tree)]   # deterministic order
+""",
+    ),
+    (
+        "literal-divisor-in-quant",
+        "dalle_tpu/ops/pallas/fake_quant.py",
+        """
+import jax.numpy as jnp
+def encode(absmax):
+    scales = absmax / 127.0
+    return scales
+""",
+        """
+import jax.numpy as jnp
+def encode(absmax, d127):
+    scales = absmax / d127   # divisor rides as a runtime operand
+    return scales
+""",
+    ),
+    (
+        "silent-except",
+        "dalle_tpu/swarm/fake.py",
+        """
+def recv_round(sock):
+    try:
+        return sock.recv()
+    except Exception:
+        return None
+""",
+        """
+import logging
+logger = logging.getLogger(__name__)
+def recv_round(sock):
+    try:
+        return sock.recv()
+    except Exception:
+        logger.warning("round recv failed", exc_info=True)
+        return None
+def parse_port(s):
+    try:
+        return int(s)
+    except ValueError:       # narrow except: deliberate, passes
+        return None
+""",
+    ),
+    (
+        "blocking-in-async",
+        "dalle_tpu/fake.py",
+        """
+import time
+async def pump(queue):
+    time.sleep(0.5)
+    return await queue.get()
+""",
+        """
+import asyncio
+async def pump(queue):
+    await asyncio.sleep(0.5)
+    return await queue.get()
+""",
+    ),
+    (
+        "thread-daemon-join",
+        "dalle_tpu/fake.py",
+        """
+import threading
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+""",
+        """
+import threading
+class Owner:
+    def __init__(self, fn):
+        self._thread = threading.Thread(target=fn, daemon=True)
+    def start(self):
+        self._thread.start()
+    def stop(self):
+        self._thread.join(timeout=5.0)
+def spawn_joined(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+    return t
+""",
+    ),
+    (
+        "thread-daemon-join",
+        "dalle_tpu/fake_subclass.py",
+        """
+import threading
+class Worker(threading.Thread):
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+""",
+        """
+import threading
+class Worker(threading.Thread):
+    def __init__(self, fn):
+        super().__init__(daemon=True, name="worker")
+        self.fn = fn
+""",
+    ),
+    (
+        "mixed-lock-writes",
+        "dalle_tpu/fake.py",
+        """
+import threading
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        self.n = 0           # races inc()'s locked writes
+""",
+        """
+import threading
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0           # __init__ publishes before threads exist
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        with self._lock:
+            self.n = 0
+""",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,path,bad,good", FIXTURES,
+    ids=[f"{r}-{i}" for i, (r, *_rest) in enumerate(FIXTURES)])
+def test_rule_fixture(rule, path, bad, good):
+    hits = analyze_source(bad, path=path, rules=[rule])
+    assert hits, f"{rule} missed its violating fixture"
+    assert all(f.rule == rule for f in hits)
+    clean = analyze_source(good, path=path, rules=[rule])
+    assert clean == [], (
+        f"{rule} false-positived on idiomatic code: "
+        f"{[f.format() for f in clean]}")
+
+
+def test_every_rule_has_a_fixture():
+    covered = {r for r, *_rest in FIXTURES}
+    assert covered == set(RULES), (
+        "rules without fixtures rot silently: "
+        f"missing {set(RULES) - covered}")
+
+
+def test_inline_suppression_same_and_previous_line():
+    bad = """
+import threading
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+"""
+    assert analyze_source(bad, path="dalle_tpu/fake.py")
+    same = bad.replace(
+        "target=fn)", "target=fn)  # graftlint: disable=thread-daemon-join")
+    assert analyze_source(same, path="dalle_tpu/fake.py") == []
+    above = bad.replace(
+        "    t = threading.Thread",
+        "    # graftlint: disable=thread-daemon-join\n"
+        "    t = threading.Thread")
+    assert analyze_source(above, path="dalle_tpu/fake.py") == []
+    # a directive for a DIFFERENT rule must not suppress
+    wrong = bad.replace(
+        "target=fn)", "target=fn)  # graftlint: disable=silent-except")
+    assert analyze_source(wrong, path="dalle_tpu/fake.py")
+
+
+def test_baseline_roundtrip_and_occurrence_fingerprints(tmp_path):
+    src = """
+def a(x):
+    try:
+        return x()
+    except Exception:
+        return None
+def b(x):
+    try:
+        return x()
+    except Exception:
+        return None
+"""
+    findings = analyze_source(src, path="dalle_tpu/fake.py",
+                              rules=["silent-except"])
+    assert len(findings) == 2
+    # identical snippets get distinct occurrence-indexed fingerprints
+    fps = [fp for _f, fp in fingerprint_findings(findings)]
+    assert len(set(fps)) == 2
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, findings)
+    baseline = load_baseline(path)
+    fresh, stale = diff_baseline(findings, baseline)
+    assert fresh == [] and stale == set()
+    # fixing one finding leaves a stale entry, adds nothing fresh
+    fresh, stale = diff_baseline(findings[:1], baseline)
+    assert fresh == [] and len(stale) == 1
+    # a new finding in a different file is fresh
+    moved = analyze_source(src, path="dalle_tpu/other.py",
+                           rules=["silent-except"])
+    fresh, _ = diff_baseline(moved, baseline)
+    assert len(fresh) == 2
+
+
+def test_parse_error_is_reported_not_raised():
+    out = analyze_source("def broken(:\n", path="dalle_tpu/fake.py")
+    assert [f.rule for f in out] == ["parse-error"]
+
+
+def test_repo_scan_is_clean_against_baseline():
+    """The tier-1 enforcement face: dalle_tpu/ has zero unbaselined
+    findings. New hazards must be fixed, suppressed with a justified
+    inline disable, or consciously triaged into lint_baseline.json."""
+    t0 = time.monotonic()
+    findings = analyze_paths([os.path.join(REPO, "dalle_tpu")], root=REPO)
+    baseline = load_baseline(os.path.join(REPO, "lint_baseline.json"))
+    fresh, _stale = diff_baseline(findings, baseline)
+    elapsed = time.monotonic() - t0
+    assert not fresh, (
+        "unbaselined graftlint findings (fix, suppress with a justified "
+        "'# graftlint: disable=<rule>', or triage via scripts/lint.py "
+        "--write-baseline):\n"
+        + "\n".join(f"  {f.format()}\n      {f.snippet}" for f in fresh))
+    # parse-only over ~70 files; the 15 s bound is generous even for the
+    # 2-core box, and catches anyone wiring subprocess fan-out in here
+    assert elapsed < 15.0, f"lint scan took {elapsed:.1f}s"
+    assert not any(f.rule == "parse-error" for f in findings)
