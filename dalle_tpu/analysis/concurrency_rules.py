@@ -90,12 +90,23 @@ def silent_except(ctx: FileContext) -> Iterable[Finding]:
     " executor.")
 def blocking_in_async(ctx: FileContext) -> Iterable[Finding]:
     out: List[Optional[Finding]] = []
+
+    def walk_coroutine_body(node: ast.AST):
+        """Descend WITHOUT entering nested function definitions: a sync
+        def nested in the coroutine is someone else's call site (it may
+        run on an executor), and a nested async def is visited as its
+        own root by the outer loop — descending would double-report."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk_coroutine_body(child)
+
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.AsyncFunctionDef):
             continue
-        for sub in ast.walk(node):
-            # nested sync defs inside the coroutine are someone else's
-            # call site — only direct coroutine-body calls are flagged
+        for sub in walk_coroutine_body(node):
             if isinstance(sub, ast.Call):
                 callee = dotted_name(sub.func)
                 if callee in _BLOCKING_CALLS or (

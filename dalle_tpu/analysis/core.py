@@ -237,8 +237,15 @@ def analyze_source(source: str, path: str = "<string>",
                         line=e.lineno or 1,
                         message=f"file does not parse: {e.msg}",
                         snippet="")]
-    selected = ([RULES[r] for r in rules] if rules is not None
-                else list(RULES.values()))
+    if rules is not None:
+        unknown = set(rules) - set(RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {sorted(unknown)}; "
+                f"known: {sorted(RULES)}")
+        selected = [RULES[r] for r in rules]
+    else:
+        selected = list(RULES.values())
     findings: List[Finding] = []
     for r in selected:
         findings.extend(f for f in r.fn(ctx) if f is not None)

@@ -201,11 +201,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         logger.warning("state archive pull failed this round")
         finally:
             if assistant is not None:
-                assistant.stop()
                 # join BEFORE the task context tears the DHT
                 # down: the thread holds native daemon handles
                 # and an in-flight round may run this long
-                assistant.join(timeout=collab.matchmaking_time
+                assistant.stop(join_timeout=collab.matchmaking_time
                                + collab.allreduce_timeout + 5)
     finally:
         # drain the freshest upload and flush wandb even when the loop

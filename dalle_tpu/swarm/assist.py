@@ -131,8 +131,18 @@ class AveragingAssistant(threading.Thread):
         self._stop_event = threading.Event()
         self.rounds_assisted = 0
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: Optional[float] = 5.0) -> None:
+        """Signal AND (bounded) join. The default bound only covers the
+        idle polls; a stop during an in-flight assisted round needs the
+        round deadlines — pass ``join_timeout=matchmaking_time +
+        allreduce_timeout + slack`` (as run_aux_peer does) to guarantee
+        the thread is gone before the DHT is torn down, or ``None`` to
+        skip the join (signal-only). The thread is a daemon either way:
+        a missed bound degrades to process-exit cleanup, never a hang."""
         self._stop_event.set()
+        if join_timeout is not None and self.is_alive() \
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
 
     def run(self) -> None:  # pragma: no cover - exercised via tests' join
         # the trainers' wire codec: each owner compresses the part it

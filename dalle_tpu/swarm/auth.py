@@ -70,6 +70,10 @@ class AccessToken:
                        peer_public_key=bytes(obj["pk"]),
                        expiration_time=float(obj["exp"]),
                        signature=bytes(obj["sig"]))
+        # pure wire parser: None IS the "not a token" result; callers
+        # (member_authorized) treat it as unauthorized and the roster
+        # paths log the resulting drop where the context lives
+        # graftlint: disable=silent-except
         except Exception:  # noqa: BLE001 - malformed wire data
             return None
 

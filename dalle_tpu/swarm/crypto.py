@@ -83,6 +83,10 @@ def open_sealed(kx: KxKeypair, blob: bytes) -> Optional[bytes]:
     try:
         key = kx._derive(epk)
         return ChaCha20Poly1305(key).decrypt(nonce, ct, epk)
+    # AEAD-open contract: every failure mode collapses to "unreadable"
+    # on purpose — distinguishing (or logging) why a ciphertext failed
+    # builds a decryption oracle out of the log stream
+    # graftlint: disable=silent-except
     except Exception:  # noqa: BLE001 - any crypto failure = unreadable
         return None
 
@@ -104,6 +108,9 @@ def decrypt(group_key: bytes, blob: bytes) -> Optional[bytes]:
     try:
         return ChaCha20Poly1305(group_key).decrypt(
             blob[:_NONCE], blob[_NONCE:], b"")
+    # same AEAD-open contract as open_sealed: constant "unreadable"
+    # behavior, no failure-reason oracle in the logs
+    # graftlint: disable=silent-except
     except Exception:  # noqa: BLE001 - any crypto failure = unreadable
         return None
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import ctypes
 import functools
 import hashlib
+import logging
 import struct
 import time
 from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple, Union
@@ -32,6 +33,8 @@ import msgpack
 
 from dalle_tpu.swarm import _native
 from dalle_tpu.swarm.identity import Identity
+
+logger = logging.getLogger(__name__)
 
 
 def get_dht_time() -> float:
@@ -178,6 +181,10 @@ class SchemaValidator(RecordValidatorBase):
             return subkey, value
         try:
             model.model_validate(msgpack.unpackb(value, raw=False))
+        # rejecting unparseable/schema-failing records IS this
+        # validator's contract (hostile writers are expected); logging
+        # per record would hand floods a log-spam amplifier
+        # graftlint: disable=silent-except
         except Exception:  # noqa: BLE001 - any parse/validation error
             return None
         return subkey, value
@@ -216,6 +223,11 @@ class DHT:
         self.host = host
         self.port = self._lib.swarm_node_port(self._node)
         self._relay_addr: Optional[str] = None
+        # (khash, subkey) pairs already warned about in get(): an
+        # undecodable record persists until expiration, so the warning
+        # is once per record, not once per poll (capped to bound memory
+        # against a flood of distinct malformed records)
+        self._undecodable_warned: set = set()
         for addr in initial_peers:
             self.bootstrap(addr)
 
@@ -440,7 +452,24 @@ class DHT:
             skey, val = clean
             try:
                 decoded = msgpack.unpackb(val, raw=False)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - undecodable record
+                # a record that passed signature/schema validation but
+                # does not unpack means a buggy or hostile writer —
+                # dropping it silently hid exactly that once. Warn ONCE
+                # per record: the record persists until expiration and
+                # get() polls sub-second, so unthrottled warnings would
+                # hand a flooder a log-spam amplifier.
+                mark = (khash, bytes(skey))
+                if mark not in self._undecodable_warned:
+                    if len(self._undecodable_warned) < 1024:
+                        self._undecodable_warned.add(mark)
+                    logger.warning(
+                        "dropping undecodable DHT record under key %s "
+                        "(subkey %r, %d bytes)", key, skey, len(val),
+                        exc_info=True)
+                else:
+                    logger.debug("dropping undecodable DHT record "
+                                 "under key %s (repeat)", key)
                 continue
             if skey not in result or exp >= result[skey].expiration_time:
                 result[skey] = ValueWithExpiration(decoded, exp)

@@ -32,12 +32,15 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import logging
 import time
 from typing import List, Optional, Tuple
 
 import msgpack
 
 from dalle_tpu.swarm.dht import DHT, get_dht_time, owner_public_key
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,6 +141,12 @@ def verify_confirmation(raw: bytes, prefix: str, epoch: int,
         obj = msgpack.unpackb(raw, raw=False)
         body, pk, sig = bytes(obj["m"]), bytes(obj["pk"]), bytes(obj["sig"])
     except Exception:  # noqa: BLE001 - malformed wire data
+        # an unparseable confirmation silently degrades this peer to its
+        # own DHT view of the roster — worth a trace when rounds
+        # mysteriously split
+        logger.warning("malformed group confirmation from leader %s "
+                       "(%d bytes): falling back to the DHT roster view",
+                       leader_peer_id, len(raw), exc_info=True)
         return None
     if hashlib.sha256(pk).hexdigest() != leader_peer_id:
         return None

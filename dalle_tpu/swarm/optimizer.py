@@ -407,6 +407,10 @@ class CollaborativeOptimizer:
                     time.monotonic() - t_match, 4)
             if group is not None:
                 pending.group_size = group.size
+        # not silent, deferred: the error crosses threads on the round
+        # object and _finish_pending logs it (with the epoch) on the
+        # training thread, where the apply-local-grads fallback runs
+        # graftlint: disable=silent-except
         except BaseException as e:  # noqa: BLE001 - reported at reconcile
             pending.error = e
         finally:

@@ -108,8 +108,16 @@ class RendezvousAdvertiser(threading.Thread):
                                exc_info=True)
             self._stop_event.wait(max(1.0, self.ttl / 3))
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: Optional[float] = 10.0) -> None:
+        """Signal AND (bounded) join: an in-flight publish_once()
+        touching a torn-down native DHT node is a use-after-free, so
+        the caller must not proceed to DHT.shutdown while this thread
+        may still be inside a publish. ``join_timeout=None`` skips the
+        join (signal-only)."""
         self._stop_event.set()
+        if join_timeout is not None and self.is_alive() \
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
 
 
 class RendezvousFile:

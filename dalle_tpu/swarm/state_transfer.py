@@ -17,6 +17,7 @@ same SizeAdaptive codec used for state averaging, task.py:125-126).
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import struct
 import threading
@@ -29,6 +30,8 @@ import numpy as np
 from dalle_tpu.swarm import compression
 from dalle_tpu.swarm.dht import DHT, get_dht_time
 from dalle_tpu.swarm.identity import Identity, open_frame, signed_frame
+
+logger = logging.getLogger(__name__)
 
 _CHUNK = 8 << 20  # 8 MB frames (native transport caps at 64 MB)
 
@@ -211,6 +214,8 @@ class StateServer:
                 try:
                     epoch = int(self.epoch_fn())
                 except Exception:  # noqa: BLE001 - racing shutdown
+                    logger.debug("state-server epoch probe failed "
+                                 "(racing shutdown?)", exc_info=True)
                     epoch = last_epoch
             due = now - last_announce >= self.announce_period
             if due or (epoch is not None and epoch != last_epoch):
@@ -220,7 +225,10 @@ class StateServer:
                     self._announce(epoch)
                     last_epoch = epoch
                 except Exception:  # noqa: BLE001 - dht may be shutting down
-                    pass
+                    # a dead announce starves resyncing stragglers for a
+                    # whole period — say so (at most once per period)
+                    logger.warning("state-server announce failed (dht "
+                                   "shutting down?)", exc_info=True)
                 last_announce = now
             raw = self.dht.recv(tag, timeout=0.5)
             if raw is None:
@@ -230,6 +238,8 @@ class StateServer:
                 reply_addr, nonce = str(req["addr"]), bytes(req["nonce"])
                 req_kx = bytes(req.get("kx") or b"")
             except Exception:  # noqa: BLE001 - malformed request
+                logger.warning("dropping malformed state request "
+                               "(%d bytes)", len(raw), exc_info=True)
                 continue
             if not self._stream_slots.acquire(blocking=False):
                 continue  # at capacity: requester retries another server
@@ -249,7 +259,10 @@ class StateServer:
                 # this server's mailbox for the requester to pull
                 self._post_chunks(nonce, blob, req_kx)
         except Exception:  # noqa: BLE001 - peer vanished mid-stream
-            pass
+            # the requester retries another server; this side still says
+            # which download died so operators can correlate
+            logger.warning("state stream to %s failed mid-transfer",
+                           reply_addr or "<mailbox>", exc_info=True)
         finally:
             self._stream_slots.release()
 
@@ -339,6 +352,9 @@ def load_state_from_peers(dht: DHT, prefix: str,
         try:
             result = deserialize_state(blob)
         except Exception:  # noqa: BLE001 - corrupt stream
+            logger.warning("corrupt state stream from %s (advertised "
+                           "epoch %d): trying the next server", pid,
+                           advertised, exc_info=True)
             continue
         if result[0] >= min_epoch:
             return result

@@ -256,11 +256,10 @@ class TrainingTask:
         if "collab_optimizer" in self.__dict__:
             self.collab_optimizer.shutdown()
         if getattr(self, "_rdv_advertiser", None) is not None:
-            # JOIN, not just signal: an in-flight publish_once() touching
-            # a destroyed native node is a use-after-free (the ordering
-            # contract on DHT.shutdown)
-            self._rdv_advertiser.stop()
-            self._rdv_advertiser.join(timeout=10)
+            # stop() both signals and joins (bounded): an in-flight
+            # publish_once() touching a destroyed native node is a
+            # use-after-free (the ordering contract on DHT.shutdown)
+            self._rdv_advertiser.stop(join_timeout=10)
         if "dht" in self.__dict__:
             self.dht.shutdown()
 

@@ -66,6 +66,10 @@ def _read_header(path: str) -> Optional[int]:
         with open(path, "rb") as f:
             unpacker = msgpack.Unpacker(f, raw=False)
             return int(unpacker.unpack()["epoch"])
+    # header PEEK over every restore candidate: None just excludes the
+    # file from the candidate list; the actual restore of the winning
+    # candidate logs its own failure (_restore_file)
+    # graftlint: disable=silent-except
     except Exception:  # noqa: BLE001 - corrupt/missing file
         return None
 

@@ -33,8 +33,7 @@ from dalle_tpu.analysis import (RULES, analyze_paths, diff_baseline,  # noqa: E4
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("paths", nargs="*",
-                        default=[os.path.join(_REPO, "dalle_tpu")],
+    parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to analyze "
                              "(default: dalle_tpu/)")
     parser.add_argument("--baseline",
@@ -58,9 +57,25 @@ def main(argv=None) -> int:
             print(f"{rid}  [{r.family}]\n    {r.doc.strip()}\n")
         return 0
 
-    findings = analyze_paths(args.paths, root=_REPO, rules=args.rules)
+    unknown = set(args.rules or ()) - set(RULES)
+    if unknown:
+        print(f"unknown rule id(s): {', '.join(sorted(unknown))} "
+              "(see --list-rules)", file=sys.stderr)
+        return 2
+
+    scoped = bool(args.paths) or bool(args.rules)
+    paths = args.paths or [os.path.join(_REPO, "dalle_tpu")]
+    findings = analyze_paths(paths, root=_REPO, rules=args.rules)
 
     if args.write_baseline:
+        if scoped:
+            # a restricted scan sees only a SUBSET of the findings;
+            # writing it out would silently drop every other triaged
+            # baseline entry (and the next full --check would fail)
+            print("--write-baseline requires the full default scope "
+                  "(no path arguments, no --rule): the baseline is "
+                  "written whole, not merged", file=sys.stderr)
+            return 2
         save_baseline(args.baseline, findings)
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")
         return 0
@@ -72,7 +87,9 @@ def main(argv=None) -> int:
         for f in fresh:
             print(f.format())
             print(f"    {f.snippet}")
-        if stale:
+        if stale and not scoped:
+            # suppressed under a restricted scope: out-of-scope baseline
+            # entries are invisible to this scan, not fixed
             print(f"note: {len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
                   "— shrink the baseline with --write-baseline)")
@@ -94,4 +111,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `lint.py --check | head` must NOT turn findings into a pass:
+        # exit like a SIGPIPE'd process, which no gate reads as success
+        sys.exit(128 + 13)

@@ -181,8 +181,11 @@ async def pump(queue):
     return await queue.get()
 """,
         """
-import asyncio
-async def pump(queue):
+import asyncio, time
+async def pump(queue, executor):
+    def _worker():           # runs on the executor, not the loop:
+        time.sleep(0.5)      # nested sync defs are NOT the coroutine
+    await asyncio.get_event_loop().run_in_executor(executor, _worker)
     await asyncio.sleep(0.5)
     return await queue.get()
 """,

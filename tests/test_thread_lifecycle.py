@@ -1,0 +1,147 @@
+"""Thread lifecycle regression tests: every long-lived background
+thread in the tree must be a daemon (so a missed join can never block
+interpreter exit) AND terminate within its bounded stop/close path (so
+shutdown is deterministic, not process-exit roulette).
+
+This is the runtime face of graftlint's `thread-daemon-join` rule
+(LINTS.md): the rule proves the discipline statically at the spawn
+sites; these tests prove the stop paths actually reap the threads. The
+clean-exit assertion is the daemon check — a non-daemon thread that
+outlives its owner is exactly the thing that wedges `python -m pytest`
+and real trainer shutdowns.
+
+Everything here runs against stubs (no native DHT, no network, no
+model): thread mechanics only, milliseconds per test.
+"""
+
+import threading
+import time
+
+from dalle_tpu.swarm.rendezvous import RendezvousAdvertiser
+from dalle_tpu.swarm.state_transfer import StateServer
+from dalle_tpu.training.checkpoint import _AsyncWriter
+from dalle_tpu.training.remote_sink import RemoteSink, UploadWorker
+
+
+def _wait_dead(thread, timeout=5.0):
+    thread.join(timeout=timeout)
+    return not thread.is_alive()
+
+
+class _StubDHT:
+    """The slice of the DHT surface the advertiser/state-server threads
+    touch, with no native node behind it."""
+
+    peer_id = "stub-peer"
+    reachable_address = ""       # pull-only: advertise() is a no-op
+    visible_address = ""
+
+    def store(self, *a, **k):
+        return True
+
+    def recv(self, tag, timeout=0.5):
+        time.sleep(min(0.01, timeout))
+        return None
+
+
+class TestAsyncCheckpointWriter:
+    def test_daemon_and_reaped_on_close(self):
+        w = _AsyncWriter()
+        assert w._thread.daemon, "ckpt writer must not block exit"
+        done = threading.Event()
+        w.submit("ckpt", done.set, "ckpt_1")
+        w.close(flush_timeout=10.0)
+        assert done.is_set(), "queued write must land before close"
+        assert _wait_dead(w._thread), "close() must reap the writer"
+
+    def test_close_without_work(self):
+        w = _AsyncWriter()
+        w.close(flush_timeout=5.0)
+        assert _wait_dead(w._thread)
+
+
+class TestUploadWorker:
+    def test_daemon_and_reaped_on_close(self):
+        uploads = []
+
+        class Sink(RemoteSink):
+            def upload(self, path):
+                uploads.append(path)
+                return True
+
+        worker = UploadWorker(Sink(), "stub://dest")
+        assert worker._thread.daemon
+        worker.submit("a-checkpoint")
+        worker.close(timeout=10.0)
+        assert _wait_dead(worker._thread), "close() must reap the worker"
+        assert uploads == ["a-checkpoint"], \
+            "the pending upload must drain before shutdown"
+
+
+class TestRendezvousAdvertiser:
+    def test_stop_joins_bounded(self):
+        adv = RendezvousAdvertiser(_StubDHT(), "test-prefix", ttl=0.5)
+        assert adv.daemon, "advertiser must not block exit"
+        adv.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        adv.stop(join_timeout=10.0)     # stop() now signals AND joins
+        assert not adv.is_alive(), "stop() must reap the advertiser"
+        assert time.monotonic() - t0 < 5.0, "join must not wait a ttl"
+
+    def test_stop_before_start_is_safe(self):
+        adv = RendezvousAdvertiser(_StubDHT(), "test-prefix")
+        adv.stop()                      # never started: no join, no raise
+
+
+class TestStateServer:
+    def test_stop_joins_bounded(self):
+        server = StateServer(_StubDHT(), "test-prefix",
+                             provider=lambda: (0, []),
+                             announce_period=0.2)
+        assert server._thread.daemon, "state server must not block exit"
+        server.start()
+        time.sleep(0.05)
+        server.stop()
+        assert _wait_dead(server._thread), "stop() must reap the server"
+
+
+def test_no_stray_nondaemon_threads_after_shutdown():
+    """The clean-interpreter-exit regression: spin up every owned
+    background worker, shut them all down, and require (a) every thread
+    they spawned is gone and (b) nothing non-daemon remains beyond the
+    threads that predate the test — a forgotten non-daemon worker here
+    is precisely what turns `python -c 'train(); exit()'` into a hang.
+    """
+    before = set(threading.enumerate())
+
+    writer = _AsyncWriter()
+
+    class NullSink(RemoteSink):
+        def upload(self, path):
+            return True
+
+    worker = UploadWorker(NullSink(), "stub://dest")
+    adv = RendezvousAdvertiser(_StubDHT(), "exit-test", ttl=0.5)
+    adv.start()
+    server = StateServer(_StubDHT(), "exit-test",
+                         provider=lambda: (0, []), announce_period=0.2)
+    server.start()
+
+    spawned = [t for t in threading.enumerate() if t not in before]
+    assert spawned, "expected live background threads"
+    assert all(t.daemon for t in spawned), (
+        "non-daemon background thread(s) would block interpreter exit: "
+        f"{[t.name for t in spawned if not t.daemon]}")
+
+    writer.close(flush_timeout=5.0)
+    worker.close(timeout=5.0)
+    adv.stop(join_timeout=5.0)
+    server.stop()
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline \
+            and any(t.is_alive() for t in spawned):
+        time.sleep(0.02)
+    leaked = [t.name for t in spawned if t.is_alive()]
+    assert not leaked, f"threads outlived their stop paths: {leaked}"
