@@ -29,7 +29,8 @@ from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
                                  dataclass_from_args)
 from dalle_tpu.config import (AuxConfig, CollabConfig, ModelConfig,
                               OptimizerConfig, PeerConfig)
-from dalle_tpu.cli.run_trainer import MODEL_PRESETS, banner
+from dalle_tpu.cli.run_trainer import (MODEL_PRESETS, banner,
+                                       maybe_wandb_run)
 
 logger = logging.getLogger("dalle_tpu.aux")
 
@@ -139,21 +140,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     uploader = UploadWorker(remote_sink, args.archive_remote) \
         if remote_sink is not None else None
 
-    wandb_run = None
-    if args.wandb_project:
-        # the reference's aux peer is the swarm's single wandb writer
-        # (run_aux_peer.py:92-93,135-144); optional here — the JSON
-        # metrics file is the always-on sink
-        try:
-            import wandb
-            wandb_run = wandb.init(project=args.wandb_project,
-                                   name=f"aux-{peer.experiment_prefix}")
-        except Exception:  # noqa: BLE001 - wandb is strictly optional:
-            # missing install, auth failure, or no network must not take
-            # the monitoring peer down with it
-            logger.warning("wandb unavailable (--wandb-project %s); "
-                           "continuing with the metrics file",
-                           args.wandb_project, exc_info=True)
+    # the reference's aux peer is the swarm's single wandb writer
+    # (run_aux_peer.py:92-93,135-144); optional here — the JSON metrics
+    # file is the always-on sink (maybe_wandb_run logs-and-continues on
+    # any wandb failure)
+    wandb_run = maybe_wandb_run(args.wandb_project,
+                                f"aux-{peer.experiment_prefix}")
 
     last_archived = -1
     rounds = 0

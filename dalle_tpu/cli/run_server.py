@@ -1,0 +1,234 @@
+"""Serving CLI: the continuous-batching HTTP front-end.
+
+Where ``run_inference`` is the reference's one-shot offline tool, this
+serves online traffic: a slot-recycled KV-cache engine
+(``dalle_tpu/serving/``) admits requests mid-flight instead of waiting
+for batch formation, and VQGAN pixel decode + CLIP rerank of finished
+requests overlap ongoing token generation on a worker thread.
+
+Usage::
+
+    python -m dalle_tpu.cli.run_server \
+        --checkpoint-dir ck/ --tokenizer-path tok/tokenizer.json \
+        --preset tiny --http-port 8080
+
+    curl -s localhost:8080/generate -d '{"text": "a red cat", \
+        "n_images": 4, "seed": 7}'
+    curl -s localhost:8080/stats
+
+``--random-init`` serves freshly initialized weights (smoke tests and
+benches — the serving path's cost does not depend on weight values).
+Ctrl-C and SIGTERM (k8s/systemd stop) both drain: queued and in-flight
+requests finish (bounded by ``--drain-timeout-s``), the engine and
+pixel worker are reaped, then the process exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Optional, Sequence
+
+from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
+                                 dataclass_from_args)
+from dalle_tpu.cli.run_trainer import MODEL_PRESETS
+from dalle_tpu.config import ModelConfig, ServingConfig
+
+logger = logging.getLogger("dalle_tpu.server")
+
+CONFIG_CLASSES = (ModelConfig, ServingConfig)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    check_no_collisions(*CONFIG_CLASSES)
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-server", description=__doc__.splitlines()[0])
+    parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
+                        default="flagship")
+    parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument("--random-init", action="store_true",
+                        help="serve freshly initialized weights (smoke "
+                             "tests / benches) instead of a checkpoint")
+    parser.add_argument("--tokenizer-path", type=str, default=None,
+                        help="tokenizer.json; without it only "
+                             "pre-tokenized 'tokens' requests are served")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="append one serving-metrics JSON line per "
+                             "--metrics-interval-s")
+    parser.add_argument(
+        "--vqgan-checkpoint", type=str, default=None,
+        help="taming-transformers VQGAN .ckpt: decode finished requests "
+             "to pixels on the overlap worker")
+    parser.add_argument(
+        "--clip-checkpoint", type=str, default=None,
+        help="openai CLIP .pt: score decoded images against the query "
+             "(requires --vqgan-checkpoint and --clip-bpe)")
+    parser.add_argument("--clip-bpe", type=str, default=None)
+    parser.add_argument(
+        "--allow-unsafe-pickle", action="store_true",
+        help="permit torch's permissive pickle loader for VQGAN/CLIP "
+             "checkpoints (EXECUTES code from the file — trusted "
+             "origins only; utils/torch_io.py)")
+    parser.add_argument("--platform", type=str, default=None)
+    parser.add_argument("--log-level", type=str, default="INFO")
+    for cls in CONFIG_CLASSES:
+        add_dataclass_args(parser, cls)
+    return parser
+
+
+def _load_params(args, cfg):
+    import jax
+
+    from dalle_tpu.models.dalle import DALLE, init_params
+
+    template = init_params(DALLE(cfg), jax.random.PRNGKey(0))
+    if args.random_init:
+        return template
+    if not args.checkpoint_dir:
+        return None
+    from dalle_tpu.training.checkpoint import CheckpointManager
+    restored = CheckpointManager(
+        args.checkpoint_dir,
+        async_writes=False).restore_params_latest(template)
+    if restored is None:
+        return None
+    params, epoch = restored
+    logger.info("serving checkpoint at epoch %d", epoch)
+    return params
+
+
+def _build_pixel_fn(args, cfg):
+    """Jitted codes -> pixels (+ CLIP score) closure for the overlap
+    worker, or None when no VQGAN checkpoint is configured. Mirrors the
+    run_inference pipeline stages."""
+    if not args.vqgan_checkpoint:
+        return None
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_tpu.models.vqgan import (VQGANConfig, decode_codes,
+                                        load_taming_checkpoint)
+    vq_cfg = VQGANConfig(n_embed=cfg.vocab_image,
+                         resolution=cfg.image_grid * 8)
+    vq_params = load_taming_checkpoint(
+        args.vqgan_checkpoint, vq_cfg,
+        allow_unsafe=args.allow_unsafe_pickle)
+    decode = jax.jit(lambda c: decode_codes(vq_params, vq_cfg, c))
+
+    score_fn = None
+    if args.clip_checkpoint:
+        if not args.clip_bpe:
+            raise SystemExit("--clip-checkpoint requires --clip-bpe")
+        from dalle_tpu.models.clip import (CLIPConfig, CLIPTokenizer,
+                                           clip_scores,
+                                           load_openai_checkpoint,
+                                           resize_for_clip)
+        cl_cfg = CLIPConfig()
+        cl_params = load_openai_checkpoint(
+            args.clip_checkpoint, cl_cfg,
+            allow_unsafe=args.allow_unsafe_pickle)
+        cl_tok = CLIPTokenizer(args.clip_bpe, cl_cfg.context_length)
+        score = jax.jit(lambda im, tok: clip_scores(
+            cl_params, cl_cfg, resize_for_clip(im, cl_cfg), tok))
+
+        def score_fn(images):
+            # served requests have no caption handy post-tokenization;
+            # score against the empty prompt as a fixed aesthetic-ish
+            # anchor (rerank across a query's n_images stays meaningful)
+            tok = jnp.asarray(cl_tok.encode("")[None])
+            return float(np.asarray(score(images, tok))[0, 0])
+
+    def pixel_fn(codes):
+        imgs = np.asarray(decode(jnp.asarray(codes[None])))
+        out = {"images": imgs[0]}
+        if score_fn is not None:
+            out["clip_score"] = score_fn(jnp.asarray(imgs))
+        return out
+
+    return pixel_fn
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from dalle_tpu.models.decode import SamplingConfig
+    from dalle_tpu.serving.engine import DecodeEngine
+    from dalle_tpu.serving.metrics import ServingMetrics
+    from dalle_tpu.serving.pixels import PixelPipeline
+    from dalle_tpu.serving.server import ServingHTTPServer
+
+    cfg = dataclass_from_args(ModelConfig, args,
+                              base=MODEL_PRESETS[args.preset]())
+    serving = dataclass_from_args(ServingConfig, args)
+    serving.validate()
+
+    params = _load_params(args, cfg)
+    if params is None:
+        logger.error("no loadable checkpoint under %s (or pass "
+                     "--random-init)", args.checkpoint_dir)
+        return 1
+
+    tokenizer = None
+    if args.tokenizer_path:
+        from dalle_tpu.data.tokenizer import CaptionTokenizer
+        tokenizer = CaptionTokenizer.load(args.tokenizer_path)
+
+    metrics = ServingMetrics(n_slots=serving.n_slots,
+                             jsonl_path=args.metrics_file,
+                             interval_s=serving.metrics_interval_s)
+    pixel_fn = _build_pixel_fn(args, cfg)
+    pipeline = (PixelPipeline(pixel_fn, metrics=metrics)
+                if pixel_fn is not None else None)
+    engine = DecodeEngine(
+        params, cfg, serving,
+        sampling=SamplingConfig(temperature=args.temperature,
+                                top_k=args.top_k, top_p=args.top_p),
+        pixel_pipeline=pipeline, metrics=metrics).start()
+
+    httpd = ServingHTTPServer((serving.http_host, serving.http_port),
+                              engine, tokenizer=tokenizer,
+                              request_timeout_s=serving.request_timeout_s)
+    logger.info("=" * 60)
+    logger.info("serving %s on http://%s:%d (%d slots, %d-step chunks, "
+                "%d prefix buckets%s)", args.preset, serving.http_host,
+                httpd.server_address[1], serving.n_slots,
+                serving.steps_per_call, engine.n_buckets,
+                ", pixel overlap" if pipeline else "")
+    logger.info("POST /generate {\"text\"|\"tokens\", \"n_images\", "
+                "\"seed\"} | GET /stats | GET /healthz")
+    logger.info("=" * 60)
+
+    # SIGTERM (k8s/systemd stop) drains exactly like Ctrl-C: the handler
+    # runs on the main thread, so raising here unwinds serve_forever
+    import signal
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("interrupt: draining engine "
+                    "(bounded by drain_timeout_s=%.0fs)",
+                    serving.drain_timeout_s)
+    finally:
+        httpd.server_close()
+        engine.stop(drain=True)
+        logger.info("drained; final stats: %s", engine.stats())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,50 @@ CONFIG_CLASSES = (ModelConfig, OptimizerConfig, TrainerConfig, CollabConfig,
                   PeerConfig)
 
 
+def maybe_wandb_run(project: Optional[str], name: str):
+    """Best-effort wandb run, mirroring the aux-peer sink (reference
+    run_aux_peer.py:92-93): None when no project is configured or wandb
+    is unusable — the JSONL metrics file stays the always-on sink, and a
+    missing install / auth failure / dead network must never take a
+    training peer down."""
+    if not project:
+        return None
+    try:
+        import wandb
+        return wandb.init(project=project, name=name)
+    except Exception:  # noqa: BLE001 - wandb is strictly optional
+        logger.warning("wandb unavailable (--wandb-project %s); "
+                       "continuing with the metrics file", project,
+                       exc_info=True)
+        return None
+
+
+def make_epoch_sink(metrics_file: Optional[str], wandb_run,
+                    timings_fn=None):
+    """Per-epoch report sink: one JSON line per epoch to
+    ``metrics_file`` and, when a wandb run is live, the same scalars
+    (timings flattened under ``timings/``) to wandb."""
+    def on_epoch(report):
+        timings = timings_fn() if timings_fn is not None else {}
+        row = {
+            "epoch": report.epoch,
+            "loss": report.loss,
+            "mini_steps": report.mini_steps,
+            "samples_per_second": report.samples_per_second,
+            "timings": timings,
+        }
+        if metrics_file:
+            with open(metrics_file, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        if wandb_run is not None:
+            scalars = {k: v for k, v in row.items()
+                       if k != "timings" and v is not None}
+            scalars.update({f"timings/{k}": v
+                            for k, v in (timings or {}).items()})
+            wandb_run.log(scalars)
+    return on_epoch
+
+
 def build_parser() -> argparse.ArgumentParser:
     check_no_collisions(*CONFIG_CLASSES)
     parser = argparse.ArgumentParser(
@@ -51,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--preset", choices=sorted(MODEL_PRESETS),
                         default="flagship",
                         help="base model shape that field flags override")
+    parser.add_argument("--wandb-project", type=str, default=None,
+                        help="log per-epoch training stats to this wandb "
+                             "project (mirrors the aux peer's swarm-wide "
+                             "sink; requires wandb to be installed)")
     parser.add_argument("--max-epochs", type=int, default=None,
                         help="stop after this many global steps")
     parser.add_argument("--max-steps", type=int, default=None,
@@ -118,29 +166,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         data_path=args.data_path,
                         tokenizer_path=args.tokenizer_path)
 
-    def on_epoch(report):
-        if args.metrics_file:
-            with open(args.metrics_file, "a") as f:
-                f.write(json.dumps({
-                    "epoch": report.epoch,
-                    "loss": report.loss,
-                    "mini_steps": report.mini_steps,
-                    "samples_per_second": report.samples_per_second,
-                    "timings": task.collab_optimizer.last_timings,
-                }) + "\n")
+    wandb_run = maybe_wandb_run(args.wandb_project,
+                                f"trainer-{peer.experiment_prefix}")
+    on_epoch = make_epoch_sink(
+        args.metrics_file, wandb_run,
+        timings_fn=lambda: task.collab_optimizer.last_timings)
 
-    with task:
-        banner(task)
-        reports = train_loop(task,
-                             max_epochs=args.max_epochs,
-                             max_steps=args.max_steps,
-                             warmup_steps=args.warmup_batches,
-                             on_epoch=on_epoch,
-                             checkpoint_dir=args.checkpoint_dir,
-                             save_every=args.save_every_epochs,
-                             backup_every=args.backup_every_epochs,
-                             keep_checkpoints=args.keep_checkpoints,
-                             profile_dir=args.profile_dir)
+    try:
+        with task:
+            banner(task)
+            reports = train_loop(task,
+                                 max_epochs=args.max_epochs,
+                                 max_steps=args.max_steps,
+                                 warmup_steps=args.warmup_batches,
+                                 on_epoch=on_epoch,
+                                 checkpoint_dir=args.checkpoint_dir,
+                                 save_every=args.save_every_epochs,
+                                 backup_every=args.backup_every_epochs,
+                                 keep_checkpoints=args.keep_checkpoints,
+                                 profile_dir=args.profile_dir)
+    finally:
+        # flush wandb even when the loop exits via KeyboardInterrupt /
+        # a DHT exception — same shutdown contract as the aux peer
+        if wandb_run is not None:
+            wandb_run.finish()
     if reports:
         logger.info("done: %d epochs, final mean loss %.4f",
                     len(reports), reports[-1].loss)

@@ -353,6 +353,60 @@ class PeerConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching decode engine knobs (``dalle_tpu/serving/``).
+
+    The reference has no serving path at all (its inference tool is a
+    one-shot CLI); these knobs size the slot-recycled KV-cache engine
+    that replaces whole-batch lockstep decode for online traffic.
+    """
+
+    # KV-cache slots = max concurrently decoding requests. The cache is
+    # allocated once at this batch size; a finished slot is recycled
+    # immediately from the request queue (image generation is fixed-
+    # length, so staggered admission gives staggered completion).
+    n_slots: int = 4
+    # Decode positions advanced per jitted call. Admission, completion
+    # harvest and metrics sampling happen at call boundaries, so this is
+    # the scheduling granularity: smaller = finer admission latency,
+    # larger = less host-loop overhead per token.
+    steps_per_call: int = 8
+    # Cap on KV-cache bytes the engine may OCCUPY concurrently; caps
+    # admitted slots at floor(budget / bytes-per-slot) when set. The
+    # cache itself is statically allocated at n_slots (XLA needs static
+    # shapes) — the budget models co-tenancy pressure (HBM shared with a
+    # trainer or a second engine) by bounding live occupancy.
+    kv_budget_mb: Optional[int] = None
+    # Prefix-bucket count for the statically-truncated cache reads
+    # (models/decode.py resolve_buckets); None = the measured adaptive
+    # choice for n_slots. Each bucket compiles one step variant.
+    decode_buckets: Optional[int] = None
+    # Queued (not yet admitted) requests beyond this are rejected at
+    # submit — backpressure instead of unbounded growth.
+    queue_capacity: int = 256
+    # How long a front-end waits on a request future before 504.
+    request_timeout_s: float = 300.0
+    # stop(drain=True) bound: finish queued + in-flight work within this
+    # window, then the engine thread is joined regardless.
+    drain_timeout_s: float = 60.0
+    # Serving front-end bind address (stdlib HTTP server).
+    http_host: str = "127.0.0.1"
+    http_port: int = 8080
+    # Seconds between metrics JSONL snapshot rows (0 disables).
+    metrics_interval_s: float = 5.0
+
+    def validate(self) -> None:
+        if self.n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1 (got {self.n_slots})")
+        if self.steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1 (got {self.steps_per_call})")
+        if self.queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1 (got {self.queue_capacity})")
+
+
+@dataclass(frozen=True)
 class AuxConfig:
     """Aux (monitor/checkpoint) peer knobs (reference ``arguments.py:140-165``)."""
 

@@ -158,7 +158,11 @@ def _positional_table(params: Dict, cfg: ModelConfig) -> jax.Array:
 
 
 def _qkv_rows(x, lp, cos_p, sin_p, cfg: ModelConfig, dtype):
-    """The block's q/k/v rows for the current position: (B, H, d) each."""
+    """The block's q/k/v rows for the current position: (B, H, d) each.
+
+    ``cos_p``/``sin_p`` are (d,) when every row shares one position, or
+    (B, d) when each batch row sits at its own position (the serving
+    engine's per-slot decode)."""
     b = x.shape[0]
     h = _ln(x, lp["attn_norm"], dtype)
     q = (h @ lp["attn"]["q"]["kernel"].astype(dtype)).reshape(
@@ -168,22 +172,31 @@ def _qkv_rows(x, lp, cos_p, sin_p, cfg: ModelConfig, dtype):
     v = (h @ lp["attn"]["v"]["kernel"].astype(dtype)).reshape(
         b, cfg.heads, cfg.head_dim)
     if cfg.rotary:
-        q = apply_rotary(q, cos_p[None, None, :], sin_p[None, None, :])
-        k = apply_rotary(k, cos_p[None, None, :], sin_p[None, None, :])
+        if cos_p.ndim == 1:
+            cos_b, sin_b = cos_p[None, None, :], sin_p[None, None, :]
+        else:                      # per-slot positions: (B, d) -> (B, 1, d)
+            cos_b, sin_b = cos_p[:, None, :], sin_p[:, None, :]
+        q = apply_rotary(q, cos_b, sin_b)
+        k = apply_rotary(k, cos_b, sin_b)
     return q, k, v
 
 
 def _attend_and_ff(x, lp, q, k_cache, v_cache, mask_row,
                    cfg: ModelConfig, dtype):
     """Attention of the current row over the block's (B, T, H*d) cache,
-    out-projection, and the GEGLU FF: (B, dim) -> (B, dim)."""
+    out-projection, and the GEGLU FF: (B, dim) -> (B, dim).
+
+    ``mask_row`` is (T,) when the batch shares one position, or (B, T)
+    when every row carries its own mask row (per-slot decode)."""
     b, t_total = k_cache.shape[0], k_cache.shape[1]
     scale = cfg.head_dim ** -0.5
     k_view = k_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
     v_view = v_cache.reshape(b, t_total, cfg.heads, cfg.head_dim)
     scores = jnp.einsum("bhd,bthd->bht", q, k_view.astype(dtype),
                         preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask_row[None, None, :], scores, NEG_INF)
+    mask_b = (mask_row[None, None, :] if mask_row.ndim == 1
+              else mask_row[:, None, :])
+    scores = jnp.where(mask_b, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum("bht,bthd->bhd", probs.astype(dtype),
                      v_view.astype(dtype),
@@ -215,16 +228,29 @@ def _apply_block(x, lp, mask_row, k_cache, v_cache, pos, cos_p, sin_p,
     updated (B, T, H*d) cache pair (merged minor axis — see init_cache).
     The incremental mirror of transformer.TransformerBlock. ``vis``
     statically truncates the attention's cache read (caller guarantees
-    pos < vis); the full-length cache pair is still returned."""
+    pos < vis); the full-length cache pair is still returned.
+
+    ``pos`` is a scalar (whole batch at one position — every row's cache
+    write lands on the same row index) or a (B,) vector (per-slot decode
+    — each batch row scatters its write to its own position)."""
     b = x.shape[0]
     q, k, v = _qkv_rows(x, lp, cos_p, sin_p, cfg, dtype)
-    k_cache = jax.lax.dynamic_update_index_in_dim(
-        k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos, axis=1)
-    v_cache = jax.lax.dynamic_update_index_in_dim(
-        v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos, axis=1)
+    if jnp.ndim(pos) == 0:
+        k_cache = jax.lax.dynamic_update_index_in_dim(
+            k_cache, k.reshape(b, cfg.dim).astype(k_cache.dtype), pos,
+            axis=1)
+        v_cache = jax.lax.dynamic_update_index_in_dim(
+            v_cache, v.reshape(b, cfg.dim).astype(v_cache.dtype), pos,
+            axis=1)
+    else:
+        rows = jnp.arange(b)
+        k_cache = k_cache.at[rows, pos].set(
+            k.reshape(b, cfg.dim).astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, pos].set(
+            v.reshape(b, cfg.dim).astype(v_cache.dtype))
     end = k_cache.shape[1] if vis is None else vis
     y = _attend_and_ff(x, lp, q, k_cache[:, :end], v_cache[:, :end],
-                       mask_row[:end], cfg, dtype)
+                       mask_row[..., :end], cfg, dtype)
     return y, k_cache, v_cache
 
 
@@ -237,6 +263,14 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     ``pos``; returns (logits over the FULL combined vocabulary at ``pos``,
     updated cache). Segment masking is applied (text positions only emit
     text ids, image positions image ids).
+
+    ``pos`` is a scalar — every batch row decodes the same position, the
+    lockstep ``generate_images`` path — or a (B,) int32 vector of
+    PER-SLOT positions: row ``i`` embeds, masks, rotates and writes its
+    cache at ``pos[i]``, so a serving engine can run requests admitted at
+    different times through ONE jitted step (continuous batching). The
+    per-row math is identical either way; only the index plumbing
+    changes (gathered positional/mask rows, scattered cache writes).
 
     ``visible`` (STATIC) bounds the attention's cache read to positions
     ``[0, visible)`` — callers that know ``pos < visible`` (the bucketed
@@ -295,11 +329,21 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
             for uid in range(cycle):
                 lp = blocks[f"block_{uid}"]
                 q, k, v = _qkv_rows(x, lp, cos_p, sin_p, cfg, dtype)
-                start = (it, uid, 0, pos, 0)
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.reshape(1, 1, b, 1, hd).astype(ck.dtype), start)
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.reshape(1, 1, b, 1, hd).astype(cv.dtype), start)
+                if jnp.ndim(pos) == 0:
+                    start = (it, uid, 0, pos, 0)
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, k.reshape(1, 1, b, 1, hd).astype(ck.dtype),
+                        start)
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, v.reshape(1, 1, b, 1, hd).astype(cv.dtype),
+                        start)
+                else:
+                    # per-slot positions: row i writes (it, uid, i, pos[i])
+                    rows = jnp.arange(b)
+                    ck = ck.at[it, uid, rows, pos].set(
+                        k.reshape(b, hd).astype(ck.dtype))
+                    cv = cv.at[it, uid, rows, pos].set(
+                        v.reshape(b, hd).astype(cv.dtype))
                 k_blk = jax.lax.dynamic_slice(
                     ck, (it, uid, 0, 0, 0),
                     (1, 1, b, vis, hd)).reshape(b, vis, hd)
@@ -307,7 +351,8 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
                     cv, (it, uid, 0, 0, 0),
                     (1, 1, b, vis, hd)).reshape(b, vis, hd)
                 y = _attend_and_ff(x, lp, q, k_blk, v_blk,
-                                   uid_masks[uid][pos, :vis], cfg, dtype)
+                                   uid_masks[uid][pos][..., :vis], cfg,
+                                   dtype)
                 # same overhang masking as training's BlockCycle: the
                 # final repetition's surplus applications run but their
                 # outputs are discarded
@@ -351,8 +396,13 @@ def decode_step(params: Dict, cfg: ModelConfig, cache: Dict,
     # segment vocabulary masking at decode (dalle-pytorch parity)
     is_text_pos = pos < cfg.text_seq_len
     vocab_is_text = jnp.arange(cfg.vocab_total) < cfg.vocab_text
-    valid = jnp.where(is_text_pos, vocab_is_text, ~vocab_is_text)
-    logits = jnp.where(valid[None, :], logits, NEG_INF)
+    if jnp.ndim(pos) == 0:
+        valid = jnp.where(is_text_pos, vocab_is_text, ~vocab_is_text)
+        logits = jnp.where(valid[None, :], logits, NEG_INF)
+    else:                          # per-slot: each row masks by ITS segment
+        valid = jnp.where(is_text_pos[:, None], vocab_is_text[None, :],
+                          ~vocab_is_text[None, :])
+        logits = jnp.where(valid, logits, NEG_INF)
     return logits, cache
 
 
@@ -378,6 +428,16 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
             jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < threshold[:, None], NEG_INF, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def bucket_bounds(total: int, n_buckets: int) -> List[int]:
+    """Prefix-bucket upper bounds over ``total`` positions (clamped to
+    [1, total] buckets). ONE definition for the lockstep scan
+    (``generate_images``) and the serving engine's per-chunk visible
+    choice — the two must truncate identically or their caches
+    desynchronize."""
+    n = max(1, min(int(n_buckets), total))
+    return [round(total * (i + 1) / n) for i in range(n)]
 
 
 def resolve_buckets(buckets: Optional[int], batch: int) -> int:
@@ -440,8 +500,7 @@ def generate_images(params: Dict, cfg: ModelConfig,
     # of streaming the dead tail (~1.6x less cache traffic at 4 buckets,
     # for ~bucket-count x the step-body compile).
     total = cfg.total_seq_len
-    n_buckets = max(1, min(int(buckets), total))
-    bounds = [round(total * (i + 1) / n_buckets) for i in range(n_buckets)]
+    bounds = bucket_bounds(total, buckets)
     init_input = jnp.full((b,), bos_id, jnp.int32)
     carry = (cache, init_input, rng)
     pieces = []

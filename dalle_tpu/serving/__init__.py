@@ -1,0 +1,30 @@
+"""Continuous-batching serving stack.
+
+The inference CLI decodes whole batches in lockstep (``models/decode.py
+generate_images``): every request waits for a batch to form, the batch
+runs start-to-finish together, and pixel decode + CLIP rerank serialize
+behind token generation. This package replaces that with an online
+engine built on ``decode_step``'s per-slot position vector:
+
+- :mod:`engine`    — the slot-recycled KV-cache decode engine
+- :mod:`scheduler` — admission by free slots + KV budget, graceful drain
+- :mod:`metrics`   — per-request TTFT/latency, occupancy, queue depth,
+  img/s, p50/p95, JSONL sink
+- :mod:`pixels`    — VQGAN pixel decode + CLIP rerank of finished slots
+  on a bounded worker thread, overlapped with ongoing token generation
+- :mod:`server`    — stdlib-HTTP front-end (``cli/run_server.py``)
+"""
+
+from dalle_tpu.serving.engine import DecodeEngine, RequestHandle
+from dalle_tpu.serving.metrics import ServingMetrics
+from dalle_tpu.serving.pixels import PixelPipeline
+from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
+
+__all__ = [
+    "DecodeEngine",
+    "PixelPipeline",
+    "RequestHandle",
+    "ServingMetrics",
+    "SlotScheduler",
+    "kv_bytes_per_slot",
+]

@@ -1,0 +1,411 @@
+"""Slot-recycled continuous-batching decode engine.
+
+``generate_images`` decodes a batch in lockstep: one shared position
+scalar, every request entering and leaving together. This engine runs
+the SAME per-row math (``decode_step`` with a per-slot position vector —
+bit-identical, pinned by test) but gives every KV-cache slot its own
+clock: a request is admitted into any free slot at a jitted-call
+boundary, decodes its 256-token teacher-forced text prefix and 1024
+sampled image codes at its own offset, and the slot is recycled from the
+request queue the moment it finishes. Under ragged arrivals the batch
+never runs partially empty waiting for batch formation, and completed
+slots hand off to the pixel worker (``serving/pixels.py``) while token
+generation continues.
+
+Structure:
+
+- Device state (:class:`EngineState`): the KV cache at ``n_slots``
+  batch rows plus per-slot position / next-input / RNG chain / text
+  prefix / emitted-code buffers. Lives on device between calls; the
+  host only pulls the (S,) position vector per chunk and one code row
+  per completion.
+- Jitted chunk (:func:`_chunk_fn`): ``steps_per_call`` decode steps as
+  one ``lax.scan``. Compiled once per (config, sampling, chunk,
+  visible-bucket) — cached module-wide so engines in one process share
+  executables.
+- Host loop (:meth:`DecodeEngine._run`): admission (scheduler-granted,
+  at chunk boundaries), bucket choice, completion harvest, metrics.
+
+RNG parity: each slot carries its own key chain, split once per decode
+step exactly like ``generate_images``'s carry, and sampling draws
+through ``sample_logits`` on a (1, V) row — value-identical to the
+lockstep batch-of-one call. A request admitted mid-flight therefore
+samples the same codes it would have sampled in its own
+``generate_images`` run.
+
+Prefix buckets: attention reads are statically truncated to the
+smallest bucket bound covering every live slot's chunk-end position
+(``resolve_buckets`` picks the bucket count — the SAME measured policy
+``generate_images`` uses, not a re-derivation).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.config import ModelConfig, ServingConfig
+from dalle_tpu.models.decode import (SamplingConfig, bucket_bounds,
+                                     decode_step, init_cache,
+                                     resolve_buckets, sample_logits)
+from dalle_tpu.serving.metrics import ServingMetrics
+from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
+
+logger = logging.getLogger(__name__)
+
+
+class EngineState(NamedTuple):
+    """Device-resident per-slot decode state. ``pos == total_seq_len``
+    marks a slot free (or finished-and-awaiting-harvest)."""
+
+    cache: Any                 # init_cache(cfg, n_slots) pytree
+    pos: jax.Array             # (S,) int32 next position to decode
+    tokens: jax.Array          # (S,) int32 input token for that position
+    rngs: jax.Array            # (S, 2) uint32 per-slot key chains
+    text: jax.Array            # (S, text_seq_len) int32 prefixes
+    codes: jax.Array           # (S, image_seq_len) int32 emitted codes
+
+
+@functools.lru_cache(maxsize=64)
+def _chunk_fn(cfg: ModelConfig, sampling: SamplingConfig, n_steps: int,
+              visible: int):
+    """Jitted ``n_steps`` decode positions for every slot at once.
+
+    Module-cached on (cfg, sampling, n_steps, visible) so every engine
+    (and test) in a process reuses one executable per bucket.
+    """
+    total = cfg.total_seq_len
+    text_len = cfg.text_seq_len
+
+    # params ride as an ARGUMENT (not a closure) so the lru_cache is
+    # valid across engines serving different checkpoints of one shape
+    def run(params, state: EngineState) -> EngineState:
+        def one(st: EngineState, _):
+            active = st.pos < total
+            # done/free slots clamp to the last position; their writes
+            # land on a row the causal mask hides from any NEW occupant
+            # (a recycled slot rewrites rows 0..p before reading them)
+            pos_c = jnp.minimum(st.pos, total - 1)
+            logits, cache = decode_step(params, cfg, st.cache, st.tokens,
+                                        pos_c, visible=visible)
+            # per-slot RNG chain: split exactly once per decode step,
+            # mirroring generate_images' carry
+            both = jax.vmap(jax.random.split)(st.rngs)
+            sampled = jax.vmap(
+                lambda k, row: sample_logits(k, row[None, :], sampling)[0]
+            )(both[:, 1], logits)
+            # position p emits S_p, the input at p+1: teacher-forced to
+            # the caption while p is a text position, the sampled code
+            # once p is in the image block (generate_images parity)
+            tf_idx = jnp.minimum(pos_c, text_len - 1)
+            tf = jnp.take_along_axis(st.text, tf_idx[:, None], axis=1)[:, 0]
+            nxt = jnp.where(pos_c < text_len, tf, sampled)
+            # land image-position emissions in the per-slot code buffer
+            rows = jnp.arange(st.codes.shape[0])
+            img_idx = jnp.clip(pos_c - text_len, 0, cfg.image_seq_len - 1)
+            emit = active & (pos_c >= text_len)
+            new_vals = jnp.where(emit, sampled - cfg.vocab_text,
+                                 st.codes[rows, img_idx])
+            return EngineState(
+                cache=cache,
+                pos=jnp.where(active, st.pos + 1, st.pos),
+                tokens=jnp.where(active, nxt, st.tokens),
+                rngs=jnp.where(active[:, None], both[:, 0], st.rngs),
+                text=st.text,
+                codes=st.codes.at[rows, img_idx].set(new_vals)), None
+
+        state, _ = jax.lax.scan(one, state, None, length=n_steps)
+        return state
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _admit_fn(cfg: ModelConfig):
+    """Jitted slot (re)initialization: one compile per model config."""
+    bos = cfg.vocab_total
+
+    def admit(state: EngineState, slot, text_row, key) -> EngineState:
+        return EngineState(
+            cache=state.cache,
+            pos=state.pos.at[slot].set(0),
+            tokens=state.tokens.at[slot].set(bos),
+            rngs=state.rngs.at[slot].set(key),
+            text=state.text.at[slot].set(text_row),
+            codes=state.codes.at[slot].set(
+                jnp.zeros((cfg.image_seq_len,), jnp.int32)))
+
+    return jax.jit(admit)
+
+
+class RequestHandle:
+    """Future for one submitted request. ``result()`` blocks until the
+    engine (or the pixel worker, when attached) resolves it."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._payload: Optional[dict] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Payload dict: ``codes`` (image_seq_len,) int32 plus, with a
+        pixel pipeline, ``images``/``clip_score``; plus the timing row
+        (``latency_s``, ``ttft_s``, ``queue_wait_s``). Raises on
+        timeout or cancellation."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done within {timeout}s")
+        if "error" in self._payload:
+            raise RuntimeError(
+                f"request {self.request_id}: {self._payload['error']}")
+        return self._payload
+
+    def _resolve(self, payload: dict) -> None:
+        self._payload = payload
+        self._event.set()
+
+
+@dataclass
+class _Pending:
+    rid: int
+    text: np.ndarray
+    key: np.ndarray
+    handle: RequestHandle
+    first_code_seen: bool = field(default=False)
+
+
+class DecodeEngine:
+    """The continuous-batching engine. ``start()`` spawns the decode
+    thread (daemonized); ``stop()`` signals AND bounded-joins it — the
+    ``tests/test_thread_lifecycle.py`` discipline.
+
+    When a :class:`~dalle_tpu.serving.pixels.PixelPipeline` is attached
+    the engine hands each finished slot's codes to it and keeps
+    decoding; the pipeline resolves the request's handle (and its
+    completion metrics) after pixels + rerank. The engine owns the
+    pipeline's shutdown: ``stop()`` drains and reaps it.
+    """
+
+    def __init__(self, params, cfg: ModelConfig,
+                 serving: Optional[ServingConfig] = None,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 pixel_pipeline=None,
+                 metrics: Optional[ServingMetrics] = None):
+        serving = serving or ServingConfig()
+        serving.validate()
+        self._params = params
+        self._cfg = cfg
+        self._serving = serving
+        self._sampling = sampling
+        self._pixels = pixel_pipeline
+        s = serving.n_slots
+        total = cfg.total_seq_len
+        n_buckets = resolve_buckets(serving.decode_buckets, s)
+        self._bounds = bucket_bounds(total, n_buckets)
+        self._chunk = serving.steps_per_call
+        self.scheduler = SlotScheduler(s, kv_bytes_per_slot(cfg),
+                                       serving.kv_budget_mb)
+        self.metrics = metrics or ServingMetrics(
+            n_slots=s, interval_s=serving.metrics_interval_s)
+        if pixel_pipeline is not None:
+            # a pipeline built without metrics adopts the engine's —
+            # submit/admit and complete/fail must share one ledger
+            pixel_pipeline.bind_metrics(self.metrics)
+        self._state = EngineState(
+            cache=init_cache(cfg, s),
+            pos=jnp.full((s,), total, jnp.int32),
+            tokens=jnp.full((s,), cfg.vocab_total, jnp.int32),
+            rngs=jnp.zeros((s, 2), jnp.uint32),
+            text=jnp.zeros((s, cfg.text_seq_len), jnp.int32),
+            codes=jnp.zeros((s, cfg.image_seq_len), jnp.int32))
+        # engine-thread-only slot table: _Pending per occupied slot
+        self._slots: List[Optional[_Pending]] = [None] * s
+        self._cv = threading.Condition()
+        self._queue: List[_Pending] = []       # guarded by _cv
+        self._next_id = 0                      # guarded by _cv
+        self._stopping = False                 # guarded by _cv
+        self._draining = True                  # guarded by _cv
+        self._thread = threading.Thread(target=self._run,
+                                        name="decode-engine", daemon=True)
+
+    # -- public API -----------------------------------------------------
+
+    def start(self) -> "DecodeEngine":
+        self._thread.start()
+        return self
+
+    def submit(self, text_tokens, rng=0) -> RequestHandle:
+        """Queue one image request. ``text_tokens``: (text_seq_len,)
+        tokenizer ids; ``rng``: an int seed or a PRNG key — the SAME key
+        handed to ``generate_images`` samples the SAME codes."""
+        text = np.asarray(text_tokens, np.int32).reshape(-1)
+        if text.shape[0] != self._cfg.text_seq_len:
+            raise ValueError(
+                f"text must be ({self._cfg.text_seq_len},) tokenizer ids, "
+                f"got shape {text.shape}")
+        if np.ndim(rng) == 0:
+            key = np.asarray(jax.random.PRNGKey(int(rng)))
+        else:
+            key = np.asarray(rng)
+        key = key.astype(np.uint32).reshape(2)
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("engine is stopping; submit refused")
+            if len(self._queue) >= self._serving.queue_capacity:
+                raise RuntimeError(
+                    f"request queue full ({self._serving.queue_capacity})")
+            rid = self._next_id
+            self._next_id += 1
+            handle = RequestHandle(rid)
+            self._queue.append(_Pending(rid, text, key, handle))
+            self.metrics.record_submit(rid)
+            self._cv.notify()
+        return handle
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop the engine thread. ``drain=True`` finishes queued and
+        in-flight requests first (bounded by ``timeout``, default the
+        config's ``drain_timeout_s``); ``drain=False`` cancels
+        everything outstanding immediately. Also drains and reaps an
+        attached pixel pipeline. Idempotent; safe before ``start()``."""
+        timeout = (self._serving.drain_timeout_s
+                   if timeout is None else timeout)
+        with self._cv:
+            self._stopping = True
+            self._draining = drain
+            self._cv.notify_all()
+        if self._thread.ident is not None:        # started at least once
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning("decode engine thread did not drain within "
+                               "%.1fs; abandoning in-flight work", timeout)
+        else:                                     # never started: nothing
+            self._cancel_outstanding()            # will run the loop exit
+        if self._pixels is not None:
+            self._pixels.stop()
+
+    @property
+    def cfg(self) -> ModelConfig:
+        return self._cfg
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._bounds)
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        with self._cv:
+            snap["queue_depth"] = len(self._queue)
+        snap["n_slots"] = self._serving.n_slots
+        snap["max_live_slots"] = self.scheduler.max_live
+        return snap
+
+    # -- engine thread --------------------------------------------------
+
+    def _visible_for(self, max_end_pos: int) -> int:
+        """Smallest bucket bound covering every position this chunk will
+        decode (callers of decode_step guarantee pos < visible)."""
+        for bound in self._bounds:
+            if bound >= max_end_pos:
+                return bound
+        return self._cfg.total_seq_len
+
+    def _admit(self, pending: _Pending, slot: int) -> None:
+        self._state = _admit_fn(self._cfg)(
+            self._state, jnp.int32(slot), jnp.asarray(pending.text),
+            jnp.asarray(pending.key))
+        self._slots[slot] = pending
+        self.metrics.record_admit(pending.rid)
+
+    def _harvest(self, slot: int) -> None:
+        pending = self._slots[slot]
+        self._slots[slot] = None
+        codes = np.asarray(self._state.codes[slot])
+        if self._pixels is not None:
+            self._pixels.submit(pending.handle, pending.rid, codes)
+        else:
+            row = self.metrics.record_complete(pending.rid)
+            pending.handle._resolve({"codes": codes, **row})
+
+    def _cancel_outstanding(self) -> None:
+        with self._cv:
+            leftover = list(self._queue)
+            self._queue.clear()
+        for pend in leftover + [p for p in self._slots if p is not None]:
+            self.metrics.record_cancelled(pend.rid)
+            pend.handle._resolve({"error": "cancelled at engine stop"})
+        self._slots = [None] * self._serving.n_slots
+
+    def _run(self) -> None:
+        try:
+            self._serve_loop()
+        except Exception:  # noqa: BLE001 - the engine thread is the only
+            # place these can surface; a hang-forever future is strictly
+            # worse than a cancelled one
+            logger.exception("decode engine crashed; cancelling "
+                             "outstanding requests")
+        finally:
+            # refuse further submits the moment the loop is gone — a
+            # crashed engine must fail fast (503 at the front-end), not
+            # queue requests no consumer will ever serve
+            with self._cv:
+                self._stopping = True
+            self._cancel_outstanding()
+
+    def _serve_loop(self) -> None:
+        total = self._cfg.total_seq_len
+        text_len = self._cfg.text_seq_len
+        while True:
+            with self._cv:
+                if self._stopping and not self._draining:
+                    break
+                free = [i for i, p in enumerate(self._slots) if p is None]
+                live = self._serving.n_slots - len(free)
+                n_admit = self.scheduler.grant(len(self._queue), live, len(free))
+                admitted = [self._queue.pop(0) for _ in range(n_admit)]
+                queue_depth = len(self._queue)
+                if not admitted and live == 0:
+                    if self._stopping:
+                        break      # drained: queue empty, slots empty
+                    self._cv.wait(timeout=0.1)
+                    idle = True
+                else:
+                    idle = False
+            if idle:
+                # the JSONL trace must keep ticking while idle — a
+                # silent gap is indistinguishable from a dead server
+                self.metrics.maybe_flush()
+                continue
+            for pending, slot in zip(admitted, free):
+                self._admit(pending, slot)
+
+            pos_before = np.asarray(self._state.pos)
+            live_slots = [i for i, p in enumerate(self._slots)
+                          if p is not None]
+            max_end = max(int(pos_before[i]) for i in live_slots) + self._chunk
+            visible = self._visible_for(min(max_end, total))
+            self._state = _chunk_fn(self._cfg, self._sampling, self._chunk,
+                                    visible)(self._params, self._state)
+            pos_after = np.asarray(self._state.pos)
+
+            self.metrics.record_step(len(live_slots), queue_depth)
+            for slot in live_slots:
+                pending = self._slots[slot]
+                if not pending.first_code_seen \
+                        and int(pos_after[slot]) > text_len:
+                    pending.first_code_seen = True
+                    self.metrics.record_first_code(pending.rid)
+                if int(pos_after[slot]) >= total:
+                    self._harvest(slot)
+            self.metrics.maybe_flush()

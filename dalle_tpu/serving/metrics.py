@@ -1,0 +1,168 @@
+"""Serving metrics: per-request accounting + engine-level counters.
+
+Per request: queue wait (submit -> admit), TTFT (submit -> first image
+code), latency (submit -> final artifact, pixels included when the
+overlap worker runs). Engine-level: occupancy (live slots / n_slots,
+sampled every step call), queue depth, img/s, p50/p95. A JSONL sink
+appends one snapshot row per ``interval_s`` so a run leaves an
+auditable trace the way the trainer's ``--metrics-file`` does.
+
+Thread-safety: the engine thread, the pixel worker and HTTP handler
+threads all report here; every mutation holds ``_lock``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# completed-request records kept for percentile computation; FIFO-capped
+# so a long-lived server's metrics stay O(1)
+_MAX_RECORDS = 16384
+
+
+def percentiles(values: List[float], qs=(50.0, 95.0)) -> List[float]:
+    """Linear-interpolated percentiles ([] -> NaNs)."""
+    if not values:
+        return [float("nan")] * len(qs)
+    arr = np.asarray(values, np.float64)
+    return [float(np.percentile(arr, q)) for q in qs]
+
+
+class ServingMetrics:
+    def __init__(self, n_slots: int, jsonl_path: Optional[str] = None,
+                 interval_s: float = 5.0):
+        self.n_slots = n_slots
+        self._jsonl_path = jsonl_path
+        self._interval_s = interval_s
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._last_flush = self._t0
+        self._submit_t: Dict[int, float] = {}
+        self._admit_t: Dict[int, float] = {}
+        self._ttft: Dict[int, float] = {}
+        self._records: List[dict] = []
+        self._submitted = 0
+        self._admitted = 0
+        self._completed = 0
+        self._cancelled = 0
+        self._failed = 0
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._depth_sum = 0.0
+        self._depth_max = 0
+        self._depth_n = 0
+
+    # -- per-request lifecycle ------------------------------------------
+
+    def record_submit(self, rid: int) -> None:
+        with self._lock:
+            self._submitted += 1
+            self._submit_t[rid] = time.monotonic()
+
+    def record_admit(self, rid: int) -> None:
+        with self._lock:
+            self._admitted += 1
+            self._admit_t[rid] = time.monotonic()
+
+    def record_first_code(self, rid: int) -> None:
+        """First image code emitted (chunk-boundary granularity)."""
+        with self._lock:
+            if rid not in self._ttft and rid in self._submit_t:
+                self._ttft[rid] = time.monotonic() - self._submit_t[rid]
+
+    def record_complete(self, rid: int) -> dict:
+        """Close out a request; returns its timing row (attached to the
+        response by the front-end)."""
+        now = time.monotonic()
+        with self._lock:
+            t_sub = self._submit_t.pop(rid, now)
+            t_adm = self._admit_t.pop(rid, t_sub)
+            row = {
+                "request_id": rid,
+                "queue_wait_s": round(t_adm - t_sub, 6),
+                "ttft_s": round(self._ttft.pop(rid, now - t_sub), 6),
+                "latency_s": round(now - t_sub, 6),
+            }
+            self._completed += 1
+            self._records.append(row)
+            if len(self._records) > _MAX_RECORDS:
+                del self._records[: len(self._records) - _MAX_RECORDS]
+            return row
+
+    def record_cancelled(self, rid: int) -> None:
+        with self._lock:
+            self._cancelled += 1
+            self._submit_t.pop(rid, None)
+            self._admit_t.pop(rid, None)
+            self._ttft.pop(rid, None)
+
+    def record_failed(self, rid: int) -> None:
+        """A request that errored downstream (e.g. the pixel stage):
+        closed out WITHOUT feeding the completion count or the latency
+        percentiles — a burst of fast failures must not read as
+        higher throughput on /stats."""
+        with self._lock:
+            self._failed += 1
+            self._submit_t.pop(rid, None)
+            self._admit_t.pop(rid, None)
+            self._ttft.pop(rid, None)
+
+    # -- engine-level sampling ------------------------------------------
+
+    def record_step(self, live_slots: int, queue_depth: int) -> None:
+        """Sampled by the engine at every jitted-call boundary."""
+        with self._lock:
+            self._occ_sum += live_slots / max(1, self.n_slots)
+            self._occ_n += 1
+            self._depth_sum += queue_depth
+            self._depth_max = max(self._depth_max, queue_depth)
+            self._depth_n += 1
+
+    # -- reporting ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = [r["latency_s"] for r in self._records]
+            ttft = [r["ttft_s"] for r in self._records]
+            p50, p95 = percentiles(lat)
+            t50, t95 = percentiles(ttft)
+            elapsed = max(1e-9, time.monotonic() - self._t0)
+            return {
+                "uptime_s": round(elapsed, 3),
+                "submitted": self._submitted,
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "cancelled": self._cancelled,
+                "failed": self._failed,
+                "img_per_s": round(self._completed / elapsed, 4),
+                "p50_latency_s": round(p50, 6),
+                "p95_latency_s": round(p95, 6),
+                "p50_ttft_s": round(t50, 6),
+                "p95_ttft_s": round(t95, 6),
+                "mean_occupancy": round(
+                    self._occ_sum / self._occ_n, 4) if self._occ_n else 0.0,
+                "mean_queue_depth": round(
+                    self._depth_sum / self._depth_n,
+                    4) if self._depth_n else 0.0,
+                "max_queue_depth": self._depth_max,
+            }
+
+    def maybe_flush(self) -> None:
+        """Append one snapshot row to the JSONL sink if the interval
+        elapsed (no-op without a path). Called from the engine loop."""
+        if not self._jsonl_path or self._interval_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_flush < self._interval_s:
+                return
+            self._last_flush = now
+        row = self.snapshot()
+        row["t"] = time.time()
+        with open(self._jsonl_path, "a") as f:
+            f.write(json.dumps(row) + "\n")

@@ -1,0 +1,100 @@
+"""Pixel stage overlap: VQGAN decode + CLIP rerank of finished slots.
+
+The one-shot CLI runs the reference pipeline serially — generate all
+codes, then VQGAN-decode to pixels, then CLIP-score (``decode_bench.py``
+e2e measures exactly that serialization). Online, that puts the conv
+stack and the ViT forward on the token-generation critical path. This
+worker moves them off it: the engine hands each finished slot's codes to
+a bounded queue and keeps decoding wave *i+1* while this thread turns
+wave *i* into pixels and scores.
+
+One worker, bounded queue, daemonized, signalled AND bounded-joined by
+``stop()`` — the ``tests/test_thread_lifecycle.py`` no-stray-threads
+discipline (same shape as ``training/remote_sink.UploadWorker``). The
+bounded queue is deliberate backpressure: if the pixel stage truly is
+the bottleneck, the engine blocks on submit rather than queueing
+unboundedly.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from dalle_tpu.serving.metrics import ServingMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class PixelPipeline:
+    """Runs ``pixel_fn(codes) -> dict`` per finished request on a worker
+    thread and resolves the request's handle with codes + that dict.
+
+    ``pixel_fn`` takes an (image_seq_len,) int32 code row and returns a
+    dict to merge into the result payload — typically ``{"images":
+    (H, W, 3) uint8}`` and optionally ``{"clip_score": float}``. It runs
+    only on this thread, so a jitted closure needs no locking.
+    """
+
+    def __init__(self, pixel_fn: Callable[[np.ndarray], dict],
+                 metrics: Optional[ServingMetrics] = None,
+                 maxsize: int = 32):
+        self._fn = pixel_fn
+        self._metrics = metrics
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._thread = threading.Thread(target=self._run,
+                                        name="pixel-worker", daemon=True)
+        self._thread.start()
+
+    def bind_metrics(self, metrics: ServingMetrics) -> None:
+        """Adopt the engine's metrics when none were given at
+        construction (DecodeEngine calls this) so completions recorded
+        here and submissions recorded there land in one ledger."""
+        if self._metrics is None:
+            self._metrics = metrics
+
+    def submit(self, handle, rid: int, codes: np.ndarray) -> None:
+        """Blocking put — backpressure when the pixel stage lags."""
+        self._q.put((handle, rid, codes))
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Drain everything already queued, then reap the worker. The
+        sentinel rides the FIFO behind pending jobs, so every handed-off
+        request still resolves. Bounded even when the worker is wedged
+        mid-job with a full queue (the sentinel put itself times out
+        rather than blocking shutdown forever)."""
+        try:
+            self._q.put(None, timeout=timeout)
+        except queue.Full:
+            logger.warning("pixel queue still full after %.1fs; "
+                           "abandoning the worker (daemon)", timeout)
+            return
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            logger.warning("pixel worker did not drain within %.1fs",
+                           timeout)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            handle, rid, codes = item
+            try:
+                extra = self._fn(codes)
+            except Exception as e:  # noqa: BLE001 - a pixel-stage failure
+                # must fail THAT request, never kill the worker the
+                # engine relies on for every later completion
+                logger.warning("pixel stage failed for request %d: %s",
+                               rid, e)
+                if self._metrics:   # failed, NOT completed: keep /stats
+                    self._metrics.record_failed(rid)   # throughput honest
+                handle._resolve({"error": f"pixel stage failed: {e}"})
+                continue
+            row = (self._metrics.record_complete(rid)
+                   if self._metrics else {})
+            handle._resolve({"codes": codes, **extra, **row})

@@ -1,0 +1,54 @@
+"""Admission control for the continuous-batching engine.
+
+Policy: FIFO over the request queue, admitted when (a) a cache slot is
+free and (b) the KV budget allows another live slot. Image generation is
+fixed-length (every request decodes exactly ``total_seq_len`` positions)
+so there is no preemption and no starvation: admission order is
+completion order up to slot-level skew.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dalle_tpu.config import ModelConfig
+
+
+def kv_bytes_per_slot(cfg: ModelConfig) -> int:
+    """KV-cache bytes one slot (batch row) owns, from the real cache
+    pytree via ``eval_shape`` — stays correct for both the cycle-carry
+    and flat layouts without re-deriving either."""
+    from dalle_tpu.models.decode import init_cache
+
+    shapes = jax.eval_shape(lambda: init_cache(cfg, 1))
+    return sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(shapes))
+
+
+class SlotScheduler:
+    """Free-slot + KV-budget admission.
+
+    ``kv_budget_mb`` caps how many slots may be LIVE at once:
+    ``floor(budget / bytes-per-slot)``, clamped to [1, n_slots]. The
+    cache is statically allocated at ``n_slots`` either way (XLA static
+    shapes); the budget models co-tenancy pressure — an engine sharing
+    HBM with a trainer admits fewer concurrent requests instead of
+    OOMing mid-flight.
+    """
+
+    def __init__(self, n_slots: int, bytes_per_slot: int,
+                 kv_budget_mb: Optional[int] = None):
+        self.n_slots = n_slots
+        self.bytes_per_slot = bytes_per_slot
+        if kv_budget_mb is None:
+            self.max_live = n_slots
+        else:
+            by_budget = (kv_budget_mb * 2 ** 20) // max(1, bytes_per_slot)
+            self.max_live = int(max(1, min(n_slots, by_budget)))
+
+    def grant(self, queued: int, live: int, free: int) -> int:
+        """How many queued requests to admit this call boundary."""
+        return max(0, min(queued, free, self.max_live - live))

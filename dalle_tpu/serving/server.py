@@ -1,0 +1,135 @@
+"""Stdlib HTTP front-end for the decode engine.
+
+Endpoints (JSON in/out, no dependencies beyond the stdlib):
+
+- ``POST /generate`` — body ``{"text": "<caption>"}`` (needs a
+  tokenizer) or ``{"tokens": [...]}`` (raw ids, tests/benches), plus
+  optional ``"n_images"`` (default 1) and ``"seed"`` (default 0; image
+  *i* of a request uses ``fold_in(seed, i)`` so a multi-image query is
+  n independent single-image requests — exactly how the engine recycles
+  slots). Blocks until every image resolves; the response carries each
+  request's codes (and ``clip_score`` when the pixel stage reranks)
+  with its TTFT / latency / queue-wait accounting.
+- ``GET /stats``  — the metrics snapshot + live queue depth.
+- ``GET /healthz`` — liveness + slot occupancy.
+
+One handler thread per in-flight connection (``ThreadingHTTPServer``,
+daemonized); the engine's queue capacity is the real admission bound —
+a full queue surfaces as HTTP 503.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True   # connection threads must not block exit
+
+    def __init__(self, address, engine, tokenizer=None,
+                 request_timeout_s: float = 300.0):
+        super().__init__(address, _Handler)
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.request_timeout_s = request_timeout_s
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: ServingHTTPServer
+
+    # stdlib logs every request to stderr by default; route to logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        logger.debug("%s " + fmt, self.client_address[0], *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib handler contract
+        engine = self.server.engine
+        if self.path == "/healthz":
+            stats = engine.stats()
+            self._reply(200, {"ok": True,
+                              "n_slots": stats["n_slots"],
+                              "queue_depth": stats["queue_depth"],
+                              "completed": stats["completed"]})
+        elif self.path == "/stats":
+            self._reply(200, engine.stats())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib handler contract
+        if self.path != "/generate":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            tokens = self._tokens_from(body)
+            n_images = int(body.get("n_images", 1))
+            seed = int(body.get("seed", 0))
+            if not (1 <= n_images <= 64):
+                raise ValueError(f"n_images must be in [1, 64], "
+                                 f"got {n_images}")
+            base = jax.random.PRNGKey(seed)   # rejects out-of-range seeds
+        except (ValueError, KeyError, TypeError, OverflowError,
+                json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+
+        try:
+            handles = [self.server.engine.submit(
+                tokens, np.asarray(jax.random.fold_in(base, i)))
+                for i in range(n_images)]
+        except ValueError as e:         # wrong-length token vector
+            self._reply(400, {"error": str(e)})
+            return
+        except RuntimeError as e:       # queue full / engine stopping;
+            # NOTE a mid-loop failure discards already-submitted sibling
+            # handles — those images still decode and are dropped (the
+            # engine has no mid-flight cancel yet; ROADMAP serving track)
+            self._reply(503, {"error": str(e)})
+            return
+        results = []
+        for h in handles:
+            try:
+                payload = h.result(timeout=self.server.request_timeout_s)
+            except TimeoutError as e:
+                self._reply(504, {"error": str(e)})
+                return
+            except RuntimeError as e:   # pixel-stage failure / cancelled:
+                # a deterministic server error, NOT a timeout — retrying
+                # it verbatim would just duplicate full-decode work
+                self._reply(500, {"error": str(e)})
+                return
+            row = {k: v for k, v in payload.items() if k != "images"}
+            row["codes"] = np.asarray(payload["codes"]).tolist()
+            if "images" in payload:     # pixels stay binary-free: shape only
+                row["image_shape"] = list(np.asarray(
+                    payload["images"]).shape)
+            results.append(row)
+        self._reply(200, {"seed": seed, "results": results})
+
+    def _tokens_from(self, body: dict):
+        if "tokens" in body:
+            return np.asarray(body["tokens"], np.int32)
+        if "text" in body:
+            if self.server.tokenizer is None:
+                raise ValueError(
+                    "server started without --tokenizer-path; "
+                    "submit pre-tokenized ids via 'tokens'")
+            text_len = self.server.engine.cfg.text_seq_len
+            ids, _ = self.server.tokenizer.encode(body["text"], text_len)
+            return np.asarray(ids, np.int32)
+        raise ValueError("body needs 'text' or 'tokens'")

@@ -1,0 +1,336 @@
+"""Serving A/B: static-batch lockstep vs the continuous-batching engine.
+
+Replays ONE seeded Poisson arrival trace against two servers built from
+the same params / sampling / pixel stage:
+
+- **static**: the pre-engine serving strategy — requests wait for batch
+  formation (S queued, or a timeout after the oldest arrival), the
+  whole batch decodes in lockstep (``generate_images``, padded to S),
+  then VQGAN pixels + CLIP rerank run SERIALLY for each finished
+  request, exactly the one-shot CLI's pipeline shape.
+- **engine**: ``serving.DecodeEngine`` — requests admitted into free KV
+  slots mid-flight, slots recycled on completion, pixels + rerank
+  overlapped on the bounded worker thread.
+
+Both rows record img/s, p50/p95 request latency (arrival -> pixels
+done), decode-slot occupancy and queue depth. The offered load is
+calibrated ABOVE static capacity (``--load``, default 2x) so the A/B
+measures sustained throughput under backlog, the regime the ROADMAP's
+"heavy traffic" north star cares about; the raggedness of the Poisson
+trace is what starves static batch formation early and late in the run.
+
+The model is a CPU-sized shape (96 positions, dim 128) — big enough
+that jitted work dominates host overhead, small enough to finish in
+minutes; weight values are random (cost does not depend on them).
+
+Run:  python scripts/serve_bench.py [--requests 48] [--slots 4]
+      [--load 2.0] [--seed 0] [--quick]
+
+Appends driver-readable JSON lines (static row, engine row, summary) to
+SERVE_BENCH.json at the repo root. Methodology notes: SERVING.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dalle_tpu.config import ServingConfig, tiny_model_config  # noqa: E402
+from dalle_tpu.models.clip import (clip_scores, resize_for_clip,  # noqa: E402
+                                   tiny_clip_config)
+from dalle_tpu.models.dalle import DALLE, init_params  # noqa: E402
+from dalle_tpu.models.decode import (SamplingConfig,  # noqa: E402
+                                     generate_images, resolve_buckets)
+from dalle_tpu.models.vqgan import tiny_vqgan_config  # noqa: E402
+from dalle_tpu.serving.engine import DecodeEngine  # noqa: E402
+from dalle_tpu.serving.metrics import ServingMetrics, percentiles  # noqa: E402
+from dalle_tpu.serving.pixels import PixelPipeline  # noqa: E402
+
+
+def bench_model_config():
+    """The serve-bench shape: 32 text + 8x8 image positions at dim 128.
+    ~100x the test-tiny step FLOPs so the jitted decode (not the host
+    loop) is what both servers spend their time on."""
+    return tiny_model_config(text_seq_len=32, image_grid=8, dim=128,
+                             heads=4, head_dim=32, depth=4)
+
+
+def build_pixel_fn(cfg):
+    """Jitted per-request codes -> pixels + CLIP score at bench scale
+    (random weights, decode_bench e2e's trick): VQGAN upconv stack to
+    32px + a small ViT rerank. This is the stage the engine overlaps
+    and the static baseline serializes."""
+    from dalle_tpu.models.clip import CLIPModel
+    from dalle_tpu.models.vqgan import VQGANDecoder, decode_codes
+
+    vq_cfg = tiny_vqgan_config(n_embed=cfg.vocab_image, ch=48,
+                               num_res_blocks=2, resolution=32)
+    assert vq_cfg.code_grid == cfg.image_grid
+    cl_cfg = tiny_clip_config(image_size=32, patch_size=8,
+                              vision_width=128, vision_layers=4,
+                              vision_heads=4, text_width=64,
+                              text_layers=2, text_heads=2)
+    code_tpl = jnp.zeros((1, cfg.image_seq_len), jnp.int32)
+    vq_params = jax.eval_shape(
+        lambda k: VQGANDecoder(vq_cfg).init(k, code_tpl),
+        jax.random.PRNGKey(0))
+    vq_params = jax.tree.map(
+        lambda s: jax.random.normal(jax.random.PRNGKey(3), s.shape,
+                                    s.dtype) * 0.02, vq_params)
+    img_tpl = jnp.zeros((1, cl_cfg.image_size, cl_cfg.image_size, 3),
+                        jnp.float32)
+    tok_tpl = jnp.ones((1, cl_cfg.context_length), jnp.int32)
+    cl_params = jax.eval_shape(
+        lambda k: CLIPModel(cl_cfg).init(k, img_tpl, tok_tpl),
+        jax.random.PRNGKey(1))
+    cl_params = jax.tree.map(
+        lambda s: jax.random.normal(jax.random.PRNGKey(4), s.shape,
+                                    s.dtype) * 0.02, cl_params)
+
+    @jax.jit
+    def _stage(codes_row):
+        imgs = decode_codes(vq_params, vq_cfg, codes_row[None, :])
+        scores = clip_scores(cl_params, cl_cfg,
+                             resize_for_clip(imgs, cl_cfg), tok_tpl)
+        return imgs[0], scores[0, 0]
+
+    def pixel_fn(codes):
+        imgs, score = _stage(jnp.asarray(codes))
+        return {"images": np.asarray(imgs),
+                "clip_score": float(np.asarray(score))}
+
+    return pixel_fn
+
+
+def make_trace(n, mean_gap, seed):
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(mean_gap, n)
+    gaps[0] = 0.0
+    return np.cumsum(gaps)
+
+
+def run_static(gen, params, texts, keys, arrivals, slots,
+               batch_timeout, pixel_fn):
+    """The whole-batch lockstep server on one thread + an arrival
+    feeder. Requests wait for batch formation; the batch decodes in
+    lockstep; pixels run serially per request afterward. ``gen`` is the
+    already-warm jitted generate_images (the calibration pass compiled
+    it) so no compile lands inside the timed window."""
+    n = len(texts)
+    waiting = deque()
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def feeder():
+        for i in range(n):
+            delay = t0 + arrivals[i] - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            with lock:
+                waiting.append((i, time.monotonic()))
+
+    feeder_thread = threading.Thread(target=feeder, daemon=True)
+    feeder_thread.start()
+
+    done_t = np.zeros(n)
+    arrive_t = np.zeros(n)
+    occupancies, depths = [], []
+    completed = 0
+    while completed < n:
+        with lock:
+            k = len(waiting)
+            oldest = waiting[0][1] if k else None
+        remaining = n - completed
+        ready = (k >= min(slots, remaining)
+                 or (k and time.monotonic() - oldest >= batch_timeout))
+        if not ready:
+            time.sleep(0.002)
+            continue
+        with lock:
+            batch = [waiting.popleft() for _ in range(min(slots, k))]
+            depths.append(len(waiting))
+        idxs = [i for i, _ in batch]
+        # pad to the static batch size: lockstep decode burns full-batch
+        # compute regardless of how many real requests formed
+        rows = idxs + [idxs[0]] * (slots - len(idxs))
+        text_b = jnp.asarray(np.stack([texts[i] for i in rows]))
+        codes = np.asarray(gen(params, text_b, keys[idxs[0]]))
+        occupancies.append(len(idxs) / slots)
+        # pixel stage serializes behind decode (the one-shot pipeline)
+        for j, (i, t_arr) in enumerate(batch):
+            pixel_fn(codes[j])
+            arrive_t[i] = t_arr
+            done_t[i] = time.monotonic()
+        completed += len(batch)
+    feeder_thread.join(timeout=10)
+    lat = (done_t - arrive_t).tolist()
+    p50, p95 = percentiles(lat)
+    makespan = done_t.max() - t0
+    return {
+        "img_per_s": round(n / makespan, 4),
+        "p50_latency_s": round(p50, 4),
+        "p95_latency_s": round(p95, 4),
+        "mean_occupancy": round(float(np.mean(occupancies)), 4),
+        "mean_queue_depth": round(float(np.mean(depths)), 4),
+        "max_queue_depth": int(np.max(depths)),
+        "makespan_s": round(makespan, 3),
+        "batches": len(occupancies),
+    }
+
+
+def run_engine(params, cfg, sam, texts, keys, arrivals, slots, chunk,
+               pixel_fn):
+    n = len(texts)
+    metrics = ServingMetrics(n_slots=slots)
+    pipeline = PixelPipeline(pixel_fn, metrics=metrics)
+    engine = DecodeEngine(
+        params, cfg,
+        ServingConfig(n_slots=slots, steps_per_call=chunk,
+                      queue_capacity=max(64, n)),
+        sampling=sam, pixel_pipeline=pipeline, metrics=metrics).start()
+    t0 = time.monotonic()
+    handles, submit_t = [], []
+    for i in range(n):
+        delay = t0 + arrivals[i] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        submit_t.append(time.monotonic())
+        handles.append(engine.submit(texts[i], keys[i]))
+    lat, done_walls = [], []
+    for t_sub, h in zip(submit_t, handles):
+        row = h.result(timeout=600)
+        lat.append(row["latency_s"])
+        done_walls.append(t_sub + row["latency_s"])
+    engine.stop()
+    snap = metrics.snapshot()
+    p50, p95 = percentiles(lat)
+    makespan = max(done_walls) - t0
+    return {
+        "img_per_s": round(n / makespan, 4),
+        "p50_latency_s": round(p50, 4),
+        "p95_latency_s": round(p95, 4),
+        "mean_occupancy": snap["mean_occupancy"],
+        "mean_queue_depth": snap["mean_queue_depth"],
+        "max_queue_depth": snap["max_queue_depth"],
+        "makespan_s": round(makespan, 3),
+        "n_buckets": engine.n_buckets,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--load", type=float, default=2.0,
+                    help="offered load as a multiple of measured static "
+                         "capacity (>1 = backlog regime)")
+    ap.add_argument("--steps-per-call", type=int, default=8)
+    ap.add_argument("--batch-timeout-frac", type=float, default=0.5,
+                    help="static batch formation timeout as a fraction "
+                         "of one static batch service time")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quick", action="store_true",
+                    help="8 requests (CI smoke; numbers not meaningful)")
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+    n = 8 if args.quick else args.requests
+    slots = args.slots
+
+    cfg = bench_model_config()
+    sam = SamplingConfig(temperature=1.0, top_k=32)
+    params = init_params(DALLE(cfg), jax.random.PRNGKey(0))
+    pixel_fn = build_pixel_fn(cfg)
+
+    rng = np.random.default_rng(args.seed)
+    texts = [rng.integers(2, cfg.vocab_text, cfg.text_seq_len,
+                          dtype=np.int64).astype(np.int32)
+             for _ in range(n)]
+    base = jax.random.PRNGKey(args.seed)
+    keys = [np.asarray(jax.random.fold_in(base, i)) for i in range(n)]
+
+    # -- calibration + warmup (compiles everything both runs use) ------
+    buckets = resolve_buckets(None, slots)
+    gen = jax.jit(lambda p, t, r: generate_images(
+        p, cfg, t, r, sam, buckets=buckets))
+    text_b = jnp.asarray(np.stack(texts[:1] * slots))
+    t0 = time.monotonic()
+    codes = np.asarray(gen(params, text_b, jax.random.PRNGKey(7)))
+    pixel_fn(codes[0])
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    codes = np.asarray(gen(params, text_b, jax.random.PRNGKey(8)))
+    for j in range(slots):
+        pixel_fn(codes[j])
+    t_static_batch = time.monotonic() - t0
+    print(f"calibration: static batch of {slots} takes "
+          f"{t_static_batch:.2f}s e2e (compile {compile_s:.1f}s)",
+          flush=True)
+    # warm the engine's chunk/admit executables on a throwaway engine
+    warm = DecodeEngine(
+        params, cfg, ServingConfig(n_slots=slots,
+                                   steps_per_call=args.steps_per_call),
+        sampling=sam).start()
+    warm_handles = [warm.submit(texts[i % n], keys[i % n])
+                    for i in range(slots)]
+    for h in warm_handles:
+        h.result(timeout=600)
+    warm.stop()
+
+    mean_gap = t_static_batch / (slots * args.load)
+    arrivals = make_trace(n, mean_gap, args.seed)
+    batch_timeout = args.batch_timeout_frac * t_static_batch
+    print(f"trace: {n} requests, Poisson mean gap {mean_gap * 1e3:.0f}ms "
+          f"(load {args.load}x static), batch timeout "
+          f"{batch_timeout:.2f}s", flush=True)
+
+    static = run_static(gen, params, texts, keys, arrivals, slots,
+                        batch_timeout, pixel_fn)
+    print(f"static: {static}", flush=True)
+    engine = run_engine(params, cfg, sam, texts, keys, arrivals, slots,
+                        args.steps_per_call, pixel_fn)
+    print(f"engine: {engine}", flush=True)
+
+    speedup = engine["img_per_s"] / max(1e-9, static["img_per_s"])
+    p95_ok = engine["p95_latency_s"] <= static["p95_latency_s"]
+    summary = {
+        "speedup": round(speedup, 3),
+        "p95_ok": bool(p95_ok),
+        "target_met": bool(speedup >= 1.3 and p95_ok),
+    }
+    print(f"summary: {summary}", flush=True)
+
+    shared = {
+        "metric": "serve-bench img/s (e2e: decode+VQGAN+CLIP)",
+        "n_requests": n,
+        "slots": slots,
+        "load_factor": args.load,
+        "mean_gap_s": round(mean_gap, 4),
+        "trace_seed": args.seed,
+        "quick": bool(args.quick),
+    }
+    out_path = args.out or os.path.join(os.path.dirname(__file__), "..",
+                                        "SERVE_BENCH.json")
+    with open(out_path, "a") as f:
+        f.write(json.dumps({**shared, "mode": "static",
+                            "batch_timeout_s": round(batch_timeout, 3),
+                            **static}) + "\n")
+        f.write(json.dumps({**shared, "mode": "engine",
+                            "steps_per_call": args.steps_per_call,
+                            **engine}) + "\n")
+        f.write(json.dumps({**shared, "mode": "summary",
+                            **summary}) + "\n")
+    return 0 if summary["target_met"] or args.quick else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

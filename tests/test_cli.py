@@ -170,6 +170,90 @@ class TestTrainerCLI:
         assert any(archive.glob("ckpt_*.msgpack")), out_aux[-4000:]
 
 
+class TestTrainerWandb:
+    """--wandb-project on the trainer, mirroring the aux-peer sink
+    (VERDICT missing #3). No real wandb in this container: a stub module
+    is injected, which is exactly the optional-dependency contract."""
+
+    def _stub_wandb(self, monkeypatch, fail=False):
+        import sys as _sys
+        import types
+
+        calls = {"init": [], "log": [], "finish": 0}
+
+        class _Run:
+            def log(self, row):
+                calls["log"].append(row)
+
+            def finish(self):
+                calls["finish"] += 1
+
+        stub = types.ModuleType("wandb")
+        if fail:
+            def _init(**kw):
+                raise OSError("no network")
+        else:
+            def _init(**kw):
+                calls["init"].append(kw)
+                return _Run()
+        stub.init = _init
+        monkeypatch.setitem(_sys.modules, "wandb", stub)
+        return calls
+
+    def test_parser_accepts_wandb_project(self):
+        from dalle_tpu.cli.run_trainer import build_parser
+
+        args = build_parser().parse_args(["--wandb-project", "dalle-serve"])
+        assert args.wandb_project == "dalle-serve"
+        # the aux peer keeps its own flag (both mirror one helper)
+        from dalle_tpu.cli.run_aux_peer import build_parser as aux_parser
+        assert aux_parser().parse_args(
+            ["--wandb-project", "x"]).wandb_project == "x"
+
+    def test_epoch_sink_logs_to_wandb_and_file(self, tmp_path,
+                                               monkeypatch):
+        from types import SimpleNamespace
+
+        from dalle_tpu.cli.run_trainer import (make_epoch_sink,
+                                               maybe_wandb_run)
+
+        calls = self._stub_wandb(monkeypatch)
+        run = maybe_wandb_run("proj", "trainer-test")
+        assert run is not None and calls["init"][0]["project"] == "proj"
+
+        metrics = tmp_path / "m.jsonl"
+        sink = make_epoch_sink(str(metrics), run,
+                               timings_fn=lambda: {"allreduce_s": 1.5})
+        sink(SimpleNamespace(epoch=3, loss=2.25, mini_steps=8,
+                             samples_per_second=12.0))
+        rows = [json.loads(line)
+                for line in metrics.read_text().splitlines()]
+        assert rows[0]["epoch"] == 3 and rows[0]["loss"] == 2.25
+        assert rows[0]["timings"] == {"allreduce_s": 1.5}
+        assert calls["log"] == [{"epoch": 3, "loss": 2.25,
+                                 "mini_steps": 8,
+                                 "samples_per_second": 12.0,
+                                 "timings/allreduce_s": 1.5}]
+        run.finish()
+        assert calls["finish"] == 1
+
+    def test_wandb_unavailable_is_nonfatal(self, monkeypatch, tmp_path):
+        from types import SimpleNamespace
+
+        from dalle_tpu.cli.run_trainer import (make_epoch_sink,
+                                               maybe_wandb_run)
+
+        self._stub_wandb(monkeypatch, fail=True)
+        assert maybe_wandb_run("proj", "n") is None
+        assert maybe_wandb_run(None, "n") is None
+        # the JSONL sink still works without a run
+        metrics = tmp_path / "m.jsonl"
+        sink = make_epoch_sink(str(metrics), None)
+        sink(SimpleNamespace(epoch=0, loss=1.0, mini_steps=1,
+                             samples_per_second=1.0))
+        assert metrics.exists()
+
+
 class TestFleetCLI:
     def test_dry_run_prints_gcloud_commands(self, capsys):
         from dalle_tpu.cli.manage_fleet import main

@@ -10,7 +10,8 @@ from dalle_tpu.config import tiny_model_config
 from dalle_tpu.models.dalle import DALLE, init_params
 from dalle_tpu.models.decode import (SamplingConfig, decode_step,
                                      generate_images, init_cache,
-                                     layer_params, sample_logits)
+                                     layer_params, resolve_buckets,
+                                     sample_logits)
 
 
 def _setup(**overrides):
@@ -133,6 +134,21 @@ class TestSampling:
                                    return_logits=True)
         pred = np.asarray(jnp.argmax(logits[:, cfg.text_seq_len:], -1))
         np.testing.assert_array_equal(pred - cfg.vocab_text, codes)
+
+def test_resolve_buckets_thresholds():
+    """The measured adaptive bucket policy (DECODE_BENCH.json r4:
+    B<=8 peaks at 4 buckets, B>=12 at 2; the threshold interpolates the
+    B=8/B=16 crossover). The serving engine REUSES this function for its
+    visible-bucket count (test_serving pins that), so these thresholds
+    are a shared contract, not a generate_images detail."""
+    for batch in range(1, 9):
+        assert resolve_buckets(None, batch) == 4
+    for batch in (9, 11, 12, 16, 64):
+        assert resolve_buckets(None, batch) == 2
+    # an explicit bucket count always wins over the adaptive choice
+    assert resolve_buckets(1, 4) == 1
+    assert resolve_buckets(7, 16) == 7
+
 
 def test_prefix_buckets_do_not_change_samples():
     """Bucketed decode (statically truncated cache reads) must produce

@@ -1,0 +1,455 @@
+"""Continuous-batching engine tests.
+
+The load-bearing invariant: the per-slot-position rewrite of
+``decode_step`` must not change numerics — a request decoded by the
+engine emits EXACTLY the codes ``generate_images`` samples for the same
+key/SamplingConfig. Pinned two ways: a single-slot engine (bit-identical
+math, guaranteed), and a multi-slot ragged run where co-tenant slots
+share the batch (XLA's batch-tiling wobble is ~1e-6 on logits; the
+sampled codes stay exact for these pinned seeds).
+
+Plus: slot recycling, KV-budget admission, metrics accounting, the
+pixel-overlap worker, the HTTP front-end, and the thread-lifecycle
+discipline (every serving thread daemonized AND reaped by stop()).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import ServingConfig, tiny_model_config
+from dalle_tpu.models.dalle import DALLE, init_params
+from dalle_tpu.models.decode import (SamplingConfig, bucket_bounds,
+                                     generate_images, init_cache,
+                                     resolve_buckets)
+from dalle_tpu.serving.engine import DecodeEngine
+from dalle_tpu.serving.metrics import ServingMetrics, percentiles
+from dalle_tpu.serving.pixels import PixelPipeline
+from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
+from dalle_tpu.serving.server import ServingHTTPServer
+
+SAM = SamplingConfig(temperature=1.0, top_k=8)
+
+# one flat-cache config + one cycle-structured (scan + wconv) config so
+# both decode_step cache layouts run the per-slot path
+FLAT = dict(attn_types=("axial_row", "axial_col"), depth=2)
+CYCLE = dict(attn_types=("axial_row", "axial_col", "axial_row",
+                         "axial_row"), depth=6, shared_block_cycle=4,
+             final_conv_block=True, conv_kernel=3)
+
+
+@pytest.fixture(scope="module")
+def flat_setup():
+    cfg = tiny_model_config(**FLAT)
+    params = init_params(DALLE(cfg), jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def cycle_setup():
+    cfg = tiny_model_config(**CYCLE)
+    params = init_params(DALLE(cfg), jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _texts(cfg, n, seed=100):
+    return [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed + i), (cfg.text_seq_len,), 2,
+        cfg.vocab_text)) for i in range(n)]
+
+
+def _solo_reference(params, cfg, text, key, buckets):
+    codes = generate_images(params, cfg, jnp.asarray(text[None]), key,
+                            SAM, buckets=buckets)
+    return np.asarray(codes)[0]
+
+
+class TestEngineParity:
+    def test_single_slot_matches_generate_images(self, flat_setup):
+        """THE acceptance invariant: one request through the engine ==
+        ``generate_images`` for the same seed, code for code. At
+        n_slots=1 the per-slot step is bit-identical to the lockstep
+        step (same shapes, same ops), so this can never flake."""
+        cfg, params = flat_setup
+        text = _texts(cfg, 1)[0]
+        key = jax.random.PRNGKey(1000)
+        ref = _solo_reference(params, cfg, text, key, buckets=4)
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4),
+                              sampling=SAM).start()
+        try:
+            got = engine.submit(text, key).result(timeout=300)
+        finally:
+            engine.stop()
+        np.testing.assert_array_equal(got["codes"], ref)
+        assert got["latency_s"] >= got["ttft_s"] >= 0
+
+    def test_single_slot_matches_on_cycle_layout(self, cycle_setup):
+        """Same invariant through the cycle-structured cache carry (the
+        flagship's layout): scatter writes into the (reps, cycle, B, T,
+        H*d) body + the wconv slot."""
+        cfg, params = cycle_setup
+        text = _texts(cfg, 1)[0]
+        key = jax.random.PRNGKey(2000)
+        ref = _solo_reference(params, cfg, text, key, buckets=1)
+        engine = DecodeEngine(
+            params, cfg,
+            ServingConfig(n_slots=1, steps_per_call=4, decode_buckets=1),
+            sampling=SAM).start()
+        try:
+            got = engine.submit(text, key).result(timeout=300)
+        finally:
+            engine.stop()
+        np.testing.assert_array_equal(got["codes"], ref)
+
+    def test_ragged_cotenancy_and_recycling_exact(self, flat_setup):
+        """5 requests through 2 slots: admissions are ragged (mid-flight
+        of other requests), every slot is recycled at least once, and
+        EVERY request still emits its solo-reference codes — co-tenants
+        cannot perturb each other's samples (pinned seeds)."""
+        cfg, params = flat_setup
+        texts = _texts(cfg, 5)
+        keys = [jax.random.PRNGKey(1000 + i) for i in range(5)]
+        refs = [_solo_reference(params, cfg, t, k, buckets=4)
+                for t, k in zip(texts, keys)]
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=2, steps_per_call=4),
+                              sampling=SAM).start()
+        try:
+            handles = []
+            for i, (t, k) in enumerate(zip(texts, keys)):
+                handles.append(engine.submit(t, k))
+                time.sleep(0.01 * i)  # stagger: admission lands mid-chunk
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            engine.stop()
+        for res, ref in zip(results, refs):
+            np.testing.assert_array_equal(res["codes"], ref)
+        stats = engine.stats()
+        assert stats["completed"] == 5
+        # 5 requests > 2 slots: recycling necessarily happened
+        assert stats["admitted"] == 5 and stats["n_slots"] == 2
+        assert 0 < stats["mean_occupancy"] <= 1.0
+
+
+class TestSchedulerAndBuckets:
+    def test_engine_reuses_resolve_buckets(self, flat_setup):
+        """The engine's bucket count comes FROM resolve_buckets (the
+        measured generate_images policy), not a re-derivation."""
+        cfg, params = flat_setup
+        for n_slots in (1, 4, 8, 12):
+            engine = DecodeEngine(params, cfg,
+                                  ServingConfig(n_slots=n_slots))
+            assert engine.n_buckets == resolve_buckets(None, n_slots)
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=4, decode_buckets=2))
+        assert engine.n_buckets == resolve_buckets(2, 4) == 2
+
+    def test_bucket_bounds_match_generate_images(self):
+        # ONE definition in models/decode.py, used by BOTH the lockstep
+        # scan and the engine's per-chunk visible choice
+        assert bucket_bounds(32, 4) == [8, 16, 24, 32]
+        assert bucket_bounds(1280, 2) == [640, 1280]
+        assert bucket_bounds(32, 1) == [32]
+
+    def test_scheduler_grant(self):
+        sched = SlotScheduler(4, bytes_per_slot=100)
+        assert sched.max_live == 4
+        assert sched.grant(queued=10, live=0, free=4) == 4
+        assert sched.grant(queued=1, live=2, free=2) == 1
+        assert sched.grant(queued=0, live=2, free=2) == 0
+        assert sched.grant(queued=5, live=4, free=0) == 0
+
+    def test_scheduler_kv_budget(self):
+        one_mb = 2 ** 20
+        sched = SlotScheduler(8, bytes_per_slot=one_mb, kv_budget_mb=3)
+        assert sched.max_live == 3
+        assert sched.grant(queued=8, live=2, free=6) == 1
+        # budget below one slot still admits one at a time
+        assert SlotScheduler(8, one_mb, kv_budget_mb=0).max_live == 1
+        # budget above n_slots clamps to n_slots
+        assert SlotScheduler(2, one_mb, kv_budget_mb=100).max_live == 2
+
+    def test_kv_bytes_per_slot_matches_cache(self, cycle_setup):
+        cfg, _ = cycle_setup
+        cache = init_cache(cfg, 1)
+        expect = sum(a.size * a.dtype.itemsize
+                     for a in jax.tree_util.tree_leaves(cache))
+        assert kv_bytes_per_slot(cfg) == expect
+
+    def test_kv_budget_caps_live_slots(self, flat_setup):
+        """n_slots=4 but a budget worth ~2 slots: at most 2 requests are
+        ever live, everything still completes via recycling."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=4, steps_per_call=4),
+                              sampling=SAM)
+        # tiny caches are ~100 KB/slot and the budget knob rounds whole
+        # MB, so inject a scheduler with a synthetic 1 MB/slot size: a
+        # 2 MB budget then caps live slots at 2 of the 4
+        engine.scheduler = SlotScheduler(4, bytes_per_slot=2 ** 20,
+                                         kv_budget_mb=2)
+        assert engine.scheduler.max_live == 2
+        engine.start()
+        max_live_seen = 0
+        try:
+            handles = [engine.submit(t, jax.random.PRNGKey(i))
+                       for i, t in enumerate(_texts(cfg, 4))]
+            while not all(h.done() for h in handles):
+                live = sum(p is not None for p in engine._slots)
+                max_live_seen = max(max_live_seen, live)
+                time.sleep(0.005)
+            for h in handles:
+                assert h.result(timeout=10)["codes"].shape == \
+                    (cfg.image_seq_len,)
+        finally:
+            engine.stop()
+        assert max_live_seen <= 2
+        assert engine.stats()["completed"] == 4
+
+
+class TestEngineLifecycle:
+    def test_submit_validates_and_bounds(self, flat_setup):
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, queue_capacity=1))
+        with pytest.raises(ValueError):
+            engine.submit(np.zeros(3, np.int32))
+        engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+        with pytest.raises(RuntimeError):     # queue full
+            engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+        engine.stop(drain=False)
+        with pytest.raises(RuntimeError):     # stopped
+            engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+
+    def test_stop_without_drain_cancels(self, flat_setup):
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg, ServingConfig(n_slots=1))
+        handle = engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+        engine.stop(drain=False)              # never started: cancel path
+        with pytest.raises(RuntimeError, match="cancelled"):
+            handle.result(timeout=5)
+        assert engine.stats()["cancelled"] == 1
+
+    def test_threads_daemonized_and_reaped(self, flat_setup):
+        """The test_thread_lifecycle invariant for the serving stack:
+        engine + pixel worker threads are daemons while alive and gone
+        after stop()."""
+        cfg, params = flat_setup
+        before = set(threading.enumerate())
+        pipeline = PixelPipeline(lambda codes: {"images": np.zeros(
+            (2, 2, 3), np.uint8)})
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4),
+                              sampling=SAM,
+                              pixel_pipeline=pipeline).start()
+        handle = engine.submit(_texts(cfg, 1)[0], jax.random.PRNGKey(3))
+        spawned = [t for t in threading.enumerate() if t not in before]
+        assert spawned and all(t.daemon for t in spawned), \
+            [t.name for t in spawned if not t.daemon]
+        assert handle.result(timeout=300)["images"].shape == (2, 2, 3)
+        engine.stop()                          # reaps pixel worker too
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                t.is_alive() for t in spawned):
+            time.sleep(0.02)
+        leaked = [t.name for t in spawned if t.is_alive()]
+        assert not leaked, f"threads outlived stop(): {leaked}"
+
+
+class TestPixelPipeline:
+    def test_failure_fails_request_not_worker(self, flat_setup):
+        cfg, params = flat_setup
+
+        calls = {"n": 0}
+
+        def flaky(codes):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("synthetic pixel failure")
+            return {"images": np.ones((2, 2, 3), np.uint8)}
+
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4),
+                              sampling=SAM,
+                              pixel_pipeline=PixelPipeline(flaky)).start()
+        try:
+            texts = _texts(cfg, 2)
+            h1 = engine.submit(texts[0], jax.random.PRNGKey(0))
+            h2 = engine.submit(texts[1], jax.random.PRNGKey(1))
+            with pytest.raises(RuntimeError, match="pixel stage failed"):
+                h1.result(timeout=300)
+            assert h2.result(timeout=300)["images"].sum() > 0
+            # the failure is a FAILED request, not a completion — the
+            # throughput/latency stats stay honest
+            snap = engine.metrics.snapshot()
+            assert snap["failed"] == 1 and snap["completed"] == 1
+        finally:
+            engine.stop()
+
+    def test_stop_drains_pending_jobs(self):
+        done = []
+        slow = PixelPipeline(lambda codes: (time.sleep(0.05),
+                                            done.append(1),
+                                            {"x": 1})[-1])
+
+        class H:
+            def _resolve(self, payload):
+                pass
+
+        for _ in range(4):
+            slow.submit(H(), 0, np.zeros(4, np.int32))
+        slow.stop(timeout=10)
+        assert len(done) == 4, "queued jobs must drain before the reap"
+
+
+class TestMetrics:
+    def test_percentiles(self):
+        assert np.isnan(percentiles([], (50.0,))[0])
+        assert percentiles([1.0], (50.0,)) == [1.0]
+        p50, p95 = percentiles([float(i) for i in range(1, 101)])
+        assert 50.0 <= p50 <= 51.0
+        assert 95.0 <= p95 <= 96.0
+
+    def test_request_accounting_and_jsonl(self, tmp_path):
+        path = tmp_path / "serving.jsonl"
+        m = ServingMetrics(n_slots=2, jsonl_path=str(path), interval_s=0.0)
+        m._interval_s = 0.0001
+        for rid in range(3):
+            m.record_submit(rid)
+            m.record_admit(rid)
+            m.record_first_code(rid)
+            row = m.record_complete(rid)
+            assert row["latency_s"] >= row["ttft_s"] >= 0
+            assert row["queue_wait_s"] >= 0
+        m.record_step(live_slots=1, queue_depth=4)
+        m.record_step(live_slots=2, queue_depth=0)
+        snap = m.snapshot()
+        assert snap["completed"] == 3 and snap["submitted"] == 3
+        assert snap["mean_occupancy"] == pytest.approx(0.75)
+        assert snap["mean_queue_depth"] == pytest.approx(2.0)
+        assert snap["max_queue_depth"] == 4
+        assert snap["img_per_s"] > 0
+        time.sleep(0.001)
+        m.maybe_flush()
+        rows = [json.loads(line) for line in
+                path.read_text().splitlines()]
+        assert rows and rows[-1]["completed"] == 3
+
+    def test_cancelled_requests_counted(self):
+        m = ServingMetrics(n_slots=1)
+        m.record_submit(7)
+        m.record_cancelled(7)
+        snap = m.snapshot()
+        assert snap["cancelled"] == 1 and snap["completed"] == 0
+
+
+class TestServeBench:
+    @pytest.mark.slow
+    def test_quick_bench_writes_valid_rows(self, tmp_path):
+        """serve_bench --quick end-to-end as a subprocess (fresh JAX
+        init + several compiles: minutes — slow-marked, like every
+        bench path, so tier-1 stays inside its window). Validates the
+        SERVE_BENCH.json row schema the driver reads; --quick numbers
+        carry no perf claim."""
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        out = tmp_path / "SERVE_BENCH.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "serve_bench.py"),
+             "--quick", "--out", str(out)],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=repo)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-2000:]
+        rows = [json.loads(line) for line in
+                out.read_text().splitlines()]
+        modes = [r["mode"] for r in rows]
+        assert modes == ["static", "engine", "summary"]
+        for row in rows[:2]:
+            assert row["img_per_s"] > 0
+            assert "mean_occupancy" in row and "mean_queue_depth" in row
+            assert "p95_latency_s" in row
+        assert "speedup" in rows[2] and "p95_ok" in rows[2]
+
+
+class TestHTTPServer:
+    @pytest.fixture()
+    def served(self, flat_setup):
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=2, steps_per_call=4),
+                              sampling=SAM).start()
+        httpd = ServingHTTPServer(("127.0.0.1", 0), engine,
+                                  request_timeout_s=300.0)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        yield cfg, engine, f"http://127.0.0.1:{httpd.server_address[1]}"
+        httpd.shutdown()
+        httpd.server_close()
+        engine.stop()
+        thread.join(timeout=10)
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_generate_stats_healthz(self, served):
+        cfg, engine, url = served
+        tokens = _texts(cfg, 1)[0].tolist()
+        status, body = self._post(url, {"tokens": tokens, "n_images": 2,
+                                        "seed": 11})
+        assert status == 200
+        assert len(body["results"]) == 2
+        for row in body["results"]:
+            codes = np.asarray(row["codes"])
+            assert codes.shape == (cfg.image_seq_len,)
+            assert (codes >= 0).all() and (codes < cfg.vocab_image).all()
+            assert row["latency_s"] >= row["ttft_s"]
+        # the two images of one query use fold_in(seed, i): distinct
+        assert body["results"][0]["codes"] != body["results"][1]["codes"]
+
+        with urllib.request.urlopen(url + "/stats", timeout=30) as resp:
+            stats = json.loads(resp.read())
+        assert stats["completed"] >= 2 and stats["n_slots"] == 2
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True
+
+    def test_error_paths(self, served):
+        cfg, engine, url = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"text": "no tokenizer configured"})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {})
+        assert e.value.code == 400
+        # wrong-length token vector is a 400, not a dropped connection
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"tokens": [1, 2, 3]})
+        assert e.value.code == 400
+        # non-numeric tokens (TypeError inside np.asarray) too
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"tokens": None})
+        assert e.value.code == 400
+        # out-of-range seed is a 400, not a handler crash
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"tokens": [1] * cfg.text_seq_len,
+                             "seed": 2 ** 72})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/nope", timeout=30)
+        assert e.value.code == 404
