@@ -2,10 +2,11 @@
 
 Stdlib-``ast`` only. Two rule families:
 
-- **jax**: host-sync-in-jit, python-rng-in-device, nondet-pytree,
-  literal-divisor-in-quant — invariants of traced device code whose
-  violation breaks determinism or the cross-peer wire byte-parity
-  contract (see LINTS.md for the incident history).
+- **jax**: host-sync-in-jit, host-sync-in-hot-loop, python-rng-in-device,
+  nondet-pytree, literal-divisor-in-quant — invariants of traced device
+  code (and of the serving hot loop's zero-sync dispatch discipline)
+  whose violation breaks determinism, throughput, or the cross-peer
+  wire byte-parity contract (see LINTS.md for the incident history).
 - **concurrency**: silent-except, blocking-in-async, thread-daemon-join,
   mixed-lock-writes — lifecycle and locking discipline for the swarm's
   background-thread layer.

@@ -41,6 +41,11 @@ _DEVICE_MODULE_PREFIXES = (
 )
 _DEVICE_MODULES = {"dalle_tpu/training/steps.py"}
 
+#: modules whose loops ARE a serving hot path — a blocking device→host
+#: pull per loop iteration stalls the dispatch pipeline every chunk
+#: (the r9 zero-sync engine loop exists to keep these out)
+_SERVING_MODULE_PREFIXES = ("dalle_tpu/serving/",)
+
 #: quantize-path modules where a literal divisor can silently break the
 #: cross-peer byte-parity contract (PR 1: XLA folds divide-by-constant
 #: into multiply-by-reciprocal, 1 ulp off for ~3% of absmax values).
@@ -124,6 +129,10 @@ class FileContext:
     def is_quant_module(self) -> bool:
         return self.path in _QUANT_MODULES or "quant" in os.path.basename(
             self.path)
+
+    @property
+    def is_serving_module(self) -> bool:
+        return self.path.startswith(_SERVING_MODULE_PREFIXES)
 
     # -- jit scopes -------------------------------------------------------
 

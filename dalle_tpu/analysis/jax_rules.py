@@ -202,6 +202,67 @@ def nondet_pytree(ctx: FileContext) -> Iterable[Finding]:
     return [f for f in out if f is not None]
 
 
+#: int()/float() pull a scalar through the host; flagged in hot loops
+#: only when the argument reads existing state (a Subscript/Attribute,
+#: e.g. ``int(pos[i])``) — wrapping a freshly computed call result is
+#: host arithmetic, not a device pull
+_STATEFUL_ARG_NODES = (ast.Subscript, ast.Attribute)
+#: np.asarray on a Name/Attribute/Subscript pulls an EXISTING buffer to
+#: the host; on a Call it usually wraps a fresh host-side construction
+_PULLABLE_ARG_NODES = (ast.Name, ast.Subscript, ast.Attribute)
+
+
+@rule(
+    "host-sync-in-hot-loop", "jax",
+    "Blocking device→host pull inside a while/for body in a serving"
+    " module: np.asarray()/np.array() on an existing value, .item()/"
+    " .tolist(), int()/float() on indexed state, or jax.device_get()."
+    " The serving hot loop must schedule from host-mirrored state and"
+    " dispatch ahead of the device (SERVING.md \"host loop\"); one pull"
+    " per chunk serializes host and device and caps throughput at their"
+    " SUM of latencies. Hoist per-completion pulls into helpers outside"
+    " the loop body, or carry a deterministic host mirror.")
+def host_sync_in_hot_loop(ctx: FileContext) -> Iterable[Finding]:
+    if not ctx.is_serving_module:
+        return []
+    out: List[Optional[Finding]] = []
+    flagged: Set[int] = set()
+    loops = [n for n in ast.walk(ctx.tree)
+             if isinstance(n, (ast.While, ast.For))]
+    for loop in loops:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            callee = dotted_name(node.func)
+            msg = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_PULL_METHODS):
+                msg = (f".{node.func.attr}() in a serving hot loop "
+                       "blocks on the device every iteration")
+            elif callee is not None:
+                parts = callee.split(".")
+                if (len(parts) == 2 and parts[0] in _NUMPY_MODULES
+                        and parts[1] in _ASARRAY_LEAVES and node.args
+                        and isinstance(node.args[0], _PULLABLE_ARG_NODES)):
+                    msg = (f"{callee}() on an existing value in a "
+                           "serving hot loop pulls a device buffer to "
+                           "the host per iteration")
+                elif parts[-1] == "device_get":
+                    msg = ("jax.device_get() in a serving hot loop is a "
+                           "blocking sync per iteration")
+            if (msg is None and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], _STATEFUL_ARG_NODES)):
+                msg = (f"{node.func.id}() on indexed state in a serving "
+                       "hot loop forces a device sync per iteration "
+                       "(schedule from a host mirror instead)")
+            if msg is not None:
+                flagged.add(id(node))
+                out.append(ctx.finding("host-sync-in-hot-loop", node, msg))
+    return [f for f in out if f is not None]
+
+
 @rule(
     "literal-divisor-in-quant", "jax",
     "Literal divisor in a quantize-path module. XLA strength-reduces"

@@ -13,8 +13,13 @@ Usage::
         --preset tiny --http-port 8080
 
     curl -s localhost:8080/generate -d '{"text": "a red cat", \
-        "n_images": 4, "seed": 7}'
+        "n_images": 4, "seed": 7, "temperature": 0.8, "top_k": 64}'
     curl -s localhost:8080/stats
+
+``--temperature``/``--top-k``/``--top-p`` set the engine-wide default;
+a request body may override any of them per request — sampling knobs
+are traced runtime operands of the chunk program, so serving a novel
+temperature never recompiles anything.
 
 ``--random-init`` serves freshly initialized weights (smoke tests and
 benches — the serving path's cost does not depend on weight values).

@@ -381,6 +381,18 @@ class ServingConfig:
     # (models/decode.py resolve_buckets); None = the measured adaptive
     # choice for n_slots. Each bucket compiles one step variant.
     decode_buckets: Optional[int] = None
+    # Cap on requests admitted per chunk boundary (None = all eligible).
+    # The pipelined loop scatters each admission batch as ONE jitted
+    # dispatch; bounding the burst keeps a cold start against a deep
+    # queue from wedging one outsized scatter between chunks.
+    admit_burst: Optional[int] = None
+    # Fall back to the r8 host-synchronous loop: block on a device→host
+    # position pull after every chunk instead of scheduling from the
+    # deterministic host mirror. Exists as the A/B baseline for
+    # scripts/engine_loop_bench.py and as a debug escape hatch — the
+    # pulled values always equal the mirror, so this buys nothing but
+    # the stall it measures.
+    host_sync_loop: bool = False
     # Queued (not yet admitted) requests beyond this are rejected at
     # submit — backpressure instead of unbounded growth.
     queue_capacity: int = 256
@@ -404,6 +416,9 @@ class ServingConfig:
         if self.queue_capacity < 1:
             raise ValueError(
                 f"queue_capacity must be >= 1 (got {self.queue_capacity})")
+        if self.admit_burst is not None and self.admit_burst < 1:
+            raise ValueError(
+                f"admit_burst must be >= 1 or None (got {self.admit_burst})")
 
 
 @dataclass(frozen=True)

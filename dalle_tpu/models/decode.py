@@ -411,7 +411,26 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
     """Temperature / top-k / top-p sampling; (B, V) -> (B,) int32.
 
     ``temperature == 0`` is greedy argmax.
+
+    The fields of ``cfg`` may be Python scalars (the lockstep
+    ``generate_images`` path: knobs become compile-time constants and
+    disabled stages vanish from the program) or **traced scalars** (the
+    serving engine: knobs ride as runtime operands of ONE compiled
+    program, so a novel temperature never triggers a recompile). Both
+    paths pick the SAME element as threshold and filter with the SAME
+    comparisons, so for equal knob values the sampled ids are
+    value-identical (pinned by test_decode/test_serving). The one
+    caveat: a static non-trivial temperature is a literal divisor XLA
+    may fold into a reciprocal multiply (1 ulp off the runtime divide
+    for ~3% of values — the PR-1 parity trap); at the pinned
+    temperature 1.0 both forms are exact.
     """
+    static = (isinstance(cfg.temperature, (int, float))
+              and isinstance(cfg.top_k, int)
+              and isinstance(cfg.top_p, (int, float)))
+    if not static:
+        return _sample_logits_traced(rng, logits, cfg.temperature,
+                                     cfg.top_k, cfg.top_p)
     if cfg.temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / cfg.temperature
@@ -428,6 +447,43 @@ def sample_logits(rng: jax.Array, logits: jax.Array,
             jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < threshold[:, None], NEG_INF, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def _sample_logits_traced(rng: jax.Array, logits: jax.Array,
+                          temperature, top_k, top_p) -> jax.Array:
+    """The traced-knob lowering of :func:`sample_logits`: every stage is
+    computed unconditionally and enabled by ``jnp.where`` on the knob,
+    so the compiled program is knob-independent. Value parity with the
+    static path at equal knobs:
+
+    - temperature: greedy runs as ``where(t == 0, argmax, sampled)``
+      with a safe divisor (the discarded sampling branch must not
+      divide by zero); ``x / 1.0`` is bitwise identity either way.
+    - top-k: the threshold is the SAME sorted element the static path
+      slices (``sorted[:, -k]`` == ``take(sorted, V - k)``), filtered
+      by the same ``<`` comparison; ``k <= 0`` keeps every id.
+    - top-p: same sorted-softmax threshold, gated by ``p < 1.0``.
+    """
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, logits.dtype)
+    is_greedy = temperature == 0.0
+    x = logits / jnp.where(is_greedy, jnp.ones_like(temperature),
+                           temperature)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    k_eff = jnp.clip(top_k, 1, v)
+    kth = jnp.take(jnp.sort(x, axis=-1), v - k_eff, axis=-1)[:, None]
+    x = jnp.where((top_k > 0) & (x < kth), NEG_INF, x)
+    top_p = jnp.asarray(top_p, logits.dtype)
+    sorted_x = jnp.sort(x, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_x, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < top_p
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_x, jnp.inf), axis=-1)
+    x = jnp.where((top_p < 1.0) & (x < threshold[:, None]), NEG_INF, x)
+    sampled = jax.random.categorical(rng, x).astype(jnp.int32)
+    return jnp.where(is_greedy, greedy, sampled)
 
 
 def bucket_bounds(total: int, n_buckets: int) -> List[int]:

@@ -16,15 +16,19 @@ Structure:
 
 - Device state (:class:`EngineState`): the KV cache at ``n_slots``
   batch rows plus per-slot position / next-input / RNG chain / text
-  prefix / emitted-code buffers. Lives on device between calls; the
-  host only pulls the (S,) position vector per chunk and one code row
-  per completion.
+  prefix / emitted-code / sampling-knob buffers. Lives on device
+  between calls and is **donated** through every chunk and admission,
+  so the multi-GB cache updates in place instead of reallocating.
 - Jitted chunk (:func:`_chunk_fn`): ``steps_per_call`` decode steps as
-  one ``lax.scan``. Compiled once per (config, sampling, chunk,
-  visible-bucket) — cached module-wide so engines in one process share
-  executables.
-- Host loop (:meth:`DecodeEngine._run`): admission (scheduler-granted,
-  at chunk boundaries), bucket choice, completion harvest, metrics.
+  one ``lax.scan``. Compiled once per (config, chunk, visible-bucket)
+  — sampling knobs are traced ``(S,)`` runtime operands, NOT compile
+  keys, so one executable serves every per-request SamplingConfig.
+- Host loop (:meth:`DecodeEngine._run`): **zero-sync** — positions
+  advance deterministically by ``steps_per_call`` for live slots, so
+  the host mirrors them in numpy, dispatches chunk k+1 while chunk k
+  still computes, and never blocks on a device→host pull. The only
+  device reads are per-completion code rows, sliced asynchronously and
+  resolved one chunk later (see SERVING.md "host loop").
 
 RNG parity: each slot carries its own key chain, split once per decode
 step exactly like ``generate_images``'s carry, and sampling draws
@@ -45,7 +49,7 @@ import functools
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Any, List, NamedTuple, Optional
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,9 +65,21 @@ from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
 logger = logging.getLogger(__name__)
 
 
+class QueueFullError(RuntimeError):
+    """submit() refused: the request queue is at capacity (back off and
+    retry — the front-end maps this to HTTP 429)."""
+
+
+class EngineStoppedError(RuntimeError):
+    """submit() refused: the engine is stopping or its thread is gone
+    (the front-end maps this to HTTP 503)."""
+
+
 class EngineState(NamedTuple):
     """Device-resident per-slot decode state. ``pos == total_seq_len``
-    marks a slot free (or finished-and-awaiting-harvest)."""
+    marks a slot free (or finished-and-awaiting-harvest). The sampling
+    knobs ride here (not in the compile key) so one chunk executable
+    serves every per-request SamplingConfig."""
 
     cache: Any                 # init_cache(cfg, n_slots) pytree
     pos: jax.Array             # (S,) int32 next position to decode
@@ -71,16 +87,16 @@ class EngineState(NamedTuple):
     rngs: jax.Array            # (S, 2) uint32 per-slot key chains
     text: jax.Array            # (S, text_seq_len) int32 prefixes
     codes: jax.Array           # (S, image_seq_len) int32 emitted codes
+    temp: jax.Array            # (S,) f32 per-slot sampling temperature
+    top_k: jax.Array           # (S,) int32 per-slot top-k (0 = off)
+    top_p: jax.Array           # (S,) f32 per-slot top-p (1.0 = off)
 
 
-@functools.lru_cache(maxsize=64)
-def _chunk_fn(cfg: ModelConfig, sampling: SamplingConfig, n_steps: int,
-              visible: int):
-    """Jitted ``n_steps`` decode positions for every slot at once.
-
-    Module-cached on (cfg, sampling, n_steps, visible) so every engine
-    (and test) in a process reuses one executable per bucket.
-    """
+def _chunk_body(cfg: ModelConfig, n_steps: int, visible: int):
+    """The un-jitted chunk program: ``n_steps`` decode positions for
+    every slot at once. Exposed separately from :func:`_chunk_fn` so
+    ``scripts/engine_loop_bench.py`` can jit it WITHOUT donation for
+    the r8-baseline row."""
     total = cfg.total_seq_len
     text_len = cfg.text_seq_len
 
@@ -96,11 +112,14 @@ def _chunk_fn(cfg: ModelConfig, sampling: SamplingConfig, n_steps: int,
             logits, cache = decode_step(params, cfg, st.cache, st.tokens,
                                         pos_c, visible=visible)
             # per-slot RNG chain: split exactly once per decode step,
-            # mirroring generate_images' carry
+            # mirroring generate_images' carry; the sampling knobs are
+            # traced per-slot operands — sample_logits lowers them as
+            # runtime selects, value-identical to the static path
             both = jax.vmap(jax.random.split)(st.rngs)
             sampled = jax.vmap(
-                lambda k, row: sample_logits(k, row[None, :], sampling)[0]
-            )(both[:, 1], logits)
+                lambda k, row, t, tk, tp: sample_logits(
+                    k, row[None, :], SamplingConfig(t, tk, tp))[0]
+            )(both[:, 1], logits, st.temp, st.top_k, st.top_p)
             # position p emits S_p, the input at p+1: teacher-forced to
             # the caption while p is a text position, the sampled code
             # once p is in the image block (generate_images parity)
@@ -119,30 +138,48 @@ def _chunk_fn(cfg: ModelConfig, sampling: SamplingConfig, n_steps: int,
                 tokens=jnp.where(active, nxt, st.tokens),
                 rngs=jnp.where(active[:, None], both[:, 0], st.rngs),
                 text=st.text,
-                codes=st.codes.at[rows, img_idx].set(new_vals)), None
+                codes=st.codes.at[rows, img_idx].set(new_vals),
+                temp=st.temp, top_k=st.top_k, top_p=st.top_p), None
 
         state, _ = jax.lax.scan(one, state, None, length=n_steps)
         return state
 
-    return jax.jit(run)
+    return run
 
 
-@functools.lru_cache(maxsize=16)
-def _admit_fn(cfg: ModelConfig):
-    """Jitted slot (re)initialization: one compile per model config."""
+@functools.lru_cache(maxsize=64)
+def _chunk_fn(cfg: ModelConfig, n_steps: int, visible: int):
+    """Jitted chunk with the state DONATED: the KV cache and per-slot
+    buffers update in place instead of reallocating ~the full cache per
+    chunk. Module-cached on (cfg, n_steps, visible) only — sampling
+    knobs are runtime operands, so every engine (and every per-request
+    SamplingConfig) in a process reuses one executable per bucket."""
+    return jax.jit(_chunk_body(cfg, n_steps, visible), donate_argnums=1)
+
+
+@functools.lru_cache(maxsize=64)
+def _admit_fn(cfg: ModelConfig, k: int):
+    """Jitted BATCHED slot (re)initialization: scatters all ``k``
+    admitted slots in one dispatch (a (K,) slot vector + (K, text_len)
+    prefix block) instead of one call per request. State donated —
+    admission is an in-place write too. One compile per (config, K),
+    K bounded by n_slots."""
     bos = cfg.vocab_total
 
-    def admit(state: EngineState, slot, text_row, key) -> EngineState:
+    def admit(state: EngineState, slots, texts, keys, temps, topks,
+              topps) -> EngineState:
         return EngineState(
             cache=state.cache,
-            pos=state.pos.at[slot].set(0),
-            tokens=state.tokens.at[slot].set(bos),
-            rngs=state.rngs.at[slot].set(key),
-            text=state.text.at[slot].set(text_row),
-            codes=state.codes.at[slot].set(
-                jnp.zeros((cfg.image_seq_len,), jnp.int32)))
+            pos=state.pos.at[slots].set(0),
+            tokens=state.tokens.at[slots].set(bos),
+            rngs=state.rngs.at[slots].set(keys),
+            text=state.text.at[slots].set(texts),
+            codes=state.codes.at[slots].set(0),
+            temp=state.temp.at[slots].set(temps),
+            top_k=state.top_k.at[slots].set(topks),
+            top_p=state.top_p.at[slots].set(topps))
 
-    return jax.jit(admit)
+    return jax.jit(admit, donate_argnums=0)
 
 
 class RequestHandle:
@@ -152,6 +189,8 @@ class RequestHandle:
     def __init__(self, request_id: int):
         self.request_id = request_id
         self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._claimed = False
         self._payload: Optional[dict] = None
 
     def done(self) -> bool:
@@ -170,9 +209,29 @@ class RequestHandle:
                 f"request {self.request_id}: {self._payload['error']}")
         return self._payload
 
-    def _resolve(self, payload: dict) -> None:
+    def _claim(self) -> bool:
+        """Atomically claim the right to resolve this handle (first
+        claim wins — the engine, the pixel worker and the stop()-
+        abandonment path can race). The winner, and ONLY the winner,
+        may feed the metrics ledger and then ``_deliver``."""
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def _deliver(self, payload: dict) -> None:
+        """Publish the payload and wake waiters. Call only after
+        winning ``_claim()``."""
         self._payload = payload
         self._event.set()
+
+    def _resolve(self, payload: dict) -> bool:
+        """claim + deliver in one step; returns whether this call won."""
+        if not self._claim():
+            return False
+        self._deliver(payload)
+        return True
 
 
 @dataclass
@@ -181,6 +240,7 @@ class _Pending:
     text: np.ndarray
     key: np.ndarray
     handle: RequestHandle
+    sampling: SamplingConfig
     first_code_seen: bool = field(default=False)
 
 
@@ -206,7 +266,10 @@ class DecodeEngine:
         self._params = params
         self._cfg = cfg
         self._serving = serving
-        self._sampling = sampling
+        # fail FAST on a bad engine-wide default: a server booted with
+        # temperature=-1 must die at construction, not 400 every
+        # knob-less request against an operator misconfiguration
+        self._sampling = self._validated_sampling(sampling)
         self._pixels = pixel_pipeline
         s = serving.n_slots
         total = cfg.total_seq_len
@@ -214,7 +277,8 @@ class DecodeEngine:
         self._bounds = bucket_bounds(total, n_buckets)
         self._chunk = serving.steps_per_call
         self.scheduler = SlotScheduler(s, kv_bytes_per_slot(cfg),
-                                       serving.kv_budget_mb)
+                                       serving.kv_budget_mb,
+                                       admit_burst=serving.admit_burst)
         self.metrics = metrics or ServingMetrics(
             n_slots=s, interval_s=serving.metrics_interval_s)
         if pixel_pipeline is not None:
@@ -227,11 +291,30 @@ class DecodeEngine:
             tokens=jnp.full((s,), cfg.vocab_total, jnp.int32),
             rngs=jnp.zeros((s, 2), jnp.uint32),
             text=jnp.zeros((s, cfg.text_seq_len), jnp.int32),
-            codes=jnp.zeros((s, cfg.image_seq_len), jnp.int32))
+            codes=jnp.zeros((s, cfg.image_seq_len), jnp.int32),
+            temp=jnp.ones((s,), jnp.float32),
+            top_k=jnp.zeros((s,), jnp.int32),
+            top_p=jnp.ones((s,), jnp.float32))
+        # host mirror of the device position vector: live positions
+        # advance deterministically by steps_per_call per chunk (and
+        # reset to 0 at admission), so the loop schedules from THIS —
+        # never from a blocking device→host pull
+        self._pos_host = np.full((s,), total, np.int32)
         # engine-thread-only slot table: _Pending per occupied slot
         self._slots: List[Optional[_Pending]] = [None] * s
+        # completions whose code rows are still in flight to the host:
+        # sliced (async) right after the next chunk is dispatched and
+        # resolved one iteration later, so the device never idles while
+        # the host turns a row into a response
+        self._harvests: List[Tuple[_Pending, jax.Array]] = []
+        # engine-thread-only: requests popped from the queue but not yet
+        # landed in _slots (the admission window) — swept by the crash-
+        # path cancel so a mid-admission failure can't orphan a handle
+        self._admitting: List[_Pending] = []
         self._cv = threading.Condition()
         self._queue: List[_Pending] = []       # guarded by _cv
+        self._handles: Dict[int, RequestHandle] = {}   # guarded by _cv
+        self._handles_prune_at = 2 * serving.queue_capacity  # guarded by _cv
         self._next_id = 0                      # guarded by _cv
         self._stopping = False                 # guarded by _cv
         self._draining = True                  # guarded by _cv
@@ -244,10 +327,14 @@ class DecodeEngine:
         self._thread.start()
         return self
 
-    def submit(self, text_tokens, rng=0) -> RequestHandle:
+    def submit(self, text_tokens, rng=0,
+               sampling: Optional[SamplingConfig] = None) -> RequestHandle:
         """Queue one image request. ``text_tokens``: (text_seq_len,)
         tokenizer ids; ``rng``: an int seed or a PRNG key — the SAME key
-        handed to ``generate_images`` samples the SAME codes."""
+        handed to ``generate_images`` samples the SAME codes.
+        ``sampling``: this request's SamplingConfig (default: the
+        engine's). Per-request knobs are runtime operands of the chunk
+        program — a novel temperature never triggers a compile."""
         text = np.asarray(text_tokens, np.int32).reshape(-1)
         if text.shape[0] != self._cfg.text_seq_len:
             raise ValueError(
@@ -258,27 +345,70 @@ class DecodeEngine:
         else:
             key = np.asarray(rng)
         key = key.astype(np.uint32).reshape(2)
+        sampling = self._validated_sampling(sampling)
         with self._cv:
             if self._stopping:
-                raise RuntimeError("engine is stopping; submit refused")
+                raise EngineStoppedError("engine is stopping; submit "
+                                         "refused")
             if len(self._queue) >= self._serving.queue_capacity:
-                raise RuntimeError(
+                raise QueueFullError(
                     f"request queue full ({self._serving.queue_capacity})")
             rid = self._next_id
             self._next_id += 1
             handle = RequestHandle(rid)
-            self._queue.append(_Pending(rid, text, key, handle))
+            self._queue.append(_Pending(rid, text, key, handle, sampling))
+            if len(self._handles) >= self._handles_prune_at:
+                # lazy prune: resolved handles leave the abandonment
+                # registry so a long-lived server stays O(outstanding).
+                # The next prune point doubles with the surviving size,
+                # so a backlog of live handles cannot trigger an
+                # O(outstanding) rebuild on EVERY submit (amortized O(1))
+                self._handles = {r: h for r, h in self._handles.items()
+                                 if not h.done()}
+                self._handles_prune_at = max(
+                    2 * self._serving.queue_capacity,
+                    2 * len(self._handles))
+            self._handles[rid] = handle
             self.metrics.record_submit(rid)
             self._cv.notify()
         return handle
+
+    def _validated_sampling(self, sampling: Optional[SamplingConfig]
+                            ) -> SamplingConfig:
+        sam = self._sampling if sampling is None else sampling
+        temp, top_p = float(sam.temperature), float(sam.top_p)
+        # >= rejects NaN; isfinite rejects inf — an infinite temperature
+        # collapses the finite segment-vocab mask (decode.py NEG_INF) to
+        # 0 and samples the WRONG vocabulary segment, returning corrupt
+        # codes with a 200 attached
+        if not (temp >= 0.0 and np.isfinite(temp)):
+            raise ValueError(
+                f"temperature must be finite and >= 0, got {temp}")
+        raw_k = sam.top_k
+        if isinstance(raw_k, bool) or not (
+                isinstance(raw_k, (int, np.integer))
+                or (isinstance(raw_k, float) and raw_k.is_integer())):
+            # a silently truncated 3.9 would serve DIFFERENT sampling
+            # than the caller asked for — guard here so the Python API
+            # is as protected as the HTTP one
+            raise ValueError(f"top_k must be an integer, got {raw_k!r}")
+        top_k = int(raw_k)
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        return SamplingConfig(temp, top_k, top_p)
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
         """Stop the engine thread. ``drain=True`` finishes queued and
         in-flight requests first (bounded by ``timeout``, default the
         config's ``drain_timeout_s``); ``drain=False`` cancels
-        everything outstanding immediately. Also drains and reaps an
-        attached pixel pipeline. Idempotent; safe before ``start()``."""
+        everything outstanding immediately. If the bounded join times
+        out, every still-unresolved handle is resolved with an error
+        payload — a client blocked in ``result()`` must not hang past
+        the drain bound. Also drains and reaps an attached pixel
+        pipeline. Idempotent; safe before ``start()``."""
         timeout = (self._serving.drain_timeout_s
                    if timeout is None else timeout)
         with self._cv:
@@ -290,6 +420,7 @@ class DecodeEngine:
             if self._thread.is_alive():
                 logger.warning("decode engine thread did not drain within "
                                "%.1fs; abandoning in-flight work", timeout)
+                self._abandon_outstanding(timeout)
         else:                                     # never started: nothing
             self._cancel_outstanding()            # will run the loop exit
         if self._pixels is not None:
@@ -298,6 +429,13 @@ class DecodeEngine:
     @property
     def cfg(self) -> ModelConfig:
         return self._cfg
+
+    @property
+    def default_sampling(self) -> SamplingConfig:
+        """The engine-level SamplingConfig used when submit() gets no
+        per-request override (the front-end merges partial overrides
+        against this)."""
+        return self._sampling
 
     @property
     def n_buckets(self) -> int:
@@ -321,31 +459,146 @@ class DecodeEngine:
                 return bound
         return self._cfg.total_seq_len
 
-    def _admit(self, pending: _Pending, slot: int) -> None:
-        self._state = _admit_fn(self._cfg)(
-            self._state, jnp.int32(slot), jnp.asarray(pending.text),
-            jnp.asarray(pending.key))
-        self._slots[slot] = pending
-        self.metrics.record_admit(pending.rid)
+    def _pick_visible(self, live_slots: List[int]) -> int:
+        """Bucket choice from the PREDICTED chunk-end positions: live
+        positions advance deterministically by ``steps_per_call``, so
+        the host mirror knows chunk k+1's span before chunk k finishes
+        — no device readback. (The speculative-bucket reconciliation
+        rule, SERVING.md: admissions land in the state BEFORE the next
+        dispatch, so the prediction is exact, never a guess.)"""
+        max_end = int(self._pos_host[live_slots].max()) + self._chunk
+        return self._visible_for(min(max_end, self._cfg.total_seq_len))
 
-    def _harvest(self, slot: int) -> None:
-        pending = self._slots[slot]
-        self._slots[slot] = None
-        codes = np.asarray(self._state.codes[slot])
+    def _admit_batch(self, admitted: List[_Pending],
+                     slots: List[int]) -> None:
+        """Scatter all K admitted requests into their slots in ONE
+        jitted dispatch (state donated, like the chunk)."""
+        self._state = _admit_fn(self._cfg, len(admitted))(
+            self._state,
+            jnp.asarray(np.asarray(slots, np.int32)),
+            jnp.asarray(np.stack([p.text for p in admitted])),
+            jnp.asarray(np.stack([p.key for p in admitted])),
+            jnp.asarray([p.sampling.temperature for p in admitted],
+                        jnp.float32),
+            jnp.asarray([p.sampling.top_k for p in admitted], jnp.int32),
+            jnp.asarray([p.sampling.top_p for p in admitted],
+                        jnp.float32))
+        for pending, slot in zip(admitted, slots):
+            self._slots[slot] = pending
+            self._pos_host[slot] = 0
+            self.metrics.record_admit(pending.rid)
+
+    def _after_chunk(self, live_slots: List[int], queue_depth: int,
+                     mirror_current: bool = False) -> List[int]:
+        """Advance the host position mirror exactly as the device does
+        (+steps_per_call per live slot, clamped) and return the slots
+        that finished at this chunk's end. ``mirror_current=True``
+        skips the advance — the sync loop already reconciled the
+        mirror from the blocking device pull."""
+        total = self._cfg.total_seq_len
+        text_len = self._cfg.text_seq_len
+        if not mirror_current:
+            self._pos_host[live_slots] = np.minimum(
+                self._pos_host[live_slots] + self._chunk, total)
+        self.metrics.record_step(len(live_slots), queue_depth)
+        finished = []
+        for slot in live_slots:
+            pending = self._slots[slot]
+            if not pending.first_code_seen \
+                    and self._pos_host[slot] > text_len:
+                pending.first_code_seen = True
+                self.metrics.record_first_code(pending.rid)
+            if self._pos_host[slot] >= total:
+                finished.append(slot)
+        return finished
+
+    def _begin_harvest(self, slots: List[int]) -> None:
+        """Slice each finished slot's code row off the (already
+        dispatched) chunk output and start its device→host copy WITHOUT
+        blocking; the slot is recycled immediately. The row is a fresh
+        buffer enqueued BEFORE the next donated dispatch, so in-order
+        execution reads it before admission zeroes the slot."""
+        for slot in slots:
+            pending = self._slots[slot]
+            # slice BEFORE clearing the slot: if the slice dispatch
+            # raises, the pending is still reachable from _slots for
+            # the crash-path cancel sweep (first-claim-wins dedupes the
+            # both-places overlap)
+            row = self._state.codes[slot]
+            row.copy_to_host_async()
+            self._harvests.append((pending, row))
+            self._slots[slot] = None
+
+    def _drain_harvests(self) -> None:
+        """Resolve completions whose rows were sliced on an EARLIER
+        iteration — by now the producing chunk has finished (or the
+        wait overlaps the chunk currently in flight), so this is the
+        loop's only device-dependent wait and it never stalls the
+        dispatch pipeline."""
+        # pop AFTER each successful resolution: a device error surfacing
+        # in np.asarray(row) leaves the failing entry (and everything
+        # behind it) in _harvests, where the crash-path cancel sweep can
+        # still resolve the handles — never orphan a client in result()
+        while self._harvests:
+            pending, row = self._harvests[0]
+            self._finish_harvest(pending, row)
+            self._harvests.pop(0)
+
+    def _finish_harvest(self, pending: _Pending, row: jax.Array) -> None:
+        codes = np.asarray(row)
         if self._pixels is not None:
             self._pixels.submit(pending.handle, pending.rid, codes)
+        elif pending.handle._claim():
+            # claim BEFORE touching the ledger: a handle the stop()-
+            # abandonment sweep already resolved must not also count
+            # as completed (and its popped timers would fabricate a
+            # ~0s latency row, skewing the percentiles)
+            pending.handle._deliver(
+                {"codes": codes,
+                 **self.metrics.record_complete(pending.rid)})
         else:
-            row = self.metrics.record_complete(pending.rid)
-            pending.handle._resolve({"codes": codes, **row})
+            logger.debug("request %d resolved elsewhere before "
+                         "harvest landed", pending.rid)
+
+    def _sync_pull(self) -> None:
+        """The r8 host-synchronous reconciliation (the
+        ``host_sync_loop`` escape hatch / bench baseline): block on a
+        device→host position pull every chunk. The pulled values always
+        equal the host mirror — positions advance deterministically —
+        so this buys nothing but the stall it exists to measure."""
+        self._pos_host[:] = np.asarray(self._state.pos)
 
     def _cancel_outstanding(self) -> None:
         with self._cv:
             leftover = list(self._queue)
             self._queue.clear()
-        for pend in leftover + [p for p in self._slots if p is not None]:
-            self.metrics.record_cancelled(pend.rid)
-            pend.handle._resolve({"error": "cancelled at engine stop"})
+        harvests, self._harvests = self._harvests, []
+        # _admitting covers the popped-but-not-yet-in-_slots window (a
+        # loop crash mid-admission): those pendings belong to none of
+        # the other structures and must still resolve. Requests already
+        # handed to the pixel queue are deliberately NOT swept — their
+        # decode finished; PixelPipeline.stop() drains and resolves
+        # them (first-claim-wins dedupes any overlap here).
+        admitting, self._admitting = self._admitting, []
+        for pend in (leftover + admitting
+                     + [p for p in self._slots if p is not None]
+                     + [p for p, _row in harvests]):
+            if pend.handle._resolve({"error": "cancelled at engine stop"}):
+                self.metrics.record_cancelled(pend.rid)
         self._slots = [None] * self._serving.n_slots
+
+    def _abandon_outstanding(self, timeout: float) -> None:
+        """stop(drain=True) hit its bound with the engine thread still
+        alive: resolve every unresolved handle with an error payload so
+        no client hangs in result() waiting on work nobody will finish.
+        First-resolution-wins keeps this safe against the wedged thread
+        limping through a late completion."""
+        with self._cv:
+            handles = [h for h in self._handles.values() if not h.done()]
+        for h in handles:
+            if h._resolve({"error": "abandoned: engine drain timed out "
+                                    f"after {timeout:.1f}s"}):
+                self.metrics.record_cancelled(h.request_id)
 
     def _run(self) -> None:
         try:
@@ -364,48 +617,58 @@ class DecodeEngine:
             self._cancel_outstanding()
 
     def _serve_loop(self) -> None:
-        total = self._cfg.total_seq_len
-        text_len = self._cfg.text_seq_len
+        sync = self._serving.host_sync_loop
         while True:
             with self._cv:
                 if self._stopping and not self._draining:
                     break
                 free = [i for i, p in enumerate(self._slots) if p is None]
                 live = self._serving.n_slots - len(free)
-                n_admit = self.scheduler.grant(len(self._queue), live, len(free))
+                n_admit = self.scheduler.grant(len(self._queue), live,
+                                               len(free))
                 admitted = [self._queue.pop(0) for _ in range(n_admit)]
                 queue_depth = len(self._queue)
                 if not admitted and live == 0:
                     if self._stopping:
                         break      # drained: queue empty, slots empty
-                    self._cv.wait(timeout=0.1)
+                    if not self._harvests:
+                        self._cv.wait(timeout=0.1)
                     idle = True
                 else:
                     idle = False
             if idle:
-                # the JSONL trace must keep ticking while idle — a
-                # silent gap is indistinguishable from a dead server
+                # a finished wave may still be riding the harvest
+                # pipeline, and the JSONL trace must keep ticking while
+                # idle — a silent gap is indistinguishable from a dead
+                # server
+                self._drain_harvests()
                 self.metrics.maybe_flush()
                 continue
-            for pending, slot in zip(admitted, free):
-                self._admit(pending, slot)
-
-            pos_before = np.asarray(self._state.pos)
+            if admitted:
+                self._admitting = admitted
+                self._admit_batch(admitted, free[: len(admitted)])
+                self._admitting = []
             live_slots = [i for i, p in enumerate(self._slots)
                           if p is not None]
-            max_end = max(int(pos_before[i]) for i in live_slots) + self._chunk
-            visible = self._visible_for(min(max_end, total))
-            self._state = _chunk_fn(self._cfg, self._sampling, self._chunk,
-                                    visible)(self._params, self._state)
-            pos_after = np.asarray(self._state.pos)
-
-            self.metrics.record_step(len(live_slots), queue_depth)
-            for slot in live_slots:
-                pending = self._slots[slot]
-                if not pending.first_code_seen \
-                        and int(pos_after[slot]) > text_len:
-                    pending.first_code_seen = True
-                    self.metrics.record_first_code(pending.rid)
-                if int(pos_after[slot]) >= total:
-                    self._harvest(slot)
+            visible = self._pick_visible(live_slots)
+            # dispatch chunk k+1 BEFORE resolving chunk k's harvests:
+            # the device computes while the host turns rows into
+            # responses — one chunk always in flight, zero blocking
+            # syncs on this path
+            self._state = _chunk_fn(self._cfg, self._chunk, visible)(
+                self._params, self._state)
+            self._drain_harvests()
+            if sync:
+                # r8-style: block on the pull BEFORE any bookkeeping, so
+                # first-code (TTFT) is recorded only once the device
+                # actually produced it — sync-mode TTFT is exact
+                self._sync_pull()
+            finished = self._after_chunk(live_slots, queue_depth,
+                                         mirror_current=sync)
+            self._begin_harvest(finished)
+            if sync:
+                self._drain_harvests()
             self.metrics.maybe_flush()
+        # loop exited with completions possibly still in flight (their
+        # decode DID finish) — land them before the cancel sweep
+        self._drain_harvests()

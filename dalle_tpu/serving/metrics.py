@@ -70,7 +70,12 @@ class ServingMetrics:
             self._admit_t[rid] = time.monotonic()
 
     def record_first_code(self, rid: int) -> None:
-        """First image code emitted (chunk-boundary granularity)."""
+        """First image code emitted (chunk-boundary granularity; the
+        pipelined engine loop records at DISPATCH of the crossing
+        chunk, so ttft_s is optimistic by up to one in-flight chunk —
+        exact under ``host_sync_loop``. Latency/completion timing is
+        device-confirmed either way: ``record_complete`` runs only
+        after the codes have landed on the host)."""
         with self._lock:
             if rid not in self._ttft and rid in self._submit_t:
                 self._ttft[rid] = time.monotonic() - self._submit_t[rid]

@@ -84,6 +84,12 @@ class PixelPipeline:
             if item is None:
                 return
             handle, rid, codes = item
+            if not handle._claim():
+                # resolved elsewhere (the engine's stop()-abandonment
+                # sweep won the race): skip the work AND the ledger —
+                # a request must never count both cancelled and
+                # completed/failed
+                continue
             try:
                 extra = self._fn(codes)
             except Exception as e:  # noqa: BLE001 - a pixel-stage failure
@@ -93,8 +99,8 @@ class PixelPipeline:
                                rid, e)
                 if self._metrics:   # failed, NOT completed: keep /stats
                     self._metrics.record_failed(rid)   # throughput honest
-                handle._resolve({"error": f"pixel stage failed: {e}"})
+                handle._deliver({"error": f"pixel stage failed: {e}"})
                 continue
             row = (self._metrics.record_complete(rid)
                    if self._metrics else {})
-            handle._resolve({"codes": codes, **extra, **row})
+            handle._deliver({"codes": codes, **extra, **row})

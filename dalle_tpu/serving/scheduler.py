@@ -37,12 +37,21 @@ class SlotScheduler:
     shapes); the budget models co-tenancy pressure — an engine sharing
     HBM with a trainer admits fewer concurrent requests instead of
     OOMing mid-flight.
+
+    ``admit_burst`` caps admissions PER CALL BOUNDARY. The pipelined
+    engine scatters a whole admission batch in one dispatch; a huge
+    burst (cold start against a deep queue) puts one outsized
+    scatter + prefix upload between two chunks and dents the dispatch
+    cadence — bounding the burst amortizes admission over several
+    boundaries instead. None = admit everything eligible at once.
     """
 
     def __init__(self, n_slots: int, bytes_per_slot: int,
-                 kv_budget_mb: Optional[int] = None):
+                 kv_budget_mb: Optional[int] = None,
+                 admit_burst: Optional[int] = None):
         self.n_slots = n_slots
         self.bytes_per_slot = bytes_per_slot
+        self.admit_burst = admit_burst
         if kv_budget_mb is None:
             self.max_live = n_slots
         else:
@@ -51,4 +60,7 @@ class SlotScheduler:
 
     def grant(self, queued: int, live: int, free: int) -> int:
         """How many queued requests to admit this call boundary."""
-        return max(0, min(queued, free, self.max_live - live))
+        n = max(0, min(queued, free, self.max_live - live))
+        if self.admit_burst is not None:
+            n = min(n, self.admit_burst)
+        return n

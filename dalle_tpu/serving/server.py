@@ -4,10 +4,13 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 
 - ``POST /generate`` — body ``{"text": "<caption>"}`` (needs a
   tokenizer) or ``{"tokens": [...]}`` (raw ids, tests/benches), plus
-  optional ``"n_images"`` (default 1) and ``"seed"`` (default 0; image
+  optional ``"n_images"`` (default 1), ``"seed"`` (default 0; image
   *i* of a request uses ``fold_in(seed, i)`` so a multi-image query is
   n independent single-image requests — exactly how the engine recycles
-  slots). Blocks until every image resolves; the response carries each
+  slots), and per-request sampling knobs ``"temperature"`` / ``"top_k"``
+  / ``"top_p"`` (default: the engine's config; knobs are traced runtime
+  operands of the chunk program, so a novel value never compiles).
+  Blocks until every image resolves; the response carries each
   request's codes (and ``clip_score`` when the pixel stage reranks)
   with its TTFT / latency / queue-wait accounting.
 - ``GET /stats``  — the metrics snapshot + live queue depth.
@@ -15,7 +18,8 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 
 One handler thread per in-flight connection (``ThreadingHTTPServer``,
 daemonized); the engine's queue capacity is the real admission bound —
-a full queue surfaces as HTTP 503.
+a full queue surfaces as HTTP 429 (back off and retry), a stopping or
+crashed engine as HTTP 503.
 """
 
 from __future__ import annotations
@@ -26,6 +30,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import numpy as np
+
+from dalle_tpu.models.decode import SamplingConfig
+from dalle_tpu.serving.engine import EngineStoppedError, QueueFullError
 
 logger = logging.getLogger(__name__)
 
@@ -77,6 +84,8 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             tokens = self._tokens_from(body)
+            sampling = self._sampling_from(
+                body, self.server.engine.default_sampling)
             n_images = int(body.get("n_images", 1))
             seed = int(body.get("seed", 0))
             if not (1 <= n_images <= 64):
@@ -90,12 +99,17 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             handles = [self.server.engine.submit(
-                tokens, np.asarray(jax.random.fold_in(base, i)))
+                tokens, np.asarray(jax.random.fold_in(base, i)),
+                sampling=sampling)
                 for i in range(n_images)]
-        except ValueError as e:         # wrong-length token vector
+        except ValueError as e:         # wrong-length token vector /
+            # out-of-range sampling knob
             self._reply(400, {"error": str(e)})
             return
-        except RuntimeError as e:       # queue full / engine stopping;
+        except QueueFullError as e:     # backpressure: retry later
+            self._reply(429, {"error": str(e)})
+            return
+        except (EngineStoppedError, RuntimeError) as e:  # stopping/crashed;
             # NOTE a mid-loop failure discards already-submitted sibling
             # handles — those images still decode and are dropped (the
             # engine has no mid-flight cancel yet; ROADMAP serving track)
@@ -113,13 +127,39 @@ class _Handler(BaseHTTPRequestHandler):
                 # it verbatim would just duplicate full-decode work
                 self._reply(500, {"error": str(e)})
                 return
-            row = {k: v for k, v in payload.items() if k != "images"}
-            row["codes"] = np.asarray(payload["codes"]).tolist()
-            if "images" in payload:     # pixels stay binary-free: shape only
-                row["image_shape"] = list(np.asarray(
-                    payload["images"]).shape)
-            results.append(row)
+            results.append(self._result_row(payload))
         self._reply(200, {"seed": seed, "results": results})
+
+    @staticmethod
+    def _result_row(payload: dict) -> dict:
+        """JSON-ready row for one resolved request (hoisted out of the
+        result-wait loop — serving/ loop bodies stay free of host-pull
+        calls, the graftlint host-sync-in-hot-loop discipline)."""
+        row = {k: v for k, v in payload.items() if k != "images"}
+        row["codes"] = np.asarray(payload["codes"]).tolist()
+        if "images" in payload:     # pixels stay binary-free: shape only
+            row["image_shape"] = list(np.asarray(payload["images"]).shape)
+        return row
+
+    @staticmethod
+    def _sampling_from(body: dict, default: SamplingConfig):
+        """Per-request SamplingConfig from the POST body, or None to use
+        the engine's default unchanged. Knobs absent from the body
+        inherit the engine default (a partial override is a delta, not
+        a reset). Values are range-checked by the engine's submit
+        (ValueError -> 400)."""
+        knobs = {k: body[k] for k in ("temperature", "top_k", "top_p")
+                 if k in body}
+        if not knobs:
+            return None
+        # values ride through RAW — the engine's _validated_sampling
+        # owns range/type checks (finite temperature, integral top_k),
+        # so the Python API and the HTTP API reject identically
+        return SamplingConfig(
+            temperature=float(knobs.get("temperature",
+                                        default.temperature)),
+            top_k=knobs.get("top_k", default.top_k),
+            top_p=float(knobs.get("top_p", default.top_p)))
 
     def _tokens_from(self, body: dict):
         if "tokens" in body:

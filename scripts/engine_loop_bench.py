@@ -1,0 +1,226 @@
+"""Engine hot-loop A/B: host-synchronous (r8) vs zero-sync (r9) chunk loop.
+
+Isolates the HOST-LOOP overhead per chunk that ``serve_bench.py``'s
+end-to-end numbers fold into everything else. Two drivers run the SAME
+chunk program over the SAME EngineState shape:
+
+- **sync** — the r8 structure: a NON-donated jit of the chunk body, and
+  after every dispatch a blocking ``np.asarray(state.pos)`` pull (the
+  per-chunk reconciliation the old engine did). Host work and device
+  compute serialize: per-chunk wall = device + pull + Python.
+- **pipelined** — the r9 structure: the donated ``_chunk_fn`` with
+  positions advanced on a deterministic host mirror, no per-chunk pull,
+  one chunk always in flight. Per-chunk wall ≈ max(device, host).
+
+Per mode we record the mean **dispatch-to-dispatch gap** (time between
+successive dispatch returns — the cadence a serving loop can sustain),
+the **device compute time** per chunk (same program, blocked every
+call), and their difference = the host overhead the loop structure
+adds. The summary row is the per-chunk milliseconds the zero-sync loop
+removes.
+
+Run:  python scripts/engine_loop_bench.py [--slots 4] [--steps-per-call 8]
+      [--chunks 48] [--quick]
+
+Appends driver-readable JSON lines (sync row, pipelined row, summary)
+to ENGINE_LOOP_BENCH.json at the repo root. Methodology: SERVING.md
+"host loop".
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dalle_tpu.config import tiny_model_config  # noqa: E402
+from dalle_tpu.models.dalle import DALLE, init_params  # noqa: E402
+from dalle_tpu.models.decode import init_cache  # noqa: E402
+from dalle_tpu.serving.engine import (EngineState, _chunk_body,  # noqa: E402
+                                      _chunk_fn)
+
+
+def bench_model_config():
+    """The serve-bench shape (32 text + 8x8 image positions, dim 128):
+    big enough that the jitted chunk dominates Python, small enough to
+    finish in minutes on the CPU container."""
+    return tiny_model_config(text_seq_len=32, image_grid=8, dim=128,
+                             heads=4, head_dim=32, depth=4)
+
+
+def fresh_state(cfg, slots, seed=0):
+    """Every slot live at position 0 (uniform compute per chunk: once a
+    slot's clock passes total it decodes clamped positions at identical
+    cost, so ANY chunk count measures the same program)."""
+    rng = np.random.default_rng(seed)
+    text = rng.integers(2, cfg.vocab_text, (slots, cfg.text_seq_len),
+                        dtype=np.int64).astype(np.int32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(slots))
+    return EngineState(
+        cache=init_cache(cfg, slots),
+        pos=jnp.zeros((slots,), jnp.int32),
+        tokens=jnp.full((slots,), cfg.vocab_total, jnp.int32),
+        rngs=jnp.asarray(keys, jnp.uint32),
+        text=jnp.asarray(text),
+        codes=jnp.zeros((slots, cfg.image_seq_len), jnp.int32),
+        temp=jnp.ones((slots,), jnp.float32),
+        top_k=jnp.full((slots,), 8, jnp.int32),
+        top_p=jnp.ones((slots,), jnp.float32))
+
+
+def measure_device(fn, params, state, chunks):
+    """Pure device compute per chunk: block after every call, so no
+    dispatch pipelining and no host work inside the timed region."""
+    t0 = time.monotonic()
+    for _ in range(chunks):
+        state = fn(params, state)
+        jax.block_until_ready(state.pos)
+    return (time.monotonic() - t0) / chunks * 1e3, state
+
+
+def run_sync(fn_nodonate, params, state, chunks, total):
+    """The r8 loop: dispatch, then block on the position pull before the
+    host may schedule the next chunk."""
+    gaps = []
+    pos_host = None
+    t0 = time.monotonic()
+    t_prev = t0
+    for _ in range(chunks):
+        state = fn_nodonate(params, state)
+        pos_host = np.asarray(state.pos)       # the per-chunk sync point
+        _visible = min(int(pos_host.max()) + 1, total)   # bucket choice
+        now = time.monotonic()
+        gaps.append(now - t_prev)
+        t_prev = now
+    wall = time.monotonic() - t0
+    return wall / chunks * 1e3, float(np.mean(gaps) * 1e3), state
+
+
+def run_pipelined(fn_donate, params, state, chunks, chunk_steps, total):
+    """The r9 loop: positions advance on the host mirror, dispatch k+1
+    without waiting on k; one block at the very end."""
+    slots = int(state.pos.shape[0])
+    pos_host = np.zeros((slots,), np.int32)
+    gaps = []
+    t0 = time.monotonic()
+    t_prev = t0
+    for _ in range(chunks):
+        state = fn_donate(params, state)
+        pos_host = np.minimum(pos_host + chunk_steps, total)
+        _visible = min(int(pos_host.max()) + 1, total)   # mirror-predicted
+        now = time.monotonic()
+        gaps.append(now - t_prev)
+        t_prev = now
+    jax.block_until_ready(state.pos)
+    wall = time.monotonic() - t0
+    return wall / chunks * 1e3, float(np.mean(gaps) * 1e3), state
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--steps-per-call", type=int, default=8)
+    ap.add_argument("--chunks", type=int, default=48)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="repetitions per measurement; the MIN is "
+                         "reported (least background-load noise — the "
+                         "2-core container wobbles several ms)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quick", action="store_true",
+                    help="10 chunks, 1 rep (CI smoke; numbers not "
+                         "meaningful)")
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+    chunks = 10 if args.quick else args.chunks
+    reps = 1 if args.quick else max(1, args.reps)
+
+    cfg = bench_model_config()
+    total = cfg.total_seq_len
+    params = init_params(DALLE(cfg), jax.random.PRNGKey(0))
+
+    fn_donate = _chunk_fn(cfg, args.steps_per_call, total)
+    fn_nodonate = jax.jit(_chunk_body(cfg, args.steps_per_call, total))
+
+    # -- warmup: compile both variants outside every timed region ------
+    t0 = time.monotonic()
+    st = fresh_state(cfg, args.slots, args.seed)
+    st = fn_nodonate(params, st)
+    st = fn_donate(params, st)
+    jax.block_until_ready(st.pos)
+    print(f"compile: {time.monotonic() - t0:.1f}s "
+          f"(slots={args.slots}, chunk={args.steps_per_call}, "
+          f"chunks={chunks})", flush=True)
+
+    # -- measurements, interleaved over reps; MIN per metric. Device
+    # baselines are per variant: donation changes the allocation
+    # traffic, so each row subtracts its OWN baseline ------------------
+    dev_sync_ms = dev_pipe_ms = wall_sync = wall_pipe = float("inf")
+    gap_sync = gap_pipe = float("inf")
+    for rep in range(reps):
+        d_s, _ = measure_device(
+            fn_nodonate, params, fresh_state(cfg, args.slots, args.seed),
+            chunks)
+        d_p, _ = measure_device(
+            fn_donate, params, fresh_state(cfg, args.slots, args.seed),
+            chunks)
+        w_s, g_s, _ = run_sync(
+            fn_nodonate, params, fresh_state(cfg, args.slots, args.seed),
+            chunks, total)
+        w_p, g_p, _ = run_pipelined(
+            fn_donate, params, fresh_state(cfg, args.slots, args.seed),
+            chunks, args.steps_per_call, total)
+        print(f"rep {rep}: device sync/pipe {d_s:.2f}/{d_p:.2f} ms, "
+              f"wall sync/pipe {w_s:.2f}/{w_p:.2f} ms", flush=True)
+        dev_sync_ms, dev_pipe_ms = min(dev_sync_ms, d_s), min(
+            dev_pipe_ms, d_p)
+        wall_sync, wall_pipe = min(wall_sync, w_s), min(wall_pipe, w_p)
+        gap_sync, gap_pipe = min(gap_sync, g_s), min(gap_pipe, g_p)
+
+    rows = [
+        {"mode": "sync", "device_ms_per_chunk": round(dev_sync_ms, 3),
+         "wall_ms_per_chunk": round(wall_sync, 3),
+         "dispatch_gap_ms": round(gap_sync, 3),
+         "host_overhead_ms_per_chunk": round(wall_sync - dev_sync_ms, 3)},
+        {"mode": "pipelined",
+         "device_ms_per_chunk": round(dev_pipe_ms, 3),
+         "wall_ms_per_chunk": round(wall_pipe, 3),
+         "dispatch_gap_ms": round(gap_pipe, 3),
+         "host_overhead_ms_per_chunk": round(wall_pipe - dev_pipe_ms, 3)},
+    ]
+    overhead_sync = wall_sync - dev_sync_ms
+    overhead_pipe = wall_pipe - dev_pipe_ms
+    summary = {
+        "mode": "summary",
+        "overhead_removed_ms_per_chunk": round(
+            overhead_sync - overhead_pipe, 3),
+        "sync_wall_ms": round(wall_sync, 3),
+        "pipelined_wall_ms": round(wall_pipe, 3),
+        "wall_speedup": round(wall_sync / max(1e-9, wall_pipe), 3),
+    }
+    shared = {
+        "metric": "engine hot-loop overhead per chunk (host vs device)",
+        "slots": args.slots,
+        "steps_per_call": args.steps_per_call,
+        "chunks": chunks,
+        "seed": args.seed,
+        "quick": bool(args.quick),
+    }
+    for row in rows + [summary]:
+        print(row, flush=True)
+    out_path = args.out or os.path.join(os.path.dirname(__file__), "..",
+                                        "ENGINE_LOOP_BENCH.json")
+    with open(out_path, "a") as f:
+        for row in rows + [summary]:
+            f.write(json.dumps({**shared, **row}) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

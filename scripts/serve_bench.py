@@ -238,6 +238,16 @@ def main():
     ap.add_argument("--batch-timeout-frac", type=float, default=0.5,
                     help="static batch formation timeout as a fraction "
                          "of one static batch service time")
+    ap.add_argument("--mean-gap-s", type=float, default=None,
+                    help="pin the Poisson mean inter-arrival gap instead "
+                         "of recalibrating from measured static capacity "
+                         "— replays a PRIOR run's exact trace (same seed "
+                         "+ same gap => same arrivals; the r8 rows used "
+                         "0.0391). Calibration wobble on the 2-core box "
+                         "otherwise changes the offered load run to run.")
+    ap.add_argument("--batch-timeout-s", type=float, default=None,
+                    help="pin the static batch-formation timeout "
+                         "(seconds) alongside --mean-gap-s (r8: 0.165)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quick", action="store_true",
                     help="8 requests (CI smoke; numbers not meaningful)")
@@ -286,9 +296,12 @@ def main():
         h.result(timeout=600)
     warm.stop()
 
-    mean_gap = t_static_batch / (slots * args.load)
+    mean_gap = (args.mean_gap_s if args.mean_gap_s is not None
+                else t_static_batch / (slots * args.load))
     arrivals = make_trace(n, mean_gap, args.seed)
-    batch_timeout = args.batch_timeout_frac * t_static_batch
+    batch_timeout = (args.batch_timeout_s if args.batch_timeout_s
+                     is not None
+                     else args.batch_timeout_frac * t_static_batch)
     print(f"trace: {n} requests, Poisson mean gap {mean_gap * 1e3:.0f}ms "
           f"(load {args.load}x static), batch timeout "
           f"{batch_timeout:.2f}s", flush=True)

@@ -28,6 +28,7 @@ from dalle_tpu.models.dalle import DALLE, init_params
 from dalle_tpu.models.decode import (SamplingConfig, bucket_bounds,
                                      generate_images, init_cache,
                                      resolve_buckets)
+from dalle_tpu.serving import engine as engine_mod
 from dalle_tpu.serving.engine import DecodeEngine
 from dalle_tpu.serving.metrics import ServingMetrics, percentiles
 from dalle_tpu.serving.pixels import PixelPipeline
@@ -166,6 +167,17 @@ class TestSchedulerAndBuckets:
         assert sched.grant(queued=0, live=2, free=2) == 0
         assert sched.grant(queued=5, live=4, free=0) == 0
 
+    def test_scheduler_admit_burst(self):
+        """admit_burst caps the PER-BOUNDARY batch: a cold start against
+        a deep queue admits over several chunk boundaries instead of one
+        outsized scatter."""
+        sched = SlotScheduler(8, bytes_per_slot=100, admit_burst=2)
+        assert sched.grant(queued=10, live=0, free=8) == 2
+        assert sched.grant(queued=1, live=0, free=8) == 1
+        # the burst never lifts the other caps
+        assert sched.grant(queued=10, live=7, free=1) == 1
+        assert SlotScheduler(8, 100, admit_burst=None).grant(10, 0, 8) == 8
+
     def test_scheduler_kv_budget(self):
         one_mb = 2 ** 20
         sched = SlotScheduler(8, bytes_per_slot=one_mb, kv_budget_mb=3)
@@ -263,6 +275,278 @@ class TestEngineLifecycle:
         assert not leaked, f"threads outlived stop(): {leaked}"
 
 
+class TestHotLoop:
+    """The r9 zero-sync loop's three load-bearing properties: one chunk
+    executable serves every SamplingConfig, the device state is donated
+    (the KV cache updates in place), and a novel temperature mid-run
+    compiles nothing."""
+
+    def test_chunk_executable_shared_across_sampling(self, flat_setup):
+        """Two engines at different temperatures share ONE chunk
+        executable: sampling knobs are traced operands, not compile
+        keys — `_chunk_fn`'s lru key is (cfg, chunk, visible) and the
+        underlying jit cache grows only with shapes/buckets."""
+        cfg, params = flat_setup
+        engine_mod._chunk_fn.cache_clear()
+        text = _texts(cfg, 1)[0]
+
+        def run_one(sampling, seed):
+            engine = DecodeEngine(
+                params, cfg, ServingConfig(n_slots=1, steps_per_call=4),
+                sampling=sampling).start()
+            try:
+                return engine.submit(
+                    text, jax.random.PRNGKey(seed)).result(timeout=300)
+            finally:
+                engine.stop()
+
+        run_one(SamplingConfig(temperature=1.0, top_k=8), 0)
+        info1 = engine_mod._chunk_fn.cache_info()
+        bounds = bucket_bounds(cfg.total_seq_len, resolve_buckets(None, 1))
+        sizes1 = {v: engine_mod._chunk_fn(cfg, 4, v)._cache_size()
+                  for v in bounds}
+        run_one(SamplingConfig(temperature=0.31, top_k=0, top_p=0.9), 1)
+        info2 = engine_mod._chunk_fn.cache_info()
+        sizes2 = {v: engine_mod._chunk_fn(cfg, 4, v)._cache_size()
+                  for v in bounds}
+        assert info2.misses == info1.misses, (
+            "a second SamplingConfig built a NEW chunk program")
+        assert sizes2 == sizes1, (
+            f"a second SamplingConfig triggered XLA compiles: "
+            f"{sizes1} -> {sizes2}")
+
+    def test_temperature_change_midrun_zero_compiles(self, flat_setup):
+        """A novel per-request temperature on a RUNNING engine triggers
+        zero new compiles (the recompile-per-temperature wall the
+        ROADMAP named)."""
+        cfg, params = flat_setup
+        texts = _texts(cfg, 2)
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=2, steps_per_call=4),
+                              sampling=SAM).start()
+        try:
+            engine.submit(texts[0], jax.random.PRNGKey(0)).result(
+                timeout=300)
+            sizes1 = {v: engine_mod._chunk_fn(cfg, 4, v)._cache_size()
+                      for v in engine._bounds}
+            novel = SamplingConfig(temperature=0.427, top_k=5, top_p=0.8)
+            engine.submit(texts[1], jax.random.PRNGKey(1),
+                          sampling=novel).result(timeout=300)
+            sizes2 = {v: engine_mod._chunk_fn(cfg, 4, v)._cache_size()
+                      for v in engine._bounds}
+        finally:
+            engine.stop()
+        assert sizes2 == sizes1, (
+            f"novel temperature compiled: {sizes1} -> {sizes2}")
+
+    def test_chunk_donates_state_buffers(self, flat_setup):
+        """donate_argnums is live: the input EngineState's buffers (the
+        KV cache above all) are DELETED after a chunk — the cache
+        updates in place instead of reallocating per chunk."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=2, steps_per_call=2))
+        old = engine._state
+        fn = engine_mod._chunk_fn(cfg, 2, cfg.total_seq_len)
+        engine._state = fn(params, old)
+        jax.block_until_ready(engine._state.pos)
+        donated = [old.pos, old.tokens, old.codes,
+                   *jax.tree_util.tree_leaves(old.cache)]
+        assert all(buf.is_deleted() for buf in donated), (
+            "chunk inputs survived the call: donation is not happening")
+
+    def test_admit_donates_and_batches(self, flat_setup):
+        """Batched admission initializes K slots in ONE jitted call
+        (a (K,) slot vector + (K, text_len) prefix block), also with
+        the state donated."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=4, steps_per_call=2))
+        texts = _texts(cfg, 3)
+        keys = [np.asarray(jax.random.PRNGKey(i), np.uint32)
+                for i in range(3)]
+        pendings = [engine_mod._Pending(
+            i, np.asarray(t, np.int32), k,
+            engine_mod.RequestHandle(i), SamplingConfig(1.0, 8, 1.0))
+            for i, (t, k) in enumerate(zip(texts, keys))]
+        old = engine._state
+        engine._admit_batch(pendings, [0, 2, 3])
+        jax.block_until_ready(engine._state.pos)
+        assert old.pos.is_deleted(), "admission did not donate the state"
+        pos = np.asarray(engine._state.pos)
+        assert pos[0] == 0 and pos[2] == 0 and pos[3] == 0
+        assert pos[1] == cfg.total_seq_len       # untouched slot
+        np.testing.assert_array_equal(
+            np.asarray(engine._state.text)[[0, 2, 3]], np.stack(texts))
+        np.testing.assert_array_equal(np.asarray(engine._state.temp),
+                                      [1.0, 1.0, 1.0, 1.0])
+        assert engine._pos_host[0] == 0 and engine._pos_host[1] == \
+            cfg.total_seq_len
+
+    def test_per_request_sampling_cotenancy_exact(self, flat_setup):
+        """Per-request SamplingConfig end to end: three co-tenant
+        requests with THREE different configs (the engine default, a
+        greedy override, a top-p override) each reproduce their own
+        generate_images solo reference exactly — one executable, three
+        knob settings in flight at once."""
+        cfg, params = flat_setup
+        texts = _texts(cfg, 3)
+        keys = [jax.random.PRNGKey(500 + i) for i in range(3)]
+        sams = [SAM, SamplingConfig(temperature=0.0),
+                SamplingConfig(temperature=1.0, top_k=0, top_p=0.7)]
+        refs = [np.asarray(generate_images(
+            params, cfg, jnp.asarray(t[None]), k, s, buckets=4))[0]
+            for t, k, s in zip(texts, keys, sams)]
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=2, steps_per_call=4),
+                              sampling=SAM).start()
+        try:
+            handles = [
+                engine.submit(texts[0], keys[0]),           # default SAM
+                engine.submit(texts[1], keys[1], sampling=sams[1]),
+                engine.submit(texts[2], keys[2], sampling=sams[2]),
+            ]
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            engine.stop()
+        for res, ref in zip(results, refs):
+            np.testing.assert_array_equal(res["codes"], ref)
+
+    def test_submit_rejects_bad_sampling(self, flat_setup):
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg, ServingConfig(n_slots=1))
+        text = np.zeros(cfg.text_seq_len, np.int32)
+        with pytest.raises(ValueError, match="temperature"):
+            engine.submit(text, sampling=SamplingConfig(temperature=-1.0))
+        with pytest.raises(ValueError, match="temperature"):
+            # inf collapses the finite segment-vocab mask: wrong-segment
+            # (negative) codes with no error — must be refused up front
+            engine.submit(text,
+                          sampling=SamplingConfig(temperature=float("inf")))
+        with pytest.raises(ValueError, match="top_k"):
+            engine.submit(text, sampling=SamplingConfig(top_k=-2))
+        with pytest.raises(ValueError, match="top_k"):
+            # the Python API must reject what HTTP rejects: a truncated
+            # 3.9 would serve different sampling than requested
+            engine.submit(text, sampling=SamplingConfig(top_k=3.9))
+        with pytest.raises(ValueError, match="top_p"):
+            engine.submit(text, sampling=SamplingConfig(top_p=0.0))
+        engine.stop(drain=False)
+
+    def test_bad_engine_default_fails_at_construction(self, flat_setup):
+        """An invalid engine-wide default dies at construction (operator
+        misconfiguration), not as a 400 on every client request."""
+        cfg, params = flat_setup
+        with pytest.raises(ValueError, match="temperature"):
+            DecodeEngine(params, cfg, ServingConfig(n_slots=1),
+                         sampling=SamplingConfig(temperature=-1.0))
+
+    def test_crash_mid_admission_cancels_popped_requests(self, flat_setup):
+        """A request popped from the queue but not yet in _slots when
+        the loop crashes must still resolve (the registry catch-all) —
+        a client in result() must never hang on a dead engine."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg, ServingConfig(n_slots=1))
+
+        def boom(admitted, slots):
+            raise RuntimeError("synthetic admission crash")
+
+        engine._admit_batch = boom
+        engine.start()
+        handle = engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+        with pytest.raises(RuntimeError, match="cancelled"):
+            handle.result(timeout=30)
+        engine.stop(drain=False)
+        assert engine.stats()["cancelled"] == 1
+        with pytest.raises(RuntimeError):      # crashed: submits refused
+            engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+
+
+class TestDrainTimeout:
+    def test_drain_timeout_resolves_abandoned_handles(self, flat_setup):
+        """stop(drain=True) that hits its bound must RESOLVE the
+        abandoned handles with an error payload — a client blocked in
+        result() must not hang until its own timeout."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4))
+        # wedge the loop: the engine thread never serves, exactly like a
+        # dispatch stuck behind a hung device. 2s outlives the 0.3s
+        # bounded join by 6x but ends before interpreter teardown (a
+        # daemon sleeping through exit trips XLA's C++ thread-registry
+        # teardown: "terminate called without an active exception")
+        engine._serve_loop = lambda: time.sleep(2)
+        engine.start()
+        handle = engine.submit(np.zeros(cfg.text_seq_len, np.int32))
+        t0 = time.monotonic()
+        engine.stop(drain=True, timeout=0.3)
+        with pytest.raises(RuntimeError, match="abandoned"):
+            handle.result(timeout=5)
+        # the client unblocked at the drain bound, not at its own timeout
+        assert time.monotonic() - t0 < 5.0
+        assert engine.stats()["cancelled"] == 1
+
+    def test_abandonment_loses_to_a_real_completion(self, flat_setup):
+        """First resolution wins: a handle the engine already resolved
+        is NOT overwritten by the abandonment sweep (and the metrics
+        ledger counts it once, as completed)."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4),
+                              sampling=SAM).start()
+        try:
+            handle = engine.submit(_texts(cfg, 1)[0],
+                                   jax.random.PRNGKey(0))
+            payload = handle.result(timeout=300)
+        finally:
+            engine.stop()
+        assert not handle._resolve({"error": "late abandonment"})
+        assert handle.result(timeout=1)["codes"].shape == \
+            (cfg.image_seq_len,)
+        assert payload["latency_s"] >= 0
+        snap = engine.metrics.snapshot()
+        assert snap["completed"] == 1 and snap["cancelled"] == 0
+
+    def test_late_harvest_after_abandonment_skips_ledger(self, flat_setup):
+        """The inverse race: the abandonment sweep won, then the wedged
+        engine thread limps through a harvest — the request must NOT
+        also count as completed (nor fabricate a ~0s latency row)."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg, ServingConfig(n_slots=1))
+        handle = engine_mod.RequestHandle(0)
+        engine.metrics.record_submit(0)
+        assert handle._resolve({"error": "abandoned"})
+        engine.metrics.record_cancelled(0)
+        pending = engine_mod._Pending(
+            0, np.zeros(cfg.text_seq_len, np.int32),
+            np.zeros(2, np.uint32), handle, SamplingConfig())
+        engine._finish_harvest(
+            pending, jnp.zeros((cfg.image_seq_len,), jnp.int32))
+        snap = engine.metrics.snapshot()
+        assert snap["cancelled"] == 1 and snap["completed"] == 0
+        with pytest.raises(RuntimeError, match="abandoned"):
+            handle.result(timeout=1)
+
+    def test_pixel_worker_skips_abandoned_handles(self):
+        """Same contract on the pixel path: an already-resolved handle
+        is skipped entirely — no pixel work, no completed/failed count
+        on top of the cancelled one."""
+        m = ServingMetrics(n_slots=1)
+        ran = []
+        pipeline = PixelPipeline(lambda codes: (ran.append(1),
+                                                {"x": 1})[-1], metrics=m)
+        handle = engine_mod.RequestHandle(7)
+        m.record_submit(7)
+        assert handle._resolve({"error": "abandoned"})
+        m.record_cancelled(7)
+        pipeline.submit(handle, 7, np.zeros(4, np.int32))
+        pipeline.stop(timeout=10)
+        assert ran == []
+        snap = m.snapshot()
+        assert snap["cancelled"] == 1 and snap["completed"] == 0 \
+            and snap["failed"] == 0
+
+
 class TestPixelPipeline:
     def test_failure_fails_request_not_worker(self, flat_setup):
         cfg, params = flat_setup
@@ -293,6 +577,38 @@ class TestPixelPipeline:
         finally:
             engine.stop()
 
+    def test_clean_drain_completes_pixel_queued_requests(self, flat_setup):
+        """stop(drain=True) with a request already handed to the pixel
+        queue must COMPLETE it (decode finished; the pipeline's drain
+        resolves it) — never steal it as 'cancelled at engine stop'."""
+        cfg, params = flat_setup
+        release = threading.Event()
+
+        def slow_pixels(codes):
+            release.wait(10)
+            return {"images": np.ones((2, 2, 3), np.uint8)}
+
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, steps_per_call=4),
+                              sampling=SAM,
+                              pixel_pipeline=PixelPipeline(slow_pixels)
+                              ).start()
+        handle = engine.submit(_texts(cfg, 1)[0], jax.random.PRNGKey(4))
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and (
+                engine._slots[0] is not None or engine._harvests):
+            time.sleep(0.01)       # decode done, job now pixel-queued
+        stopper = threading.Thread(
+            target=lambda: engine.stop(drain=True, timeout=60))
+        stopper.start()
+        time.sleep(0.1)            # engine loop exits while pixels wait
+        release.set()
+        stopper.join(60)
+        assert not stopper.is_alive()
+        assert handle.result(timeout=10)["images"].sum() > 0
+        snap = engine.metrics.snapshot()
+        assert snap["completed"] == 1 and snap["cancelled"] == 0
+
     def test_stop_drains_pending_jobs(self):
         done = []
         slow = PixelPipeline(lambda codes: (time.sleep(0.05),
@@ -300,7 +616,10 @@ class TestPixelPipeline:
                                             {"x": 1})[-1])
 
         class H:
-            def _resolve(self, payload):
+            def _claim(self):
+                return True
+
+            def _deliver(self, payload):
                 pass
 
         for _ in range(4):
@@ -383,6 +702,41 @@ class TestServeBench:
         assert "speedup" in rows[2] and "p95_ok" in rows[2]
 
 
+class TestEngineLoopBench:
+    @pytest.mark.slow
+    def test_quick_bench_writes_valid_rows(self, tmp_path):
+        """engine_loop_bench --quick as a subprocess (fresh JAX init +
+        two chunk-variant compiles: minutes — slow-marked like every
+        bench path). Validates the ENGINE_LOOP_BENCH.json row schema;
+        --quick numbers carry no perf claim."""
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        out = tmp_path / "ENGINE_LOOP_BENCH.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable,
+             str(repo / "scripts" / "engine_loop_bench.py"),
+             "--quick", "--out", str(out)],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=repo)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-2000:]
+        rows = [json.loads(line) for line in
+                out.read_text().splitlines()]
+        assert [r["mode"] for r in rows] == ["sync", "pipelined",
+                                             "summary"]
+        for row in rows[:2]:
+            assert row["device_ms_per_chunk"] > 0
+            assert row["wall_ms_per_chunk"] > 0
+            assert "dispatch_gap_ms" in row
+            assert "host_overhead_ms_per_chunk" in row
+        assert "overhead_removed_ms_per_chunk" in rows[2]
+        assert "wall_speedup" in rows[2]
+
+
 class TestHTTPServer:
     @pytest.fixture()
     def served(self, flat_setup):
@@ -453,3 +807,74 @@ class TestHTTPServer:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(url + "/nope", timeout=30)
         assert e.value.code == 404
+        # out-of-range per-request sampling knobs are a 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"tokens": [1] * cfg.text_seq_len,
+                             "temperature": -0.5})
+        assert e.value.code == 400
+        # non-integral top_k must not silently truncate to a DIFFERENT
+        # sampling config than the client asked for
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(url, {"tokens": [1] * cfg.text_seq_len,
+                             "top_k": 3.9})
+        assert e.value.code == 400
+
+    def test_per_request_sampling_over_http(self, served):
+        """The POST body's sampling knobs reach the engine: a greedy
+        (temperature 0) request is deterministic — same seed, same
+        codes — while the stochastic default keeps its own stream."""
+        cfg, engine, url = served
+        tokens = _texts(cfg, 1)[0].tolist()
+        status, a = self._post(url, {"tokens": tokens, "seed": 3,
+                                     "temperature": 0.0})
+        status_b, b = self._post(url, {"tokens": tokens, "seed": 3,
+                                       "temperature": 0.0})
+        assert status == status_b == 200
+        assert a["results"][0]["codes"] == b["results"][0]["codes"]
+        ref = np.asarray(generate_images(
+            engine._params, cfg,
+            jnp.asarray(np.asarray(tokens, np.int32)[None]),
+            jax.random.fold_in(jax.random.PRNGKey(3), 0),
+            SamplingConfig(temperature=0.0), buckets=4))[0]
+        np.testing.assert_array_equal(a["results"][0]["codes"], ref)
+
+    def test_queue_full_maps_to_429(self, flat_setup):
+        """submit()'s backpressure rejection is an HTTP 429 (retryable),
+        NOT a generic failure: an unstarted engine with queue_capacity=1
+        fills on the first sibling of a 2-image query."""
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg,
+                              ServingConfig(n_slots=1, queue_capacity=1))
+        httpd = ServingHTTPServer(("127.0.0.1", 0), engine,
+                                  request_timeout_s=5.0)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._post(url, {"tokens": _texts(cfg, 1)[0].tolist(),
+                                 "n_images": 2})
+            assert e.value.code == 429
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            engine.stop(drain=False)
+            thread.join(timeout=10)
+
+    def test_stopping_engine_maps_to_503(self, flat_setup):
+        cfg, params = flat_setup
+        engine = DecodeEngine(params, cfg, ServingConfig(n_slots=1))
+        engine.stop(drain=False)        # engine gone before the request
+        httpd = ServingHTTPServer(("127.0.0.1", 0), engine,
+                                  request_timeout_s=5.0)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._post(url, {"tokens": _texts(cfg, 1)[0].tolist()})
+            assert e.value.code == 503
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            thread.join(timeout=10)

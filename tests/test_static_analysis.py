@@ -146,6 +146,38 @@ def encode(absmax, d127):
 """,
     ),
     (
+        "host-sync-in-hot-loop",
+        "dalle_tpu/serving/fake.py",
+        """
+import numpy as np
+import jax
+def serve_loop(state, chunk_fn, total):
+    while True:
+        state = chunk_fn(state)
+        pos = np.asarray(state.pos)          # blocking pull per chunk
+        done = int(pos[0]) >= total
+        flags = jax.device_get(state.flags)
+        depth = state.depth.item()
+        if done:
+            break
+""",
+        """
+import numpy as np
+def _harvest(state, slot):
+    return np.asarray(state.codes[slot])     # per-completion, no loop
+def serve_loop(state, chunk_fn, pos_host, chunk, total):
+    rows = []
+    while True:
+        state = chunk_fn(state)
+        pos_host[:] = np.minimum(pos_host + chunk, total)  # host mirror
+        if pos_host[0] >= total:
+            rows.append(_harvest(state, 0))
+            break
+    n = int(np.asarray(rows).sum())          # outside the loop: fine
+    return rows, n
+""",
+    ),
+    (
         "silent-except",
         "dalle_tpu/swarm/fake.py",
         """
