@@ -292,3 +292,206 @@ def mixed_lock_writes(ctx: FileContext) -> Iterable[Finding]:
                     "write to a lock-guarded attribute must hold the "
                     "lock"))
     return [f for f in out if f is not None]
+
+
+# -- unchecked-pool-future ------------------------------------------------
+
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_FUTURE_CONSUMERS = {"result", "exception", "add_done_callback"}
+#: callees that may receive a future collection WITHOUT consuming its
+#: results — `concurrent.futures.wait(futs)` observes completion only,
+#: and a worker exception still vanishes (the motivating allreduce-retry
+#: incident, LINTS.md). The comprehension builtins are pass-throughs:
+#: their output joins the same tracked family via assignment/iteration.
+_FUTURE_OBSERVERS = {"wait", "len", "sorted", "list", "tuple", "zip",
+                     "enumerate", "reversed", "sum", "any", "all", "bool"}
+
+
+def _executor_names(tree: ast.AST) -> Set[str]:
+    """Names (incl. dotted `self._pool`) bound from a
+    concurrent.futures executor constructor anywhere in the file — by
+    plain assignment or a `with ... as name` item."""
+    out: Set[str] = set()
+
+    def ctor(value: ast.AST) -> bool:
+        return (isinstance(value, ast.Call)
+                and (dotted_name(value.func) or "").split(".")[-1]
+                in _EXECUTOR_CTORS)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and ctor(node.value):
+            for t in node.targets:
+                d = dotted_name(t)
+                if d is not None:
+                    out.add(d)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if ctor(item.context_expr) and item.optional_vars is not None:
+                    d = dotted_name(item.optional_vars)
+                    if d is not None:
+                        out.add(d)
+    return out
+
+
+def _flat_names(target: ast.AST) -> Iterable[str]:
+    """Plain/dotted names in an assignment/loop target, tuples included."""
+    stack = [target]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.Tuple, ast.List)):
+            stack.extend(cur.elts)
+        else:
+            d = dotted_name(cur)
+            if d is not None:
+                yield d
+
+
+def _mentions(node: ast.AST, family: Set[str]) -> bool:
+    return any(isinstance(sub, (ast.Name, ast.Attribute))
+               and dotted_name(sub) in family
+               for sub in ast.walk(node))
+
+
+def _sink_of(call: ast.Call, parents) -> Tuple[str, Optional[str]]:
+    """Where the future from this ``submit()`` call lands:
+    ("discarded", None) for a bare expression statement,
+    ("name", n) when bound to / appended onto a name,
+    ("consumed", None) for a direct ``.result()`` chain or any shape
+    this file-local analysis can't track (passed to a call, returned,
+    stored in a container literal) — benefit of the doubt."""
+    cur: ast.AST = call
+    while cur in parents:
+        p = parents[cur]
+        if isinstance(p, ast.Expr):
+            return "discarded", None
+        if isinstance(p, ast.Attribute):
+            # pool.submit(fn).result() — consumed inline
+            return "consumed", None
+        if isinstance(p, ast.Call):
+            if (isinstance(p.func, ast.Attribute) and p.func.attr == "append"
+                    and cur in p.args):
+                d = dotted_name(p.func.value)
+                if d is not None:
+                    # futures.append(pool.submit(...)): Expr-statement
+                    # append is accumulation into the named collection
+                    return "name", d
+            return "consumed", None  # passed to a call: can't track
+        if isinstance(p, (ast.Assign, ast.AnnAssign)):
+            targets = (p.targets if isinstance(p, ast.Assign)
+                       else [p.target])
+            for t in targets:
+                for d in _flat_names(t):
+                    return "name", d
+            return "consumed", None  # subscript/starred target: give up
+        if isinstance(p, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.IfExp, ast.Starred, ast.Await)):
+            cur = p
+            continue
+        return "consumed", None  # dict value, return, yield, ...: give up
+    return "consumed", None
+
+
+def _family_consumed(scope: ast.AST, seed: str) -> bool:
+    """Whether futures reachable from ``seed`` are ever consumed inside
+    ``scope``. Grows an alias family to a fixpoint — assignment RHS
+    mentioning a family name recruits its targets, iterating a family
+    name recruits the loop/comprehension variable (this is how
+    `done, _ = wait(futs)` + `for f in done: f.result()` resolves) —
+    then looks for result()/exception()/add_done_callback() on any
+    family name, or an escape (returned / passed to a non-observer
+    call) that local analysis must give the benefit of the doubt."""
+    family: Set[str] = {seed}
+    for _ in range(8):  # alias chains are short; fixpoint fast
+        grew = False
+        for node in ast.walk(scope):
+            targets: List[ast.AST] = []
+            source: Optional[ast.AST] = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                if node.value is None:
+                    continue
+                source = node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+            elif isinstance(node, ast.For):
+                source, targets = node.iter, [node.target]
+            elif isinstance(node, ast.comprehension):
+                source, targets = node.iter, [node.target]
+            if source is None or not _mentions(source, family):
+                continue
+            for t in targets:
+                for d in _flat_names(t):
+                    if d not in family:
+                        family.add(d)
+                        grew = True
+        if not grew:
+            break
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _FUTURE_CONSUMERS \
+                    and dotted_name(f.value) in family:
+                return True
+            callee_leaf = (dotted_name(f) or "").split(".")[-1]
+            if callee_leaf not in _FUTURE_OBSERVERS \
+                    and callee_leaf != "append" and not (
+                        isinstance(f, ast.Attribute)
+                        and f.attr == "submit"):
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if any(isinstance(a, (ast.Name, ast.Attribute))
+                       and dotted_name(a) in family for a in args):
+                    return True  # escapes to a callee: benefit of doubt
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if node.value is not None and _mentions(node.value, family):
+                return True
+    return False
+
+
+@rule(
+    "unchecked-pool-future", "concurrency",
+    "A concurrent.futures future whose result/exception is never"
+    " consumed: a worker exception vanishes into the unread Future and"
+    " the failure leaves zero trace (`wait(futs)` alone does NOT consume"
+    " — the allreduce retry-pool incident). Read result()/exception(),"
+    " attach add_done_callback, or justify a disable.")
+def unchecked_pool_future(ctx: FileContext) -> Iterable[Finding]:
+    executors = _executor_names(ctx.tree)
+    if not executors:
+        return []
+    out: List[Optional[Finding]] = []
+    # analysis scope = outermost enclosing function (or the module):
+    # submits and their consumption loops live in one function body in
+    # every real call site; nested defs/comprehensions are inside it
+    def outermost_function(node: ast.AST) -> ast.AST:
+        best: ast.AST = ctx.tree
+        cur = node
+        while cur in ctx.parents:
+            cur = ctx.parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                best = cur
+        return best
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and dotted_name(node.func.value) in executors):
+            continue
+        kind, sink = _sink_of(node, ctx.parents)
+        if kind == "consumed":
+            continue
+        if kind == "discarded":
+            out.append(ctx.finding(
+                "unchecked-pool-future", node,
+                "fire-and-forget submit(): the returned future (and any "
+                "worker exception) is discarded on the spot"))
+            continue
+        scope = outermost_function(node)
+        if not _family_consumed(scope, sink):
+            out.append(ctx.finding(
+                "unchecked-pool-future", node,
+                f"future(s) accumulated in `{sink}` are never consumed "
+                "in this function — wait() alone does not surface "
+                "worker exceptions; read result()/exception() or "
+                "attach add_done_callback"))
+    return [f for f in out if f is not None]

@@ -324,6 +324,15 @@ class CollabConfig:
     delay_optimizer_step: bool = True  # task.py:129
     reuse_grad_buffers: bool = True    # task.py:133
     metrics_expiration: float = 600.0  # statistics_expiration, arguments.py:129-131
+    # Deterministic fault injection (swarm/chaos.py, CHAOS.md): a
+    # FaultPlan as inline JSON ('{...}') or a path to a JSON file. The
+    # plan wraps this peer's DHT transport with seeded message
+    # drop/delay/duplication, payload corruption/truncation, bandwidth
+    # throttles, timed blackouts (partitions) and crash-at-epoch — the
+    # churn-soak harness (scripts/churn_soak.py) drives it. None (the
+    # default) leaves the transport untouched; every swarm entry point
+    # exposes it as --chaos-plan.
+    chaos_plan: Optional[str] = None
 
 
 @dataclass(frozen=True)

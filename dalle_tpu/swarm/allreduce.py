@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import hashlib
+import logging
 import os
 import struct
 import threading as _threading
@@ -60,6 +61,8 @@ from dalle_tpu.swarm.dht import DHT
 from dalle_tpu.swarm.identity import (Identity, PK_LEN, SIG_LEN,
                                       open_frame, signed_frame)
 from dalle_tpu.swarm.matchmaking import AveragingGroup
+
+logger = logging.getLogger(__name__)
 
 # group_hash, sender_index, weight, n_elems (this chunk), chunk_idx,
 # n_chunks, codec
@@ -170,14 +173,28 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   sender_timeout: Optional[float] = None,
                   report: Optional[dict] = None,
                   chunk_elems: int = CHUNK_ELEMS,
-                  codec_backend: str = compression.HOST_BACKEND
-                  ) -> List[np.ndarray]:
+                  codec_backend: str = compression.HOST_BACKEND,
+                  ledger=None) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
     ``report`` (optional dict) receives ``{"complete": bool}``: True iff
     every expected reduce chunk and every gather part arrived — i.e. this
     peer's result reflects the full roster. PowerSGD needs this to detect
-    rounds whose averaged bytes may diverge across survivors.
+    rounds whose averaged bytes may diverge across survivors. It also
+    receives ``corrupt_senders``/``timeout_senders``: peer ids whose
+    contribution was dropped for affirmatively malformed chunks (bad
+    geometry / codec under a VALID signature — authenticated garbage,
+    detected immediately, no timeout burned) or for never delivering a
+    usable contribution (dead, slow, or their traffic was damaged in
+    flight — unattributable, so it is never blamed as corruption; see
+    ``_parse``). Either way
+    the offender's weight is renormalized out (``total_w`` only ever
+    counts fully-applied senders), so one bad peer degrades the round
+    instead of poisoning it.
+
+    ``ledger`` (optional :class:`~dalle_tpu.swarm.health
+    .PeerHealthLedger`) receives a strike per banned peer, so
+    matchmaking can down-rank repeat offenders in later epochs.
 
     ``weight`` is this peer's contribution weight (its accumulated sample
     count, hivemind's per-peer weighting). ``codec=None`` selects
@@ -208,9 +225,34 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     use_device = codec_mod is not compression
     device_codec = codec_mod if use_device else None
     phases: Dict[str, float] = {}
+    corrupt_senders: List[str] = []
+    timeout_senders: List[str] = []
+    struck: set = set()  # (peer_id, reason) pairs already sent to the ledger
     if report is not None:
         report["complete"] = True  # falsified below on any missing chunk
         report["phases"] = phases  # wall time per protocol phase
+        report["corrupt_senders"] = corrupt_senders
+        report["timeout_senders"] = timeout_senders
+
+    def ban_peer(peer_id: str, reason: str, strike: bool = True) -> None:
+        """Cross-round memory of an in-round ban: one ledger strike per
+        (peer, reason) per round, so matchmaking can down-rank repeat
+        offenders (health.PeerHealthLedger). ``strike=False`` records
+        the ban in the report but withholds the ledger strike — used
+        when the failure is unattributable (a round where NOTHING
+        arrived from several peers points at the local node, and
+        striking every honest sender would self-isolate it)."""
+        sink = (corrupt_senders if reason == "corrupt-chunk"
+                else timeout_senders)
+        if peer_id not in sink:
+            sink.append(peer_id)
+        # the report sinks dedup per (peer, phase-family) but strikes
+        # dedup per (peer, reason): reduce- and gather-timeout share the
+        # timeout_senders sink, and a peer that both withheld its
+        # contribution AND never served its part has earned both strikes
+        if strike and ledger is not None and (peer_id, reason) not in struck:
+            struck.add((peer_id, reason))
+            ledger.strike(peer_id, reason)
     owners = [m for m in group.members if m.addr]  # part owners
     total_elems = sum(int(np.prod(np.shape(t))) if np.shape(t) else 1
                       for t in tensors)
@@ -363,13 +405,41 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     return None
                 return _parse(raw, group, my_chunks, my_ctx, codec_mod)
 
+            banned_reduce = 0  # corrupt-banned senders (no data applied)
+
             def apply_reduce(parsed) -> bool:
-                nonlocal acc, total_w
+                nonlocal acc, total_w, banned_reduce
                 if parsed is None:
                     return False
-                sender, w, ci, data = parsed
+                status, sender, w, ci, data = parsed
                 if sender not in expected:
                     return False  # duplicate or already-complete sender
+                if status == "bad":
+                    # authenticated garbage (valid signature over bad
+                    # geometry / codec — _parse never blames an
+                    # unsigned frame): drop this sender's WHOLE
+                    # contribution now — buffered chunks included —
+                    # instead of holding the round open until the
+                    # no-progress timeout. Its weight never reaches
+                    # total_w, so the average renormalizes over the
+                    # honest contributors by construction.
+                    expected.discard(sender)
+                    bufs.pop(sender, None)
+                    got.pop(sender, None)
+                    banned_reduce += 1
+                    ban_peer(group.members[sender].peer_id,
+                             "corrupt-chunk")
+                    if report is not None:
+                        report["complete"] = False
+                    logger.warning(
+                        "allreduce: banned sender %s for a signed but "
+                        "unusable chunk (contribution dropped, weight "
+                        "renormalized out). Hostile/buggy sender, OR a "
+                        "config mismatch — a peer with a different "
+                        "model shape or chunk_elems produces frames "
+                        "this receiver can never apply",
+                        group.members[sender].peer_id[:16])
+                    return True  # the roster shrank: that is progress
                 if sender not in bufs:
                     bufs[sender] = np.zeros(n_mine, np.float32)
                     got[sender] = set()
@@ -420,14 +490,31 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 for f in decoding:
                     if f.done():
                         apply_reduce(f.result())
+            # strike attribution: a no-show while OTHER senders' data
+            # landed here is that peer's fault; zero data from anyone
+            # (including the only peer of a 2-peer swarm) is equally
+            # consistent with local inbound loss — renormalize and
+            # report, but don't feed the ledger strikes that would
+            # down-rank every honest peer and self-isolate this node
+            delivered_any = ((n_expected0 - len(expected) - banned_reduce)
+                             > 0 or bool(bufs))
+            blame_remote = delivered_any
+            for s in expected:
+                # never delivered a full contribution within the round's
+                # patience: the classic dead/slow-peer ban
+                ban_peer(group.members[s].peer_id, "reduce-timeout",
+                         strike=blame_remote)
             if expected and report is not None:
                 report["complete"] = False
             if report is not None:
                 # contributors whose full data reached this part (self
                 # included when weight > 0) — an assistant uses this to
                 # detect rounds where nothing ever parsed (e.g. a model
-                # mismatch producing un-parseable chunk geometry)
+                # mismatch producing un-parseable chunk geometry).
+                # Corrupt-banned senders left ``expected`` without
+                # contributing: subtract them.
                 report["reduced_senders"] = (n_expected0 - len(expected)
+                                             - banned_reduce
                                              + (1 if weight > 0 else 0))
             if total_w > 0:
                 averaged_mine = acc / total_w
@@ -456,6 +543,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         if retries and time.monotonic() < deadline:
             retry_futs = [pool.submit(send_raw, *s) for s in retries]
             concurrent.futures.wait(retry_futs)
+            # consume every retry outcome: an exception in send_raw (or
+            # a still-failing send) must leave a trace, not vanish in an
+            # unread Future (graftlint unchecked-pool-future)
+            still_failed = sum(1 for f in retry_futs
+                               if f.done() and not f.result())
+            if still_failed:
+                logger.warning(
+                    "allreduce: %d/%d scatter chunk(s) undeliverable "
+                    "after retry (receivers will ban this sender's "
+                    "contribution)", still_failed, len(retry_futs))
         phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
@@ -561,6 +658,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 owner_index[m.peer_id]:
                     set(range(len(part_chunks[owner_index[m.peer_id]])))
                 for m in owners if m.peer_id != me.peer_id}
+            n_pending0 = len(pending)
             sender_to_part = {
                 group.members.index(m): owner_index[m.peer_id]
                 for m in owners}
@@ -592,9 +690,27 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             def apply_gather(res) -> bool:
                 if res is None:
                     return False
-                part, (_s, _w, ci, data) = res
-                if part not in pending or ci not in pending[part]:
-                    return False  # duplicate chunk / completed part
+                part, (status, sender, _w, ci, data) = res
+                if part not in pending:
+                    return False  # completed part
+                if status == "bad":
+                    # the part OWNER is serving damaged bytes: stop
+                    # waiting on it — the part keeps this peer's local
+                    # values (the dead-owner elasticity path), the
+                    # round reports incomplete, the owner is struck
+                    pending.pop(part, None)
+                    ban_peer(group.members[sender].peer_id,
+                             "corrupt-chunk")
+                    if report is not None:
+                        report["complete"] = False
+                    logger.warning(
+                        "allreduce: part %d owner %s served a corrupt/"
+                        "truncated chunk — keeping local values for "
+                        "that part", part,
+                        group.members[sender].peer_id[:16])
+                    return True
+                if ci not in pending[part]:
+                    return False  # duplicate chunk
                 # NB: fresh names — produce_gather's codec threads read
                 # the enclosing lo/clo/chi lazily; rebinding them here
                 # would corrupt the local-apply offsets (r5 bug)
@@ -641,7 +757,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     if f.done():
                         apply_gather(f.result())
             # chunks never received keep this peer's local values (owner
-            # died mid-round): degraded but well-defined
+            # died mid-round): degraded but well-defined. Same strike
+            # attribution as the reduce sweep: owners silent with zero
+            # gather data points at the local node as much as at them —
+            # report the bans, withhold the ledger strikes.
+            progressed = (len(pending) < n_pending0 or any(
+                len(v) < len(part_chunks[k]) for k, v in pending.items()))
+            blame_owners = progressed
+            for k in pending:
+                ban_peer(owners[k].peer_id, "gather-timeout",
+                         strike=blame_owners)
             if pending and report is not None:
                 report["complete"] = False
         else:
@@ -672,7 +797,24 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                                         gather_ctx, codec_mod)
                         if parsed is None:
                             continue
-                        _, _, pci, data = parsed
+                        status, psender, _, pci, data = parsed
+                        if (status == "bad" or group.members[psender]
+                                .peer_id != owner.peer_id):
+                            # the OWNER's mailbox served damaged goods:
+                            # authenticated garbage, or a replayed
+                            # frame validly signed by some OTHER peer
+                            # (the shared gather ctx makes that frame
+                            # verify — the mailbox it came from is what
+                            # convicts). Abandon the part (local
+                            # values) and strike the owner — NEVER the
+                            # signer, or a hostile owner could frame
+                            # honest peers by replaying their frames.
+                            pending.pop(k, None)
+                            ban_peer(owner.peer_id, "corrupt-chunk")
+                            if report is not None:
+                                report["complete"] = False
+                            last_progress = time.monotonic()
+                            break
                         if pci not in pending[k]:
                             continue
                         lo, hi = slices[k]
@@ -684,6 +826,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         pending.pop(k, None)
                 if pending:
                     time.sleep(0.1)
+            # same strike attribution as the push path: every-owner
+            # silence with zero pulled chunks points at the local node
+            progressed = (len(pending) < len(owners) or any(
+                len(v) < len(part_chunks[k]) for k, v in pending.items()))
+            blame_owners = progressed
+            for k in pending:
+                ban_peer(owners[k].peer_id, "gather-timeout",
+                         strike=blame_owners)
             if pending and report is not None:
                 report["complete"] = False
 
@@ -698,6 +848,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         if retries and time.monotonic() < deadline:
             retry_futs = [pool.submit(send_raw, *s) for s in retries]
             concurrent.futures.wait(retry_futs)
+            # read back every retry (graftlint unchecked-pool-future):
+            # a receiver that still missed the chunk falls back to its
+            # local values for this part — worth a trace here too
+            still_failed = sum(1 for f in retry_futs
+                               if f.done() and not f.result())
+            if still_failed:
+                logger.warning(
+                    "allreduce: %d/%d gather chunk send(s) undeliverable "
+                    "after retry", still_failed, len(retry_futs))
 
     phases["gather_s"] = round(time.monotonic() - t_gather, 3)
     if weight == 0:
@@ -722,29 +881,53 @@ def _peek(raw: bytes, group: AveragingGroup
 def _parse(raw: bytes, group: AveragingGroup,
            chunks: List[Tuple[int, int]], ctx: bytes,
            codec_mod=compression
-           ) -> Optional[Tuple[int, float, int, np.ndarray]]:
-    """-> (sender, weight, chunk_idx, decoded chunk) or None.
+           ) -> Optional[Tuple[str, int, float, int,
+                               Optional[np.ndarray]]]:
+    """-> ("ok", sender, weight, chunk_idx, decoded chunk),
+    ("bad", sender, 0.0, -1, None), or None.
 
     ``chunks`` is the receiver-side chunking of the part this tag carries
     (both sides derive it from the part size, so chunk_idx and the chunk's
     element count must both agree — a frame chunked differently is
-    malformed and dropped). ``codec_mod`` is the decompress backend
-    (compression or device_codec — identical wire semantics)."""
+    malformed). ``codec_mod`` is the decompress backend (compression or
+    device_codec — identical wire semantics).
+
+    ``"bad"`` is an AUTHENTICATED verdict: it fires only when the
+    frame's signature verifies under the claimed sender's key yet the
+    signed content is malformed (wrong geometry for the agreed part
+    chunking, undecodable codec payload) — that sender provably
+    produced bytes this receiver can never apply, so the receiver bans
+    its contribution immediately (weight renormalized out) instead of
+    holding the round open until the no-progress timeout. NOTE the
+    verdict is "cannot interoperate", not necessarily malice: geometry
+    derives from receiver-local config, so an honest peer running a
+    different model shape or ``chunk_elems`` lands here too — and the
+    resulting corrupt-chunk strikes make config-skewed peers mutually
+    down-rank until the swarm re-partitions into compatible groups,
+    which is the useful outcome (grouping with a peer whose frames
+    never parse burns every round's ban budget). The ledger's decay
+    bounds the split if the config converges. Anything that fails the signature check —
+    wire corruption, truncation, a forged frame naming someone else —
+    returns None: blame there would let any byte flip (or any peer who
+    knows the group hash) evict an HONEST member's contribution and
+    feed the health ledger false strikes. Unattributable damage still
+    degrades gracefully, just slower: the true sender times out and is
+    renormalized out via the "reduce-timeout" path."""
     head = _peek(raw, group)
     if head is None:
         return None
     sender, w = head
+    if not _verify_frame(raw, ctx, group, sender):
+        return None
     _, _, _, n, ci, nc, codec = _HDR.unpack_from(raw)
     if nc != len(chunks) or not (0 <= ci < nc):
-        return None
+        return "bad", sender, 0.0, -1, None
     clo, chi = chunks[ci]
     if n != chi - clo:
-        return None
-    if not _verify_frame(raw, ctx, group, sender):
-        return None  # forged or replayed chunk: drop
+        return "bad", sender, 0.0, -1, None
     body = raw[_PREFIX_LEN:]
     try:
         data = codec_mod.decompress(body, codec, n)
     except (ValueError, struct.error):
-        return None
-    return sender, float(w), ci, data
+        return "bad", sender, 0.0, -1, None
+    return "ok", sender, float(w), ci, data

@@ -1,0 +1,110 @@
+"""Peer-health ledger: strike counts with epoch decay.
+
+The all-reduce already bans a misbehaving sender *within* a round
+(corrupt chunks, no-progress timeouts — ``allreduce.py``), but until
+now that knowledge died with the round: the same flapping or hostile
+peer re-entered matchmaking the very next epoch and cost every survivor
+another ban timeout. The ledger is the cross-round memory: bans feed
+strikes, strikes decay after a few epochs, and repeat offenders are
+down-ranked — dropped from this peer's matchmaking candidate view
+(``matchmaking._read_candidates``) and ignored by the progress
+aggregation (``progress.ProgressTracker``) until their strikes age out.
+
+The ledger is LOCAL knowledge. Peers' ledgers can disagree (one peer
+saw the corrupt chunk, another didn't) and the matchmaking roster can
+therefore diverge transiently — that is the existing elasticity
+contract: followers prefer the leader's signed roster, and residual
+disagreement falls out through group-hash mismatch drops. Down-ranking
+is a *bias*, not a consensus verdict.
+
+Thread-safety: strikes arrive from wire/round worker threads while the
+training thread reads penalties — every mutation holds the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+#: default strike weights by reason (anything else counts 1.0).
+#: "confirm-timeout" is deliberately sub-threshold on its own: a
+#: missing confirmation is unattributable (the leader may be alive but
+#: slow, or the follower's roster may have diverged), so even striking
+#: the same leader EVERY epoch (0.5 x ttl 3 = 1.5) can never cross the
+#: default penalty threshold (3.0) without corroborating allreduce
+#: evidence — unattributable signals tip the scale, they don't convict.
+STRIKE_WEIGHTS = {
+    "corrupt-chunk": 2.0,     # affirmatively malformed traffic
+    "reduce-timeout": 1.0,    # never delivered its contribution
+    "gather-timeout": 1.0,    # owned a part and never served it
+    "confirm-timeout": 0.5,   # announced leader, never confirmed
+}
+
+
+class PeerHealthLedger:
+    """Decaying per-peer strike counts.
+
+    A strike is recorded with the epoch it happened in; only strikes
+    from the last ``ttl_epochs`` epochs count toward the penalty score.
+    ``penalized(pid)`` is True while the live score is at or above
+    ``penalty_threshold`` — "down-ranked for the next few epochs".
+    """
+
+    def __init__(self, ttl_epochs: int = 3,
+                 penalty_threshold: float = 3.0,
+                 max_peers: int = 4096):
+        self.ttl_epochs = ttl_epochs
+        self.penalty_threshold = penalty_threshold
+        self.max_peers = max_peers
+        self._lock = threading.Lock()
+        self._epoch = 0
+        # peer_id -> [(epoch, weight), ...]
+        self._strikes: Dict[str, List[Tuple[int, float]]] = {}
+
+    # -- writes ------------------------------------------------------------
+
+    def strike(self, peer_id: str, reason: str = "",
+               weight: float = 0.0) -> None:
+        """Record one offense. ``weight`` 0 looks the reason up in
+        STRIKE_WEIGHTS (unknown reasons count 1.0)."""
+        w = weight or STRIKE_WEIGHTS.get(reason, 1.0)
+        with self._lock:
+            if (peer_id not in self._strikes
+                    and len(self._strikes) >= self.max_peers):
+                return  # bound memory against an id-churning flood
+            self._strikes.setdefault(peer_id, []).append((self._epoch, w))
+
+    def advance_epoch(self, epoch: int) -> None:
+        """Move the decay clock forward (never backward) and prune
+        strikes that have aged out everywhere."""
+        with self._lock:
+            if epoch <= self._epoch:
+                return
+            self._epoch = epoch
+            floor = epoch - self.ttl_epochs
+            for pid in list(self._strikes):
+                live = [(e, w) for e, w in self._strikes[pid] if e > floor]
+                if live:
+                    self._strikes[pid] = live
+                else:
+                    del self._strikes[pid]
+
+    # -- reads -------------------------------------------------------------
+
+    def score(self, peer_id: str) -> float:
+        """Live (un-decayed) strike weight for a peer."""
+        with self._lock:
+            floor = self._epoch - self.ttl_epochs
+            return sum(w for e, w in self._strikes.get(peer_id, ())
+                       if e > floor)
+
+    def penalized(self, peer_id: str) -> bool:
+        return self.score(peer_id) >= self.penalty_threshold
+
+    def snapshot(self) -> Dict[str, float]:
+        """{peer_id: live score} for logging/metrics."""
+        with self._lock:
+            floor = self._epoch - self.ttl_epochs
+            out = {pid: sum(w for e, w in rec if e > floor)
+                   for pid, rec in self._strikes.items()}
+            return {pid: s for pid, s in out.items() if s > 0}

@@ -33,6 +33,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import logging
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -181,12 +182,22 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
                min_group_size: int = 1,
                client_mode: bool = False,
                authorizer=None,
-               encrypt: bool = False) -> Optional[AveragingGroup]:
+               encrypt: bool = False,
+               ledger=None) -> Optional[AveragingGroup]:
     """Announce, wait, and agree on this epoch's averaging group.
 
     Returns None if this peer somehow isn't in the final group (can happen
     only if its own announce failed and a leader confirmation without it
     arrived) — callers should then skip averaging this epoch.
+
+    ``ledger`` (optional :class:`~dalle_tpu.swarm.health
+    .PeerHealthLedger`) down-ranks repeat offenders: candidates this
+    peer's ledger currently penalizes (strikes from recent allreduce
+    bans, decaying over a few epochs) are dropped from the local
+    candidate view, so a flapping or hostile peer stops costing every
+    epoch a ban timeout. The ledger is local knowledge — rosters can
+    diverge transiently, which the group-hash drop rule already
+    tolerates (a leader-confirmed roster still overrides).
 
     With an ``authorizer`` (swarm/auth.py), the announce carries this
     peer's access token and every honest member drops candidates whose
@@ -218,7 +229,7 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
         now = time.monotonic()
         if now >= deadline:
             break
-        current = _read_candidates(dht, key, authorizer)
+        current = _read_candidates(dht, key, authorizer, ledger)
         if [m.peer_id for m in current] == [m.peer_id for m in seen]:
             stable_polls += 1
         else:
@@ -233,7 +244,7 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
             break
         time.sleep(min(0.25, max(0.0, deadline - now)))
 
-    members = _read_candidates(dht, key, authorizer)
+    members = _read_candidates(dht, key, authorizer, ledger)
     if not any(m.peer_id == my_id for m in members):
         # our own announce hasn't landed anywhere readable: run solo
         members = sorted(
@@ -257,23 +268,69 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
                                        sealed_keys)
         if any(not m.addr for m in members):
             # client-mode members have no listener: park the confirmation in
-            # the leader's mailbox for them to pull. Post BEFORE the send
-            # loop — sends to dead followers can block for confirm_wait
-            # each, and the clients' polling window would expire first.
+            # the leader's mailbox for them to pull. Post BEFORE the sends —
+            # a send to a dead follower can still burn its own timeout, and
+            # the clients' polling window must not wait on that.
             dht.post(_confirm_tag(prefix, epoch, "clients"), payload,
                      expiration_time=get_dht_time()
                      + matchmaking_time * 4 + 60)
-        for m in members:
-            if m.peer_id == my_id or not m.addr:
-                continue
-            dht.send(m.addr, _confirm_tag(prefix, epoch, m.peer_id), payload,
-                     timeout=confirm_wait)
+        targets = [m for m in members if m.peer_id != my_id and m.addr]
+        if targets:
+            # bounded-PARALLEL confirmation fan-out: serially, each send
+            # to a dead follower blocked for up to confirm_wait, so a
+            # leader confirming K followers took K x confirm_wait — long
+            # past every follower's own confirmation deadline. In
+            # parallel the whole fan-out is bounded by ~confirm_wait
+            # regardless of K; stragglers past the bound are abandoned
+            # (their sends self-terminate on their own timeout) and the
+            # affected followers fall back to their DHT roster view,
+            # the normal degraded path.
+            # daemon threads, not a ThreadPoolExecutor: pool workers are
+            # non-daemon, so abandoning stragglers with
+            # shutdown(wait=False) left up to confirm_wait of exit-time
+            # join (threading._shutdown) and tripped thread-hygiene
+            # checks. Each send self-terminates on its own confirm_wait
+            # timeout either way.
+            delivered = [False] * len(targets)
+
+            def _confirm_one(k: int, m: GroupMember) -> None:
+                try:
+                    delivered[k] = dht.send(
+                        m.addr, _confirm_tag(prefix, epoch, m.peer_id),
+                        payload, confirm_wait)
+                except Exception:  # noqa: BLE001 - counted undelivered
+                    logger.debug("confirmation send to %s raised",
+                                 m.peer_id[:16], exc_info=True)
+            threads = [threading.Thread(target=_confirm_one, args=(k, m),
+                                        name=f"confirm-{m.peer_id[:8]}",
+                                        daemon=True)
+                       for k, m in enumerate(targets)]
+            for t in threads:
+                t.start()
+            bound = time.monotonic() + confirm_wait + 1.0
+            for t in threads:
+                t.join(max(0.0, bound - time.monotonic()))
+            straggling = sum(1 for t in threads if t.is_alive())
+            undelivered = sum(
+                1 for k, t in enumerate(threads)
+                if not t.is_alive() and not delivered[k])
+            if undelivered or straggling:
+                logger.info(
+                    "leader confirmation fan-out: %d/%d send(s) failed, "
+                    "%d still in flight at the bound (followers fall "
+                    "back to their DHT roster view)", undelivered,
+                    len(targets), straggling)
     else:
+        awaited_leader = True
         if client_mode and dht._relay_addr is None:
             # plain client mode (no relay): pull from the leader's
             # mailbox; poll, since the leader may still be finishing its
-            # own matchmaking window
+            # own matchmaking window. An addr-less (client-mode) leader
+            # has no mailbox to poll — this peer never waits on it, so
+            # a missing confirmation is NOT evidence of a vanished
+            # leader and must not feed the ledger.
             raw = None
+            awaited_leader = bool(leader.addr)
             confirm_deadline = time.monotonic() + confirm_wait
             while raw is None and leader.addr:
                 remaining = confirm_deadline - time.monotonic()
@@ -300,6 +357,15 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
             # unsigned/forged/mismatched: fall back to our own DHT view
             # (group_key stays None -> this peer sits the encrypted round
             # out, ban-and-proceed elasticity)
+        elif ledger is not None and awaited_leader:
+            # the announced leader vanished in the announce->confirm
+            # window: the bounded confirm_wait we actually spent
+            # waiting elapsed, so the epoch proceeds on our DHT roster
+            # view (the dead leader is banned-and-renormalized inside
+            # the round) — record the no-show so a flapping leader is
+            # down-ranked out of the candidate view for the next few
+            # epochs
+            ledger.strike(leader.peer_id, "confirm-timeout")
 
     members = sorted(members, key=lambda m: m.peer_id)
     try:
@@ -312,7 +378,7 @@ def make_group(dht: DHT, prefix: str, epoch: int, weight: float,
 
 
 def _read_candidates(dht: DHT, key: str,
-                     authorizer=None) -> List[GroupMember]:
+                     authorizer=None, ledger=None) -> List[GroupMember]:
     entries = dht.get(key) or {}
     out = {}
     for _subkey, item in entries.items():
@@ -324,6 +390,15 @@ def _read_candidates(dht: DHT, key: str,
         # the addr-keyed identity the announcer wrote under its own subkey
         pid = dht.bound_peer_id(_subkey)
         if pid is None:
+            continue
+        if (ledger is not None and pid != dht.peer_id
+                and ledger.penalized(pid)):
+            # down-ranked repeat offender (recent allreduce bans, see
+            # health.py): keep it out of this peer's candidate view
+            # until its strikes decay. DEBUG: this poll repeats every
+            # ~0.25 s for the whole matchmaking window
+            logger.debug("matchmaking: skipping penalized peer %s "
+                         "(health score %.1f)", pid[:16], ledger.score(pid))
             continue
         token = bytes(rec.get("tok") or b"")
         if authorizer is not None:

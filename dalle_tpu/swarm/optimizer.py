@@ -142,11 +142,17 @@ class CollaborativeOptimizer:
         # the coordinator — speaks the swarm protocol; followers run the
         # same jitted steps (their devices already join the global-mesh
         # collectives) and receive decisions/averages via broadcasts.
+        # Peer-health ledger (swarm/health.py): allreduce bans feed
+        # strikes; matchmaking and progress aggregation down-rank repeat
+        # offenders until the strikes decay. Local knowledge only.
         if self.role.swarm_enabled:
+            from dalle_tpu.swarm.health import PeerHealthLedger
+            self.ledger = PeerHealthLedger()
             self.tracker = ProgressTracker(
                 dht, cfg.run_id, cfg.target_batch_size,
-                client_mode=client_mode)
+                client_mode=client_mode, ledger=self.ledger)
         else:
+            self.ledger = None
             self.tracker = _FollowerTracker()
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
@@ -195,7 +201,8 @@ class CollaborativeOptimizer:
                     dht, cfg.run_id, self._state_snapshot,
                     codec=self._state_codec,
                     adaptive_threshold=cfg.size_adaptive_threshold,
-                    epoch_fn=lambda: self.local_epoch).start()
+                    epoch_fn=lambda: self.local_epoch,
+                    stream_timeout=cfg.averaging_timeout).start()
             else:
                 # the snapshot runs on a server thread that cannot join
                 # the cross-process all-gather a sharded state needs;
@@ -364,7 +371,7 @@ class CollaborativeOptimizer:
                 matchmaking_time=self.cfg.matchmaking_time,
                 min_group_size=self.matchmaking_min_group,
                 client_mode=self.client_mode, authorizer=self.authorizer,
-                encrypt=self.cfg.encrypt_data_plane)
+                encrypt=self.cfg.encrypt_data_plane, ledger=self.ledger)
             t_match = time.monotonic()
             pending.timings["matchmaking_s"] = round(t_match - t0, 4)
             if group is not None and group.size > 1:
@@ -401,7 +408,8 @@ class CollaborativeOptimizer:
                         pending.epoch, grads_local, weight=pending.weight,
                         allreduce_timeout=budget, codec=self._grad_codec,
                         adaptive_threshold=self.cfg.size_adaptive_threshold,
-                        codec_backend=self._codec_backend)
+                        codec_backend=self._codec_backend,
+                        ledger=self.ledger)
                 pending.result = averaged
                 pending.timings["allreduce_s"] = round(
                     time.monotonic() - t_match, 4)
@@ -534,7 +542,7 @@ class CollaborativeOptimizer:
             weight=weight, matchmaking_time=self.cfg.matchmaking_time,
             min_group_size=self.matchmaking_min_group,
             client_mode=self.client_mode, authorizer=self.authorizer,
-            encrypt=self.cfg.encrypt_data_plane)
+            encrypt=self.cfg.encrypt_data_plane, ledger=self.ledger)
         t_match = time.monotonic()
         exchanging = group is not None and group.size > 1
         mode = (self._X_POWERSGD if self._powersgd is not None else
@@ -569,7 +577,7 @@ class CollaborativeOptimizer:
                     self.local_epoch, grads_local, weight=weight,
                     allreduce_timeout=budget, codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
-                    codec_backend=self._codec_backend)
+                    codec_backend=self._codec_backend, ledger=self.ledger)
         else:
             # alone this epoch: with a deferred pull the grads never left
             # the device — they flow straight into the jitted apply
@@ -657,7 +665,8 @@ class CollaborativeOptimizer:
                     allreduce_timeout=budget / 2,
                     codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
-                    report=rep, codec_backend=self._codec_backend)
+                    report=rep, codec_backend=self._codec_backend,
+                    ledger=self.ledger)
                 if not rep.get("complete", False):
                     ok = 0
             if sharded:
@@ -669,6 +678,18 @@ class CollaborativeOptimizer:
             return out
 
         return reduce_fn
+
+    def _note_epoch_advanced(self) -> None:
+        """Every epoch advance (global step or peer-state load) drives
+        the health ledger's strike decay and the chaos layer's
+        crash-at-epoch trigger (ChaosDHT.note_epoch — a no-op attribute
+        miss on a plain DHT)."""
+        if self.ledger is not None:
+            self.ledger.advance_epoch(self.local_epoch)
+        note = getattr(self.dht, "note_epoch", None) \
+            if self.dht is not None else None
+        if note is not None:
+            note(self.local_epoch)
 
     def _apply_averaged(self, treedef, averaged,
                         preserve_accumulator: bool = False) -> None:
@@ -691,6 +712,7 @@ class CollaborativeOptimizer:
             self.local_samples = 0
             self._grad_acc = None
         self.tracker.reset_epoch(self.local_epoch)
+        self._note_epoch_advanced()
 
         if (self.cfg.average_state_every > 0
                 and self.local_epoch % self.cfg.average_state_every == 0):
@@ -779,7 +801,8 @@ class CollaborativeOptimizer:
                     allreduce_timeout=self.cfg.allreduce_timeout,
                     codec=self._state_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
-                    codec_backend=self._codec_backend)
+                    codec_backend=self._codec_backend,
+                    ledger=self.ledger)
         if not broadcast_decision(0 if averaged is None else 1):
             return
         if floats is None:  # follower of a slice whose coordinator averaged
@@ -868,6 +891,7 @@ class CollaborativeOptimizer:
         self.local_samples = 0
         self._grad_acc = None
         self.tracker.reset_epoch(self.local_epoch)
+        self._note_epoch_advanced()
         for cb in self.on_load_state_from_peers:
             cb()
         return True

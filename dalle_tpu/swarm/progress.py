@@ -92,13 +92,21 @@ class ProgressTracker:
                  expected_drift_peers: float = 3.0,
                  metadata_expiration: float = 60.0,
                  min_refresh_period: float = 0.5,
-                 client_mode: bool = False):
+                 client_mode: bool = False,
+                 ledger=None):
         self.dht = dht
         self.key = f"{run_id}_progress"
         self.target_batch_size = target_batch_size
         self.metadata_expiration = metadata_expiration
         self.min_refresh_period = min_refresh_period
         self.client_mode = client_mode
+        # optional health.PeerHealthLedger: progress records from peers
+        # this node's ledger currently penalizes (repeat allreduce
+        # offenders) are ignored in the aggregate — a peer spewing
+        # corrupt rounds must not also drive our epoch clock or inflate
+        # the swarm's sample total. Strikes decay, so a rehabilitated
+        # peer re-enters the aggregate after a few clean epochs.
+        self.ledger = ledger
         self.performance_ema = PerformanceEMA()
         self.local_epoch = 0
         self.samples_accumulated = 0
@@ -163,6 +171,9 @@ class ProgressTracker:
             bound = self.dht.bound_peer_id(subkey)
             if bound is None or str(rec.get("peer_id")) != bound:
                 continue
+            if (self.ledger is not None and bound != self.dht.peer_id
+                    and self.ledger.penalized(bound)):
+                continue  # down-ranked offender: not part of our clock
             try:
                 prog = LocalProgress(
                     peer_id=bound,

@@ -22,7 +22,7 @@ import os
 import struct
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import msgpack
 import numpy as np
@@ -34,6 +34,10 @@ from dalle_tpu.swarm.identity import Identity, open_frame, signed_frame
 logger = logging.getLogger(__name__)
 
 _CHUNK = 8 << 20  # 8 MB frames (native transport caps at 64 MB)
+#: minimum amortized wall grant per outbound stream frame: the stream
+#: budget is max(stream_timeout, n_frames * this), so multi-GB states
+#: stay servable while a slow client is still bounded per frame
+_FRAME_BUDGET_S = 5.0
 
 
 def _seal_maybe(req_kx: bytes, frame: bytes) -> bytes:
@@ -168,10 +172,19 @@ class StateServer:
                  adaptive_threshold: int =
                  compression.SIZE_ADAPTIVE_THRESHOLD,
                  max_concurrent_streams: int = 2,
-                 epoch_fn: Optional[Callable[[], int]] = None):
+                 epoch_fn: Optional[Callable[[], int]] = None,
+                 stream_timeout: float = 60.0):
         self.dht = dht
         self.prefix = prefix
         self.provider = provider
+        # wall budget for ONE outbound state stream (floored at
+        # _FRAME_BUDGET_S per frame so huge states stay servable);
+        # per-frame send timeouts are derived from what remains of it,
+        # so a slow or dead client pins a server thread for a bounded
+        # amortized grant per frame — not a hard-coded 30 s PER FRAME
+        # (a multi-GB state is hundreds of frames). Callers wire the
+        # swarm's averaging_timeout here.
+        self.stream_timeout = stream_timeout
         # cheap epoch probe so announcements refresh the moment the epoch
         # advances; stale announced epochs otherwise starve resyncing
         # stragglers for a whole period. Without it, announcements stay on
@@ -281,13 +294,48 @@ class StateServer:
                      req_kx: bytes = b"") -> None:
         tag = _rsp_tag(self.prefix, nonce)
         n = max(1, (len(blob) + _CHUNK - 1) // _CHUNK)
+        # one deadline for the WHOLE stream: each frame gets what
+        # remains of the transfer budget, never a flat per-frame grant
+        # that a slow client could collect n times over. The budget
+        # scales with the frame count so a state bigger than
+        # stream_timeout's worth of wall time stays servable — the
+        # floor caps a slow client at ~_FRAME_BUDGET_S per frame
+        # AMORTIZED (8 MB frames -> a minimum-bandwidth bar), while a
+        # dead client still exits on its first failed send
+        budget = max(self.stream_timeout, n * _FRAME_BUDGET_S)
+        deadline = time.monotonic() + budget
         for i in range(n):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logger.warning(
+                    "state stream to %s aborted: %d/%d frames within "
+                    "the %.0fs stream budget (client too slow or gone)",
+                    addr, i, n, budget)
+                return
             part = blob[i * _CHUNK:(i + 1) * _CHUNK]
             frame = _chunk_frame(self.dht.identity, self.prefix, nonce,
                                  i, n, part)
             frame = _seal_maybe(req_kx, frame)
-            if not self.dht.send(addr, tag, frame, timeout=30.0):
+            if not self.dht.send(addr, tag, frame,
+                                 timeout=min(30.0, remaining)):
                 return
+
+
+def _advertised_servers(dht: DHT, prefix: str
+                        ) -> List[Tuple[int, str, str]]:
+    """Live (advertised_epoch, addr, peer_id) records, freshest first."""
+    entries = dht.get(f"{prefix}_state_servers") or {}
+    servers = []
+    for subkey, item in entries.items():
+        rec = item.value
+        if not isinstance(rec, dict) or "addr" not in rec:
+            continue
+        pid = dht.bound_peer_id(subkey)
+        if pid is None or pid == dht.peer_id:
+            continue
+        servers.append((int(rec.get("epoch", 0)), str(rec["addr"]), pid))
+    servers.sort(reverse=True)
+    return servers
 
 
 def load_state_from_peers(dht: DHT, prefix: str,
@@ -303,73 +351,128 @@ def load_state_from_peers(dht: DHT, prefix: str,
     one in the downloaded state. If nobody serves ``min_epoch`` or newer,
     the freshest state actually received is returned — catching a
     straggler up partway beats returning nothing.
-    """
-    entries = dht.get(f"{prefix}_state_servers") or {}
-    servers = []
-    for subkey, item in entries.items():
-        rec = item.value
-        if not isinstance(rec, dict) or "addr" not in rec:
-            continue
-        pid = dht.bound_peer_id(subkey)
-        if pid is None or pid == dht.peer_id:
-            continue
-        servers.append((int(rec.get("epoch", 0)), str(rec["addr"]), pid))
-    servers.sort(reverse=True)
 
+    Failure handling (the elasticity contract): a server that dies or
+    stalls MID-STREAM costs a ~10 s stall window (the chunk collectors'
+    no-fresh-chunk abandon) — not the whole timeout — and the client
+    moves on to a *different* advertised server; a healthy-but-slow
+    stream is never cut off while it makes progress. Once every
+    advertised server has been tried the list is re-fetched (new
+    servers may have announced meanwhile) with a capped exponential
+    backoff between sweeps, until the deadline.
+    """
     deadline = time.monotonic() + timeout
     best: Optional[Tuple[int, List[np.ndarray]]] = None
-    for advertised, addr, pid in servers:
+    fail_counts: Dict[str, int] = {}
+    backoff = 0.5
+    while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
-        if advertised < min_epoch:
-            # below min_epoch, advertisements are sorted descending: once a
-            # fallback download is in hand, further servers are strictly
-            # staler — stop sweeping. Failed attempts (dead server) don't
-            # count; the next stale server still gets its chance.
-            if best is not None:
+        servers = _advertised_servers(dht, prefix)
+        if not servers:
+            if not fail_counts and best is None:
+                # nobody has EVER advertised a state server (sharded
+                # trainers don't run one): the historical fast exit —
+                # resync/archive callers poll at their own cadence, and
+                # sleeping out their full timeout here pinned the
+                # training thread / aux archive for minutes per call.
+                # Re-sweeps are only for failing over FROM a server that
+                # vanished or stalled mid-stream.
+                return None
+            time.sleep(min(backoff, remaining))
+            backoff = min(backoff * 2, 4.0)
+            continue
+        # retry order: servers that have not failed on us first, then by
+        # advertised freshness — "a different advertised server" before
+        # hammering the one that just died mid-stream
+        servers.sort(key=lambda s: (fail_counts.get(s[2], 0), -s[0]))
+        for advertised, addr, pid in servers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 break
-        nonce = os.urandom(16)  # CSPRNG: the nonce is the freshness binding
-        # relay-attached client peers CAN receive pushed chunks (their
-        # relay route is the reply address); only plain client mode pays
-        # the mailbox-poll pull path
-        reply_addr = dht.reachable_address
-        # the kx public key lets the server seal chunks so only this
-        # requester can read the state stream (swarm/crypto.py)
-        req = msgpack.packb({"addr": reply_addr, "nonce": nonce,
-                             "kx": dht.kx.public_bytes},
-                            use_bin_type=True)
-        if not dht.send(addr, _req_tag(prefix, pid), req,
-                        timeout=min(10.0, remaining)):
-            continue
-        if not reply_addr:
-            blob = _pull_chunks(dht, prefix, addr, nonce, deadline, pid)
-        else:
-            blob = _collect_chunks(dht, _rsp_tag(prefix, nonce), deadline,
-                                   prefix, nonce, pid)
-        if blob is None:
-            continue
-        try:
-            result = deserialize_state(blob)
-        except Exception:  # noqa: BLE001 - corrupt stream
-            logger.warning("corrupt state stream from %s (advertised "
-                           "epoch %d): trying the next server", pid,
-                           advertised, exc_info=True)
-            continue
-        if result[0] >= min_epoch:
-            return result
-        if best is None or result[0] > best[0]:
-            best = result
+            if advertised < min_epoch and best is not None:
+                # a fallback is in hand and this server cannot promise
+                # better: skip it this sweep
+                continue
+            # no hard per-attempt cap — a healthy server that is merely
+            # slow (big state, thin pipe) keeps the full remaining
+            # deadline and is never cut off mid-progress. A DEAD server
+            # is abandoned by the chunk collectors' no-fresh-chunk
+            # stall window instead, scaled so that even under a short
+            # caller timeout one corpse leaves budget for the other
+            # advertised servers
+            stall = min(10.0, max(2.0, remaining / max(2, len(servers))))
+            nonce = os.urandom(16)  # CSPRNG: the freshness binding
+            # relay-attached client peers CAN receive pushed chunks
+            # (their relay route is the reply address); only plain
+            # client mode pays the mailbox-poll pull path
+            reply_addr = dht.reachable_address
+            # the kx public key lets the server seal chunks so only this
+            # requester can read the state stream (swarm/crypto.py)
+            req = msgpack.packb({"addr": reply_addr, "nonce": nonce,
+                                 "kx": dht.kx.public_bytes},
+                                use_bin_type=True)
+            if not dht.send(addr, _req_tag(prefix, pid), req,
+                            timeout=min(10.0, remaining)):
+                fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                continue
+            if not reply_addr:
+                blob = _pull_chunks(dht, prefix, addr, nonce,
+                                    deadline, pid, stall_timeout=stall)
+            else:
+                blob = _collect_chunks(dht, _rsp_tag(prefix, nonce),
+                                       deadline, prefix, nonce,
+                                       pid, stall_timeout=stall)
+            if blob is None:
+                fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                logger.info(
+                    "state stream from %s failed/stalled mid-transfer: "
+                    "trying a different server", pid[:16])
+                continue
+            try:
+                result = deserialize_state(blob)
+            except Exception:  # noqa: BLE001 - corrupt stream
+                fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                logger.warning("corrupt state stream from %s (advertised "
+                               "epoch %d): trying the next server", pid,
+                               advertised, exc_info=True)
+                continue
+            if result[0] >= min_epoch:
+                return result
+            if best is None or result[0] > best[0]:
+                best = result
+        if best is not None and not any(
+                adv >= min_epoch and fail_counts.get(pid, 0) == 0
+                for adv, _a, pid in servers):
+            # nothing un-failed still promises min_epoch: the fallback
+            # is the best this swarm can do right now
+            break
+        # pause between sweeps whether or not this one made progress: a
+        # server whose advert runs ahead of its snapshot (announce fires
+        # before the epoch's state is applied) serves a stale epoch with
+        # no failure recorded, and without growing backoff the loop
+        # re-downloads the full state back-to-back until the snapshot
+        # catches up
+        time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+        backoff = min(backoff * 2, 4.0)
     return best
 
 
 def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
-                 deadline: float, expected_pid: str) -> Optional[bytes]:
-    """Client-mode download: poll the server's mailbox for each chunk."""
+                 deadline: float, expected_pid: str,
+                 stall_timeout: float = 10.0) -> Optional[bytes]:
+    """Client-mode download: poll the server's mailbox for each chunk.
+    Abandons the stream (returns None) after ``stall_timeout`` seconds
+    without a fresh chunk — a server that died mid-stream must cost a
+    stall window, not the whole deadline."""
     chunks = {}
     total = None
     i = 0
+    last_progress = time.monotonic()
     while time.monotonic() < deadline:
+        if time.monotonic() - last_progress >= stall_timeout:
+            return None  # mid-stream stall: caller tries another server
         raw = dht.fetch(addr, _chunk_tag(prefix, nonce, i),
                         timeout=min(5.0, max(
                             0.1, deadline - time.monotonic())))
@@ -386,16 +489,24 @@ def _pull_chunks(dht: DHT, prefix: str, addr: str, nonce: bytes,
         total = n
         chunks[i] = part
         i += 1
+        last_progress = time.monotonic()
         if i == total:
             return b"".join(chunks[k] for k in range(total))
     return None
 
 
 def _collect_chunks(dht: DHT, tag: int, deadline: float, prefix: str,
-                    nonce: bytes, expected_pid: str) -> Optional[bytes]:
+                    nonce: bytes, expected_pid: str,
+                    stall_timeout: float = 10.0) -> Optional[bytes]:
+    """Drain the pushed state stream. Abandons (returns None) after
+    ``stall_timeout`` seconds without a fresh chunk, so a server that
+    died mid-stream costs a stall window, not the caller's deadline."""
     chunks = {}
     total = None
+    last_progress = time.monotonic()
     while time.monotonic() < deadline:
+        if time.monotonic() - last_progress >= stall_timeout:
+            return None  # mid-stream stall: caller tries another server
         raw = dht.recv(tag, timeout=min(
             1.0, max(0.05, deadline - time.monotonic())))
         if raw is None:
@@ -411,6 +522,7 @@ def _collect_chunks(dht: DHT, tag: int, deadline: float, prefix: str,
         if n != total or i >= n:
             continue
         chunks[i] = part
+        last_progress = time.monotonic()
         if len(chunks) == total:
             break
     if total is None or len(chunks) != total:

@@ -83,6 +83,13 @@ class TrainingTask:
                   identity=self.identity,
                   record_validators=make_validators(
                       self.identity, self.peer_cfg.experiment_prefix))
+        # deterministic fault injection (swarm/chaos.py, CHAOS.md):
+        # wrap the transport BEFORE anything else touches it, so
+        # matchmaking, all-reduce, state transfer, progress and
+        # rendezvous all run through the faulted seam; with no plan
+        # configured the node is returned untouched (bit-transparent)
+        from dalle_tpu.swarm.chaos import maybe_wrap
+        dht = maybe_wrap(dht, self.collab_cfg.chaos_plan)
         # advertise now and RE-advertise on a background cadence —
         # rendezvous records/lines expire (DEFAULT_TTL), so a one-shot
         # publish would strand joiners arriving later than the TTL

@@ -1,0 +1,423 @@
+#!/usr/bin/env python
+"""Churn soak: N real-socket peers under a seeded fault schedule.
+
+The paper's core claim — a swarm of elastic, unreliable volunteers
+behaves like one synchronous data-parallel trainer — as an executable
+gate. N loopback peers run the real protocol stack (matchmaking ->
+butterfly all-reduce -> state apply, with a StateServer each) while a
+seeded schedule injects churn through the chaos layer (swarm/chaos.py):
+
+- **kills** — `crash_at_epoch` in the victim's FaultPlan; the victim's
+  transport dies between rounds and its native node is torn down
+  abruptly while survivors may still be talking to it;
+- **joins** — a fresh peer bootstraps mid-run, downloads the state from
+  the swarm (`load_state_from_peers`, exercising the
+  failover-to-a-different-server path when a dead server's
+  advertisement lingers), and trains onward;
+- **a partition window** — a timed total `Blackout` on one peer: both
+  wire planes severed, the peer degrades to ALONE epochs, then heals
+  and rejoins.
+
+Assertions (violations -> exit 1, scriptable as a gate):
+
+- *liveness*: every survivor reaches the target epoch before the
+  deadline (no wedged rounds), per-peer epochs advance monotonically,
+  and zero Python threads leak past teardown;
+- *convergence*: all survivors (joiner included) end at the target
+  epoch with identical state fingerprints.
+
+The convergence oracle: every peer contributes the SAME deterministic
+integer-valued gradient g(epoch) with weight 1.0 and the exact (NONE)
+codec, so the weighted average equals g(epoch) bit-exactly for ANY
+surviving roster — group, subgroup, or ALONE — and the state after
+epoch e is sum(g(0..e)) on every honest path. Any fault-handling bug
+that lets damaged or partial data into the accumulator, or hands a
+joiner a torn (epoch, state) snapshot, breaks fingerprint equality.
+(Weight renormalization itself is pinned by tests/test_chaos.py — with
+identical contributions the average is weight-invariant by design.)
+
+Results land in CHURN_SOAK.json (schedule included: the same --seed
+reproduces the same fault schedule). The tier-1 fast variant and the
+slow-marked full soak both live in tests/test_chaos.py; see CHAOS.md
+for methodology and the 2-core-box caveats.
+
+Usage::
+
+    python scripts/churn_soak.py                  # full soak, defaults
+    python scripts/churn_soak.py --peers 3 --epochs 4 --kills 1 \
+        --joins 1 --matchmaking-time 1.2 --allreduce-timeout 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+from dalle_tpu.swarm import DHT, Identity  # noqa: E402
+from dalle_tpu.swarm import compression  # noqa: E402
+from dalle_tpu.swarm.allreduce import run_allreduce  # noqa: E402
+from dalle_tpu.swarm.chaos import Blackout, ChaosDHT, FaultPlan  # noqa: E402
+from dalle_tpu.swarm.health import PeerHealthLedger  # noqa: E402
+from dalle_tpu.swarm.matchmaking import make_group  # noqa: E402
+from dalle_tpu.swarm.state_transfer import (StateServer,  # noqa: E402
+                                            load_state_from_peers)
+
+STATE_ELEMS = 256
+
+
+def fingerprint(state: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(state).tobytes()) \
+        .hexdigest()[:16]
+
+
+def grads_for_epoch(epoch: int, n: int = STATE_ELEMS) -> np.ndarray:
+    """The shared per-epoch contribution: small INTEGER values, so sums
+    and the divide-by-group-size renormalize back bit-exactly (k*g/k
+    == g in IEEE f32 when k*g is exact) — the convergence oracle."""
+    rng = np.random.RandomState(1000 + epoch)
+    return rng.randint(-8, 9, size=n).astype(np.float32)
+
+
+def build_schedule(seed: int, n_peers: int, epochs: int, kills: int,
+                   joins: int, partition: bool = True) -> dict:
+    """Seeded Poisson-ish churn schedule. Kill/partition victims are
+    drawn without replacement from the initial roster (a partitioned
+    peer is never also killed: it must survive to prove it re-merges);
+    event epochs arrive with exponential gaps, clamped inside the run."""
+    rng = random.Random(seed)
+    kills = min(kills, max(0, n_peers - 2))  # >= 2 peers must survive
+    victims = rng.sample(range(n_peers), k=min(n_peers, kills + 1))
+    kill_events = []
+    e = 0.0
+    for v in victims[:kills]:
+        e += rng.expovariate(2.0 / max(1, epochs))
+        kill_events.append({"peer": v,
+                            "epoch": 1 + int(e) % max(1, epochs - 1)})
+    join_events = []
+    e = 0.0
+    for _ in range(joins):
+        e += rng.expovariate(2.0 / max(1, epochs))
+        join_events.append({"at_epoch": 1 + int(e) % max(1, epochs - 1)})
+    part = None
+    if partition and n_peers >= 2:
+        start = round(rng.uniform(2.0, 5.0), 2)
+        part = {"peer": victims[kills], "start_s": start,
+                "end_s": round(start + rng.uniform(2.0, 4.0), 2)}
+    return {"seed": seed, "kills": kill_events, "joins": join_events,
+            "partition": part}
+
+
+class SoakPeer:
+    """One volunteer: a real DHT node (chaos-wrapped when its schedule
+    says so), a StateServer, and the epoch loop."""
+
+    def __init__(self, name: str, node: DHT, plan: FaultPlan, prefix: str,
+                 target_epochs: int, deadline: float,
+                 matchmaking_time: float, allreduce_timeout: float,
+                 state: Optional[np.ndarray] = None, epoch: int = 0):
+        self.name = name
+        self.node = node
+        self.dht = ChaosDHT(node, plan) if plan.enabled else node
+        self.prefix = prefix
+        self.target = target_epochs
+        self.deadline = deadline
+        self.mt = matchmaking_time
+        self.at = allreduce_timeout
+        self.lock = threading.Lock()
+        self.state = (state.copy() if state is not None
+                      else np.zeros(STATE_ELEMS, np.float32))
+        self.epoch = epoch
+        self.epoch_log: List[int] = [epoch]
+        self.ledger = PeerHealthLedger()
+        self.died = False
+        self.errors: List[str] = []
+        self.server = StateServer(self.dht, prefix, self._provide,
+                                  announce_period=1.0,
+                                  stream_timeout=allreduce_timeout)
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"soak-{name}")
+
+    def _provide(self):
+        # atomic (epoch, state) snapshot: a torn pair would hand a
+        # joiner epoch e with the state of e±1 and break convergence
+        with self.lock:
+            return self.epoch, [self.state.copy()]
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def _run(self) -> None:
+        self.server.start()
+        try:
+            while (self.epoch < self.target
+                   and time.monotonic() < self.deadline):
+                note = getattr(self.dht, "note_epoch", None)
+                if note is not None and note(self.epoch):
+                    pass  # crash fired; the alive check below exits
+                if isinstance(self.dht, ChaosDHT) and not self.dht.alive:
+                    self.died = True
+                    return
+                grads = grads_for_epoch(self.epoch)
+                averaged = grads
+                try:
+                    g = make_group(self.dht, self.prefix,
+                                   epoch=self.epoch, weight=1.0,
+                                   matchmaking_time=self.mt,
+                                   min_group_size=1, ledger=self.ledger)
+                    if g is not None and g.size > 1:
+                        out = run_allreduce(
+                            self.dht, g, self.prefix, self.epoch,
+                            [grads], weight=1.0,
+                            allreduce_timeout=self.at,
+                            sender_timeout=min(2.0, self.at / 3),
+                            codec=compression.NONE, ledger=self.ledger)
+                        averaged = out[0]
+                except Exception as e:  # noqa: BLE001 - degraded epoch
+                    # a failed round is an ALONE-equivalent epoch (the
+                    # optimizer's elasticity contract), never a wedge
+                    self.errors.append(f"epoch {self.epoch}: {e!r}")
+                    averaged = grads
+                self.ledger.advance_epoch(self.epoch)
+                with self.lock:
+                    self.state = self.state + averaged
+                    self.epoch += 1
+                self.epoch_log.append(self.epoch)
+        finally:
+            if self.died:
+                # abrupt process death: stop serving and tear the
+                # native node down while survivors may still be
+                # mid-conversation with it
+                self.server.stop()
+                self.node.shutdown()
+            # survivors keep their StateServer up past the loop (a late
+            # joiner must still find a server); finish() tears it down
+
+    def finish(self) -> None:
+        """Join the loop and tear down whatever the death path didn't."""
+        self.thread.join(timeout=max(0.0, self.deadline
+                                     - time.monotonic()) + 30.0)
+        if not self.died:
+            self.server.stop()
+            self.node.shutdown()
+
+    def result(self, killed: bool) -> Dict:
+        with self.lock:
+            return {"name": self.name, "survivor": not killed,
+                    "killed": killed, "died": self.died,
+                    "final_epoch": self.epoch,
+                    "fingerprint": fingerprint(self.state),
+                    "epoch_log": self.epoch_log,
+                    "round_errors": self.errors,
+                    "injected": dict(getattr(self.dht, "injected", {}))}
+
+
+def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
+                  name: str, prefix: str, target_epochs: int,
+                  deadline: float, mt: float, at: float,
+                  violations: List[str]) -> None:
+    boot = None
+    with peers_lock:
+        for p in peers:
+            if not p.died:
+                boot = p.node.visible_address
+                break
+    if boot is None:
+        violations.append(f"{name}: no live peer to bootstrap from")
+        return
+    node = DHT(initial_peers=[boot], identity=Identity.generate(),
+               rpc_timeout=2.0)
+    result = None
+    while result is None and time.monotonic() < deadline:
+        # the swarm is the checkpoint: a lingering advertisement from a
+        # killed server exercises the try-a-different-server failover
+        result = load_state_from_peers(node, prefix,
+                                       timeout=min(10.0, at * 2))
+        if result is None:
+            # no-server calls return immediately: don't hammer dht.get
+            # at full speed on the 2 cores the peers under test share
+            time.sleep(0.2)
+    if result is None:
+        node.shutdown()
+        violations.append(f"{name}: state download never succeeded")
+        return
+    epoch, arrays = result
+    peer = SoakPeer(name, node, FaultPlan(), prefix,
+                    target_epochs=target_epochs, deadline=deadline,
+                    matchmaking_time=mt, allreduce_timeout=at,
+                    state=arrays[0].astype(np.float32), epoch=epoch)
+    with peers_lock:
+        peers.append(peer)
+    peer.start()
+
+
+def run_soak(args) -> dict:
+    prefix = f"soak{args.seed}"
+    schedule = build_schedule(args.seed, args.peers, args.epochs,
+                              args.kills, args.joins)
+    kill_by_peer = {k["peer"]: k["epoch"] for k in schedule["kills"]}
+    t0 = time.monotonic()
+    deadline = t0 + args.deadline
+    threads_before = set(threading.enumerate())
+
+    peers: List[SoakPeer] = []
+    peers_lock = threading.Lock()
+    violations: List[str] = []
+    nodes: List[DHT] = []
+    for i in range(args.peers):
+        ident = Identity.generate()
+        boots = [nodes[0].visible_address] if nodes else []
+        nodes.append(DHT(initial_peers=boots, identity=ident,
+                         rpc_timeout=2.0))
+    for i, node in enumerate(nodes):
+        blackouts = ()
+        part = schedule["partition"]
+        if part is not None and part["peer"] == i:
+            blackouts = (Blackout(start_s=part["start_s"],
+                                  end_s=part["end_s"]),)
+        plan = FaultPlan(seed=args.seed, blackouts=blackouts,
+                         crash_at_epoch=kill_by_peer.get(i))
+        peers.append(SoakPeer(f"peer{i}", node, plan, prefix,
+                              target_epochs=args.epochs,
+                              deadline=deadline,
+                              matchmaking_time=args.matchmaking_time,
+                              allreduce_timeout=args.allreduce_timeout))
+    for p in peers:
+        p.start()
+
+    pending_joins = sorted((j["at_epoch"] for j in schedule["joins"]),
+                           reverse=True)
+    join_threads: List[threading.Thread] = []
+    n_joined = 0
+    while time.monotonic() < deadline:
+        with peers_lock:
+            live = [p for p in peers if p.thread.is_alive()]
+            max_epoch = max((p.epoch for p in peers), default=0)
+        if pending_joins and max_epoch >= pending_joins[-1]:
+            pending_joins.pop()
+            n_joined += 1
+            jt = threading.Thread(
+                target=_spawn_joiner,
+                args=(peers, peers_lock, f"joiner{n_joined}", prefix,
+                      args.epochs, deadline, args.matchmaking_time,
+                      args.allreduce_timeout, violations),
+                daemon=True, name=f"soak-join{n_joined}")
+            jt.start()
+            join_threads.append(jt)
+        if not live and not pending_joins \
+                and all(not t.is_alive() for t in join_threads):
+            break
+        time.sleep(0.2)
+    for t in join_threads:
+        t.join(timeout=30)
+    with peers_lock:
+        all_peers = list(peers)
+    for p in all_peers:
+        p.finish()
+    elapsed = round(time.monotonic() - t0, 1)
+
+    # -- liveness ---------------------------------------------------------
+    results = [p.result(killed=p.died) for p in all_peers]
+    survivors = [r for r in results if r["survivor"]]
+    for r in results:
+        if r["survivor"] and r["final_epoch"] < args.epochs:
+            violations.append(
+                f"{r['name']} wedged: epoch {r['final_epoch']}"
+                f"/{args.epochs} at the deadline")
+        if r["epoch_log"] != sorted(r["epoch_log"]):
+            violations.append(f"{r['name']}: epochs went backwards")
+    expected_joiners = len(schedule["joins"])
+    if sum(1 for r in results if r["name"].startswith("joiner")) \
+            < expected_joiners:
+        violations.append(
+            f"expected {expected_joiners} joiner(s) in the roster")
+
+    # -- convergence ------------------------------------------------------
+    done = [r for r in survivors if r["final_epoch"] >= args.epochs]
+    fps = {r["fingerprint"] for r in done}
+    if len(fps) > 1:
+        violations.append(f"fingerprints diverged: {sorted(fps)}")
+    want = fingerprint(sum((grads_for_epoch(e)
+                            for e in range(args.epochs)),
+                           np.zeros(STATE_ELEMS, np.float32)))
+    if done and fps != {want}:
+        violations.append(
+            f"fingerprints {sorted(fps)} != analytic {want} — damaged "
+            "or partial data reached a state accumulator")
+
+    # -- thread hygiene ---------------------------------------------------
+    settle = time.monotonic() + 5.0
+    leaked: List[str] = []
+    while time.monotonic() < settle:
+        leaked = [t.name for t in threading.enumerate()
+                  if t not in threads_before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.2)
+    if leaked:
+        violations.append(f"leaked threads: {leaked}")
+
+    return {"seed": args.seed,
+            "params": {"peers": args.peers, "epochs": args.epochs,
+                       "kills": args.kills, "joins": args.joins,
+                       "matchmaking_time": args.matchmaking_time,
+                       "allreduce_timeout": args.allreduce_timeout,
+                       "deadline": args.deadline},
+            "schedule": schedule, "elapsed_s": elapsed,
+            "peers": results, "violations": violations,
+            "pass": not violations}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--peers", type=int, default=5,
+                        help="initial roster size (>= 2 always survive)")
+    parser.add_argument("--epochs", type=int, default=6,
+                        help="target epoch every survivor must reach")
+    parser.add_argument("--kills", type=int, default=2)
+    parser.add_argument("--joins", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="fault schedule seed (same seed -> same "
+                             "schedule, recorded in the report)")
+    parser.add_argument("--matchmaking-time", type=float, default=3.0)
+    parser.add_argument("--allreduce-timeout", type=float, default=8.0)
+    parser.add_argument("--deadline", type=float, default=420.0,
+                        help="hard wall for the whole soak (liveness "
+                             "bound: a wedged round fails here)")
+    parser.add_argument("--out", type=str,
+                        default=os.path.join(_REPO, "CHURN_SOAK.json"))
+    args = parser.parse_args(argv)
+
+    report = run_soak(args)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    ok = report["pass"]
+    print(f"churn soak: {'PASS' if ok else 'FAIL'} in "
+          f"{report['elapsed_s']}s — {len(report['peers'])} peers, "
+          f"{len(report['schedule']['kills'])} kill(s), "
+          f"{len(report['schedule']['joins'])} join(s), partition="
+          f"{report['schedule']['partition']}")
+    for r in report["peers"]:
+        print(f"  {r['name']:>8}: epoch {r['final_epoch']} "
+              f"fp={r['fingerprint']} killed={r['killed']} "
+              f"injected={r['injected']}")
+    for v in report["violations"]:
+        print(f"  VIOLATION: {v}")
+    print(f"report: {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

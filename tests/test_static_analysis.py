@@ -267,6 +267,34 @@ class Worker(threading.Thread):
 """,
     ),
     (
+        "unchecked-pool-future",
+        "dalle_tpu/swarm/fake.py",
+        """
+import concurrent.futures
+def scatter(work, items):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        pool.submit(work, items[0])                 # fire-and-forget
+        futs = [pool.submit(work, it) for it in items]
+        concurrent.futures.wait(futs)               # observes, never reads
+""",
+        """
+import concurrent.futures
+def scatter(work, items, log):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        one = pool.submit(work, items[0])
+        one.add_done_callback(log)
+        futs = [pool.submit(work, it) for it in items]
+        done, straggling = concurrent.futures.wait(futs, timeout=5.0)
+        failed = sum(1 for f in done
+                     if f.exception() is not None or not f.result())
+        retry_futs = [pool.submit(work, it) for it in items[:failed]]
+        for f in retry_futs:
+            f.result()
+        handed_off = [pool.submit(work, it) for it in items]
+        return handed_off                # escapes: the caller consumes
+""",
+    ),
+    (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
         """
