@@ -1,17 +1,24 @@
 """graftlint: AST-based JAX/concurrency hazard analysis for this repo.
 
-Stdlib-``ast`` only. Two rule families:
+Stdlib-``ast`` only. Three rule families:
 
-- **jax**: host-sync-in-jit, host-sync-in-hot-loop, python-rng-in-device,
-  nondet-pytree, literal-divisor-in-quant — invariants of traced device
-  code (and of the serving hot loop's zero-sync dispatch discipline)
-  whose violation breaks determinism, throughput, or the cross-peer
-  wire byte-parity contract (see LINTS.md for the incident history).
-- **concurrency**: silent-except, blocking-in-async, thread-daemon-join,
-  mixed-lock-writes — lifecycle and locking discipline for the swarm's
-  background-thread layer.
+- **jax** (per-file): host-sync-in-jit, host-sync-in-hot-loop,
+  python-rng-in-device, nondet-pytree, literal-divisor-in-quant —
+  invariants of traced device code (and of the serving hot loop's
+  zero-sync dispatch discipline) whose violation breaks determinism,
+  throughput, or the cross-peer wire byte-parity contract (see LINTS.md
+  for the incident history).
+- **concurrency** (per-file): silent-except, blocking-in-async,
+  thread-daemon-join, mixed-lock-writes, unchecked-pool-future —
+  lifecycle and locking discipline for the swarm's background-thread
+  layer.
+- **flow** (whole-program): use-after-donate, lock-order-cycle,
+  rng-key-reuse — flow-sensitive properties resolved over the project
+  model (``project.py``: symbol table, intra-package call graph, jit
+  wrappers with their donate_argnums/static_argnums).
 
-Entry points: ``scripts/lint.py`` (CLI with ``--check``/baseline) and
+Entry points: ``scripts/lint.py`` (CLI with ``--check``/baseline,
+``--diff``/``--jobs``, JSON/SARIF output, content-hash parse cache) and
 ``tests/test_static_analysis.py`` (tier-1 enforcement). Inline
 suppression: ``# graftlint: disable=<rule>[,<rule>...]`` on the flagged
 line or the line above it.
@@ -19,12 +26,16 @@ line or the line above it.
 
 from dalle_tpu.analysis.core import (  # noqa: F401
     Finding,
+    PROJECT_RULES,
     RULES,
+    all_rules,
     analyze_paths,
     analyze_source,
+    analyze_sources,
     diff_baseline,
     fingerprint_findings,
     load_baseline,
     save_baseline,
 )
-from dalle_tpu.analysis import concurrency_rules, jax_rules  # noqa: F401
+from dalle_tpu.analysis import (concurrency_rules, flow_rules,  # noqa: F401
+                                jax_rules)

@@ -1,0 +1,98 @@
+"""graftlint parse cache: content-hash keyed per-file results.
+
+The cold scan parses ~70 files and lowers each to its flow-IR summary;
+the cache stores both products — per-file finding dicts and the project
+summary — keyed on the file's sha256, so a warm scan touches no ``ast``
+at all for unchanged files: it hashes sources, loads this JSON, and
+runs only the (cheap, pure-data) project pass. That is what keeps the
+warm full scan inside the r7 ~2 s tier-1 budget on the 2-core box, and
+what makes ``--diff`` fast: whole-program rules need summaries for the
+WHOLE tree even when only one file changed, and unchanged summaries
+come from here.
+
+Invalidation is structural, not temporal: the version key folds in the
+analyzer version, the summary schema, and the registered rule ids — a
+new rule, changed rule logic (bump ``ANALYZER_VERSION``), or a schema
+change discards the whole cache. Corrupt/foreign cache files are
+ignored, never trusted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def version_key() -> str:
+    from dalle_tpu.analysis.core import (ANALYZER_VERSION, PROJECT_RULES,
+                                         RULES, _load_rules)
+    from dalle_tpu.analysis.project import SUMMARY_SCHEMA
+    _load_rules()
+    ids = ",".join(sorted(RULES) + sorted(PROJECT_RULES))
+    digest = hashlib.sha256(ids.encode()).hexdigest()[:12]
+    return f"{ANALYZER_VERSION}|{SUMMARY_SCHEMA}|{digest}"
+
+
+def load(path: Optional[str]) -> dict:
+    """Load (or initialize) a cache dict. Anything unreadable, of a
+    different version, or structurally off is discarded wholesale."""
+    fresh = {"version": version_key(), "files": {}}
+    if path is None or not os.path.exists(path):
+        return fresh
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if (not isinstance(data, dict)
+                or data.get("version") != fresh["version"]
+                or not isinstance(data.get("files"), dict)):
+            return fresh
+        return data
+    except (OSError, ValueError):
+        return fresh
+
+
+def lookup(cache: dict, rel: str, sha: str
+           ) -> Optional[Tuple[List[dict], Optional[dict]]]:
+    entry = cache["files"].get(rel)
+    if entry is None or entry.get("sha") != sha:
+        return None
+    return entry.get("findings", []), entry.get("summary")
+
+
+def store(cache: dict, rel: str, sha: str, findings: List[dict],
+          summary: Optional[dict]) -> None:
+    cache["files"][rel] = {"sha": sha, "findings": findings,
+                           "summary": summary}
+
+
+def save(path: Optional[str], cache: dict,
+         keep: Optional[Dict[str, str]] = None,
+         in_scope: Optional[Callable[[str], bool]] = None) -> None:
+    """Write the cache atomically (tmp + rename). ``keep`` prunes stale
+    entries — files that were *in this scan's scope* but no longer
+    exist — so a deleted module does not pin its summary forever.
+    ``in_scope`` bounds the pruning: entries outside the scanned paths
+    are ones this scan never looked at, so a path-restricted run
+    (``lint.py dalle_tpu/serving``) must not evict the rest of the
+    tree's entries and turn the next full ``--check`` cold. Without
+    ``in_scope``, every entry is fair game (full-scope semantics)."""
+    if path is None:
+        return
+    if keep is not None:
+        cache["files"] = {
+            rel: e for rel, e in cache["files"].items()
+            if rel in keep
+            or (in_scope is not None and not in_scope(rel))}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(cache, fh, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        # a read-only checkout must not turn the lint into a crash
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

@@ -66,7 +66,7 @@ def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
     " a trace. Log with context (logger.warning + exc_info) or narrow"
     " the exception; parser/crypto contracts that legitimately map any"
     " failure to None may carry a justified"
-    " `# graftlint: disable=silent-except`.")
+    " `# graftlint: disable=silent-except`.", severity="warning")
 def silent_except(ctx: FileContext) -> Iterable[Finding]:
     out: List[Optional[Finding]] = []
     for node in ast.walk(ctx.tree):
@@ -87,7 +87,7 @@ def silent_except(ctx: FileContext) -> Iterable[Finding]:
     "Synchronous blocking call (time.sleep, subprocess, sync"
     " socket/HTTP) inside `async def`: it stalls the whole event loop,"
     " not just this coroutine — use the asyncio equivalents or a thread"
-    " executor.")
+    " executor.", severity="warning")
 def blocking_in_async(ctx: FileContext) -> Iterable[Finding]:
     out: List[Optional[Finding]] = []
 
@@ -149,7 +149,7 @@ def _join_targets(tree: ast.AST) -> Set[str]:
     " `.join()` on the stored handle: a forgotten non-daemon thread"
     " blocks interpreter exit; an unjoined one leaks past shutdown."
     " Thread subclasses must set daemon in __init__ (super().__init__"
-    " (daemon=...) or self.daemon = ...).")
+    " (daemon=...) or self.daemon = ...).", severity="warning")
 def thread_daemon_join(ctx: FileContext) -> Iterable[Finding]:
     out: List[Optional[Finding]] = []
     joined = _join_targets(ctx.tree)
@@ -453,7 +453,7 @@ def _family_consumed(scope: ast.AST, seed: str) -> bool:
     " consumed: a worker exception vanishes into the unread Future and"
     " the failure leaves zero trace (`wait(futs)` alone does NOT consume"
     " — the allreduce retry-pool incident). Read result()/exception(),"
-    " attach add_done_callback, or justify a disable.")
+    " attach add_done_callback, or justify a disable.", severity="warning")
 def unchecked_pool_future(ctx: FileContext) -> Iterable[Finding]:
     executors = _executor_names(ctx.tree)
     if not executors:

@@ -69,6 +69,23 @@ class Finding:
     def format(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
 
+    @property
+    def severity(self) -> str:
+        """The owning rule's severity ("error"/"warning"); not part of
+        the fingerprint, so re-tiering a rule never churns baselines."""
+        r = RULES.get(self.rule) or PROJECT_RULES.get(self.rule)
+        return r.severity if r is not None else "error"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet,
+                "severity": self.severity}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Finding":
+        return cls(rule=d["rule"], path=d["path"], line=d["line"],
+                   message=d["message"], snippet=d["snippet"])
+
 
 def dotted_name(node: ast.AST) -> Optional[str]:
     """``a.b.c`` for an Attribute/Name chain, else None."""
@@ -205,40 +222,77 @@ class FileContext:
 
 # -- rule registry --------------------------------------------------------
 
+#: bump to invalidate parse caches when rule logic changes without a
+#: registry change (cache.py folds this into its version key)
+ANALYZER_VERSION = 2
+
 RuleFn = Callable[[FileContext], Iterable[Finding]]
 
 
 @dataclasses.dataclass(frozen=True)
 class Rule:
     id: str
-    family: str        # "jax" | "concurrency"
+    family: str        # "jax" | "concurrency" | "flow"
     doc: str
-    fn: RuleFn
+    fn: Callable
+    severity: str = "error"    # "error" | "warning" (SARIF level)
 
 
+#: per-file rules: fn(FileContext) -> findings
 RULES: Dict[str, Rule] = {}
+#: project rules: fn(Project) -> findings — run once over the assembled
+#: whole-program model, not per file
+PROJECT_RULES: Dict[str, Rule] = {}
 
 
-def rule(rule_id: str, family: str, doc: str):
+def rule(rule_id: str, family: str, doc: str, severity: str = "error"):
     def register(fn: RuleFn) -> RuleFn:
-        RULES[rule_id] = Rule(id=rule_id, family=family, doc=doc, fn=fn)
+        RULES[rule_id] = Rule(id=rule_id, family=family, doc=doc, fn=fn,
+                              severity=severity)
         return fn
     return register
 
 
+def project_rule(rule_id: str, family: str, severity: str, doc: str):
+    def register(fn: Callable) -> Callable:
+        PROJECT_RULES[rule_id] = Rule(id=rule_id, family=family, doc=doc,
+                                      fn=fn, severity=severity)
+        return fn
+    return register
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rules()
+    merged = dict(RULES)
+    merged.update(PROJECT_RULES)
+    return merged
+
+
 def _load_rules() -> None:
     # import for side effect: rule registration
-    from dalle_tpu.analysis import concurrency_rules, jax_rules  # noqa: F401
+    from dalle_tpu.analysis import (concurrency_rules, flow_rules,  # noqa: F401
+                                    jax_rules)
 
 
 # -- analysis drivers -----------------------------------------------------
 
-def analyze_source(source: str, path: str = "<string>",
-                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run (a subset of) the rules over one source string. ``path``
-    drives the module-role classification, so fixtures can pretend to
-    live in a device/quant module."""
+def _select_rules(rules: Optional[Iterable[str]]):
+    """-> (per-file Rule list, project Rule list); validates ids."""
     _load_rules()
+    if rules is None:
+        return list(RULES.values()), list(PROJECT_RULES.values())
+    unknown = set(rules) - set(RULES) - set(PROJECT_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s): {sorted(unknown)}; "
+            f"known: {sorted(RULES) + sorted(PROJECT_RULES)}")
+    return ([RULES[r] for r in rules if r in RULES],
+            [PROJECT_RULES[r] for r in rules if r in PROJECT_RULES])
+
+
+def _file_findings(source: str, path: str,
+                   file_rules) -> List[Finding]:
+    """Per-file rules over one source string (no project pass)."""
     try:
         ctx = FileContext(path, source)
     except SyntaxError as e:
@@ -246,20 +300,45 @@ def analyze_source(source: str, path: str = "<string>",
                         line=e.lineno or 1,
                         message=f"file does not parse: {e.msg}",
                         snippet="")]
-    if rules is not None:
-        unknown = set(rules) - set(RULES)
-        if unknown:
-            raise ValueError(
-                f"unknown rule id(s): {sorted(unknown)}; "
-                f"known: {sorted(RULES)}")
-        selected = [RULES[r] for r in rules]
-    else:
-        selected = list(RULES.values())
     findings: List[Finding] = []
-    for r in selected:
+    for r in file_rules:
         findings.extend(f for f in r.fn(ctx) if f is not None)
+    return findings
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[Iterable[str]] = None
+                    ) -> List[Finding]:
+    """Analyze a set of in-memory ``{path: source}`` files as one
+    project: per-file rules on each file, project rules (use-after-
+    donate, lock-order-cycle, rng-key-reuse) over the assembled model —
+    how the multi-file fixtures exercise cross-module resolution."""
+    from dalle_tpu.analysis.project import Project, summarize_source
+    file_rules, proj_rules = _select_rules(rules)
+    findings: List[Finding] = []
+    summaries = {}
+    for path, source in sources.items():
+        path = path.replace(os.sep, "/")
+        findings.extend(_file_findings(source, path, file_rules))
+        try:
+            summaries[path] = summarize_source(path, source)
+        except SyntaxError:
+            pass    # parse-error already reported by the per-file pass
+    if proj_rules and summaries:
+        project = Project(summaries, dict(sources))
+        for r in proj_rules:
+            findings.extend(f for f in r.fn(project) if f is not None)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run (a subset of) the rules over one source string. ``path``
+    drives the module-role classification, so fixtures can pretend to
+    live in a device/quant module. Project rules see a single-file
+    project (intra-file resolution only)."""
+    return analyze_sources({path: source}, rules=rules)
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:
@@ -276,18 +355,114 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return sorted(set(out))
 
 
+def _analyze_one(rel: str, source: str):
+    """Worker for the parallel scan: (per-file finding dicts, summary).
+    Top-level so ProcessPoolExecutor can pickle it; computes ALL
+    per-file rules — selection filters at report time, which keeps the
+    parse cache rule-selection-independent."""
+    from dalle_tpu.analysis.project import summarize_source
+    _load_rules()
+    findings = [f.to_dict() for f in
+                _file_findings(source, rel, list(RULES.values()))]
+    try:
+        summary = summarize_source(rel, source)
+    except SyntaxError:
+        summary = None
+    return rel, findings, summary
+
+
 def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
-                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
+                  rules: Optional[Iterable[str]] = None,
+                  jobs: int = 1,
+                  cache_path: Optional[str] = None,
+                  changed_only: Optional[Set[str]] = None) -> List[Finding]:
     """Analyze every ``*.py`` under ``paths``; finding paths are made
     relative to ``root`` (default: cwd) so baselines are machine-
-    independent."""
+    independent.
+
+    ``cache_path``: content-hash parse cache (cache.py) — unchanged
+    files reuse their per-file findings and project summary without
+    re-parsing. ``jobs`` > 1 fans cache misses over a process pool.
+    ``changed_only``: report per-file findings only for these relative
+    paths (the ``--diff`` mode); the project model is still built over
+    the FULL scope — whole-program rules are only sound over the whole
+    program — so flow findings are always reported wherever they land.
+    """
+    from dalle_tpu.analysis import cache as cache_mod
+    from dalle_tpu.analysis.project import Project
+    paths = list(paths)         # iterated twice: file walk + scope prune
     root = os.path.abspath(root or os.getcwd())
-    findings: List[Finding] = []
+    file_rules, proj_rules = _select_rules(rules)
+    file_rule_ids = {r.id for r in file_rules} | {"parse-error"}
+
+    entries: Dict[str, str] = {}       # rel -> source
     for path in iter_python_files(paths):
-        rel = os.path.relpath(os.path.abspath(path), root)
+        rel = os.path.relpath(os.path.abspath(path), root).replace(
+            os.sep, "/")
         with open(path, "r", encoding="utf-8") as f:
-            source = f.read()
-        findings.extend(analyze_source(source, path=rel, rules=rules))
+            entries[rel] = f.read()
+
+    cache = cache_mod.load(cache_path) if cache_path else None
+    per_file: Dict[str, List[dict]] = {}
+    summaries: Dict[str, dict] = {}
+    misses: List[str] = []
+    shas: Dict[str, str] = {}
+    for rel, source in entries.items():
+        sha = hashlib.sha256(source.encode()).hexdigest()
+        shas[rel] = sha
+        hit = cache_mod.lookup(cache, rel, sha) if cache else None
+        if hit is not None:
+            per_file[rel], summaries[rel] = hit
+        else:
+            misses.append(rel)
+
+    if jobs > 1 and len(misses) > 1:
+        import concurrent.futures
+        with concurrent.futures.ProcessPoolExecutor(
+                max_workers=jobs) as pool:
+            futs = [pool.submit(_analyze_one, rel, entries[rel])
+                    for rel in misses]
+            for fut in futs:
+                rel, findings, summary = fut.result()
+                per_file[rel], summaries[rel] = findings, summary
+    else:
+        for rel in misses:
+            _rel, findings, summary = _analyze_one(rel, entries[rel])
+            per_file[rel], summaries[rel] = findings, summary
+
+    if cache is not None:
+        for rel in misses:
+            cache_mod.store(cache, rel, shas[rel], per_file[rel],
+                            summaries[rel])
+        # prune only entries this scan could actually see: a scoped run
+        # (lint.py dalle_tpu/serving) must not evict the rest of the
+        # tree's cache and turn the next full --check cold
+        scope_rels = []
+        for p in paths:
+            rp = os.path.relpath(os.path.abspath(p), root).replace(
+                os.sep, "/")
+            scope_rels.append("" if rp == "." else rp)
+
+        def _in_scope(rel: str) -> bool:
+            return any(sr == "" or rel == sr or rel.startswith(sr + "/")
+                       for sr in scope_rels)
+
+        cache_mod.save(cache_path, cache,
+                       keep={rel: shas[rel] for rel in entries},
+                       in_scope=_in_scope)
+
+    findings: List[Finding] = []
+    for rel, dicts in per_file.items():
+        if changed_only is not None and rel not in changed_only:
+            continue
+        findings.extend(Finding.from_dict(d) for d in dicts
+                        if d["rule"] in file_rule_ids)
+    if proj_rules:
+        project = Project(
+            {rel: sm for rel, sm in summaries.items() if sm is not None},
+            entries)
+        for r in proj_rules:
+            findings.extend(f for f in r.fn(project) if f is not None)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

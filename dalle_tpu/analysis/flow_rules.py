@@ -1,0 +1,500 @@
+"""graftlint flow rule family: whole-program, flow-sensitive hazards.
+
+These three rules run over the :mod:`~dalle_tpu.analysis.project` model
+(flow IR + symbol table + call graph), not a single parsed tree — each
+encodes an invariant the r9 zero-sync engine and the r10 chaos layer
+made load-bearing:
+
+- **use-after-donate** — a buffer handed to a jitted call in a
+  ``donate_argnums`` position is *deleted* on dispatch; any later read
+  through the old binding returns garbage or raises
+  ``RuntimeError: Array has been deleted`` depending on backend timing.
+  The engine's ``_chunk_fn``/``_admit_fn`` and the trainer's donated
+  apply step are the real call sites this guards.
+- **lock-order-cycle** — per-function lock acquisition sequences are
+  lifted through the call graph; a cycle in the global acquisition-order
+  graph means two threads can each hold one lock of the cycle while
+  waiting on the next — a deadlock the engine/pixel/DHT thread mix can
+  actually schedule.
+- **rng-key-reuse** — a ``jax.random`` key consumed by two draws without
+  an intervening ``split`` produces *correlated* samples: silent, no
+  crash, but it breaks the swarm's bit-exact parity oracles (the same
+  request would sample different codes solo vs co-tenant).
+
+All three interpret the same statement-ordered IR with branch-union and
+loop-twice semantics: branches merge conservatively (a hazard on either
+arm survives the join), and loop bodies run twice so a donation or
+consumption at the bottom of an iteration meets its read at the top of
+the next.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dalle_tpu.analysis.core import Finding, project_rule
+from dalle_tpu.analysis.project import Project, iter_functions
+
+# -- shared interpreter plumbing ------------------------------------------
+
+
+def _mk_finding(project: Project, rule: str, path: str, line: int,
+                message: str) -> Optional[Finding]:
+    if project.suppressed(path, line, rule):
+        return None
+    return Finding(rule=rule, path=path, line=line, message=message,
+                   snippet=project.snippet(path, line))
+
+
+# -- use-after-donate ------------------------------------------------------
+
+
+def _matches(binding: str, donated: Dict[str, Tuple[int, str]]
+             ) -> Optional[str]:
+    """The donated binding a read of ``binding`` touches: exact match or
+    a read *through* it (``state.codes`` after ``state`` was donated)."""
+    if binding in donated:
+        return binding
+    for d in donated:
+        if binding.startswith(d + "."):
+            return d
+    return None
+
+
+def _clear_binding(name: str, donated: Dict[str, Tuple[int, str]]) -> None:
+    """Rebinding ``name`` retires it (and anything reached through it)
+    from the donated set — ``state = fn(state)`` is the sanctioned
+    pattern."""
+    for d in list(donated):
+        if d == name or d.startswith(name + "."):
+            del donated[d]
+
+
+def _run_donate_block(block: List[dict], donated: Dict[str, Tuple[int, str]],
+                      ctx: dict, findings: List[Optional[Finding]],
+                      seen: Set[Tuple[int, str]]) -> bool:
+    """Returns True when the block terminated (return/raise/break/
+    continue) — a terminated branch contributes nothing to its join."""
+    project: Project = ctx["project"]
+    for op in block:
+        t = op["t"]
+        if t == "term":
+            return True
+        if t == "read":
+            hit = _matches(op["n"], donated)
+            if hit is not None:
+                key = (op["l"], op["n"])
+                if key not in seen:
+                    seen.add(key)
+                    dline, callee = donated[hit]
+                    findings.append(_mk_finding(
+                        project, "use-after-donate", ctx["path"], op["l"],
+                        f"'{op['n']}' is read after '{hit}' was donated "
+                        f"to {callee} (line {dline}): the buffer was "
+                        "deleted at dispatch — rebind the result "
+                        f"('{hit} = {callee}(...)') or re-slice from "
+                        "the returned state"))
+        elif t == "call":
+            pos = project.donate_positions(
+                ctx["module"], ctx["cls"], ctx["qual"], op)
+            if pos:
+                callee = op.get("fn") or op.get("inner") or "a jitted call"
+                for p in pos:
+                    if p < len(op["args"]) and op["args"][p] is not None:
+                        donated.setdefault(op["args"][p],
+                                           (op["l"], callee))
+        elif t == "assign":
+            for tg in op["tg"]:
+                _clear_binding(tg, donated)
+        elif t == "with":
+            if _run_donate_block(op["b"], donated, ctx, findings, seen):
+                return True
+        elif t == "branch":
+            outs = []
+            n_term = 0
+            for b in op["bs"]:
+                branch_state = dict(donated)
+                if _run_donate_block(b, branch_state, ctx, findings,
+                                     seen):
+                    n_term += 1
+                else:
+                    outs.append(branch_state)
+            merged: Dict[str, Tuple[int, str]] = {}
+            for o in outs:
+                merged.update(o)
+            donated.clear()
+            donated.update(merged)
+            if n_term == len(op["bs"]) and op["bs"]:
+                return True      # every arm left: the join is dead code
+        elif t == "loop":
+            # two passes: the second meets pass-one donations at the top
+            # of the body (the wrap-around read); break/continue inside
+            # stop a pass but never terminate the enclosing block
+            _run_donate_block(op["b"], donated, ctx, findings, seen)
+            _run_donate_block(op["b"], donated, ctx, findings, seen)
+    return False
+
+
+@project_rule(
+    "use-after-donate", "flow", "error",
+    "A binding passed in a donate_argnums position of a jitted call"
+    " (decorator, binding, factory, or immediate jax.jit form — resolved"
+    " through the project call graph) is read again without rebinding:"
+    " the donated buffer was deleted at dispatch, so the read returns"
+    " garbage or raises depending on backend timing. `state = fn(state)`"
+    " is the sanctioned shape; `fn(state); state.x` is the bug.")
+def use_after_donate(project: Project) -> Iterable[Finding]:
+    findings: List[Optional[Finding]] = []
+    for path, module, qual, rec in iter_functions(project):
+        ctx = {"project": project, "path": path, "module": module,
+               "qual": qual, "cls": rec["cls"]}
+        seen: Set[Tuple[int, str]] = set()
+        _run_donate_block(rec["body"], {}, ctx, findings, seen)
+    return [f for f in findings if f is not None]
+
+
+# -- lock-order-cycle ------------------------------------------------------
+
+
+def _direct_lock_info(project: Project, path: str, module: str,
+                      qual: str, rec: dict):
+    """One function's lock facts from its IR:
+
+    - ``acquires``: every lock id acquired anywhere in the body
+    - ``edges``: (outer_id, inner_id, line) for nested with-blocks
+    - ``held_calls``: (held_id, callee_dotted, line) for calls made
+      while holding a lock (lifted through the call graph later)
+    - ``calls``: every callee dotted name (for transitive acquisition)
+    """
+    acquires: Set[str] = set()
+    edges: List[Tuple[str, str, int]] = []
+    held_calls: List[Tuple[str, str, int]] = []
+    calls: List[str] = []
+
+    def walk(block: List[dict], held: List[str]) -> None:
+        for op in block:
+            t = op["t"]
+            if t == "with":
+                ids = []
+                for name in op["locks"]:
+                    lid = project.lock_id(module, rec["cls"], qual, name)
+                    if lid is not None:
+                        ids.append(lid)
+                for lid in ids:
+                    acquires.add(lid)
+                    for h in held:
+                        edges.append((h, lid, op["l"]))
+                walk(op["b"], held + ids)
+            elif t == "call":
+                callee = op.get("fn") or op.get("inner")
+                if callee is not None:
+                    calls.append(callee)
+                    for h in held:
+                        held_calls.append((h, callee, op["l"]))
+            elif t == "branch":
+                for b in op["bs"]:
+                    walk(b, held)
+            elif t == "loop":
+                walk(op["b"], held)
+
+    walk(rec["body"], [])
+    return acquires, edges, held_calls, calls
+
+
+@project_rule(
+    "lock-order-cycle", "flow", "error",
+    "Lock acquisition order differs across code paths: per-function"
+    " acquisition sequences (nested `with` blocks, plus locks acquired"
+    " by callees while a lock is held, lifted through the call graph)"
+    " form a cycle in the global lock-order graph — two threads can each"
+    " hold one lock of the cycle while waiting on the next. Lock"
+    " identity is per class attribute (Condition-on-lock aliases share"
+    " their underlying lock's node).")
+def lock_order_cycle(project: Project) -> Iterable[Finding]:
+    # pass A: per-function direct facts
+    facts: Dict[Tuple[str, str], tuple] = {}
+    for path, module, qual, rec in iter_functions(project):
+        facts[(module, qual)] = (path, rec) + _direct_lock_info(
+            project, path, module, qual, rec)
+
+    # pass B: transitive acquisitions per function (fixpoint)
+    enters: Dict[Tuple[str, str], Set[str]] = {
+        k: set(v[2]) for k, v in facts.items()}
+    resolved_calls: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for (module, qual), (path, rec, _acq, _e, _hc, calls) in facts.items():
+        out = []
+        for callee in calls:
+            r = project.resolve_callee(module, rec["cls"], qual, callee)
+            if r is None:
+                continue
+            if r[0] == "fn":
+                out.append((r[1], r[2]))
+            elif r[0] == "class":
+                init = (r[1], f"{r[2]}.__init__")
+                if init in facts:
+                    out.append(init)
+        resolved_calls[(module, qual)] = out
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in resolved_calls.items():
+            cur = enters[key]
+            before = len(cur)
+            for ck in callees:
+                cur |= enters.get(ck, set())
+            if len(cur) != before:
+                changed = True
+
+    # pass C: the global order graph
+    graph: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int, via: str) -> None:
+        if a == b:
+            return  # re-entering the same (R)Lock id: not an order fact
+        graph.setdefault(a, {}).setdefault(b, (path, line, via))
+        graph.setdefault(b, {})
+
+    for (module, qual), (path, rec, _acq, edges, held_calls, _calls) \
+            in facts.items():
+        for a, b, line in edges:
+            add_edge(a, b, path, line, f"{module}.{qual}")
+        for held, callee, line in held_calls:
+            r = project.resolve_callee(module, rec["cls"], qual, callee)
+            if r is None or r[0] not in ("fn", "class"):
+                continue
+            ck = (r[1], r[2] if r[0] == "fn" else f"{r[2]}.__init__")
+            for inner in enters.get(ck, ()):
+                add_edge(held, inner, path, line,
+                         f"{module}.{qual} -> {callee}")
+
+    # pass D: cycles = non-trivial SCCs (iterative Tarjan)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work = [(root, iter(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    m = stack.pop()
+                    on_stack.discard(m)
+                    scc.append(m)
+                    if m == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+
+    findings: List[Optional[Finding]] = []
+    for scc in sccs:
+        members = sorted(scc)
+        # anchor the finding on one in-cycle edge and narrate the rest
+        detail = []
+        anchor = None
+        for a in members:
+            for b, (path, line, via) in sorted(graph[a].items()):
+                if b in scc:
+                    detail.append(f"{a} -> {b} (via {via}, "
+                                  f"{path}:{line})")
+                    if anchor is None:
+                        anchor = (path, line)
+        path, line = anchor
+        findings.append(_mk_finding(
+            project, "lock-order-cycle", path, line,
+            "lock acquisition order cycle — a potential deadlock: "
+            + "; ".join(detail)))
+    return [f for f in findings if f is not None]
+
+
+# -- rng-key-reuse ---------------------------------------------------------
+
+#: parameter names that mean "this receives a PRNG key" — deliberately
+#: the repo's `rng` convention only: `key`/`subkey` name DHT record
+#: subkeys and dict keys throughout the swarm layer, so matching them
+#: would misread byte-string plumbing as entropy flow. Variables whose
+#: PROVENANCE is PRNGKey/split/fold_in are tracked regardless of name.
+_KEY_PARAM_RE = re.compile(r"^(rng|prng_key|.*_rng|rngs?)$")
+_SAMPLER_LEAVES = {
+    "categorical", "uniform", "normal", "bernoulli", "gumbel", "randint",
+    "choice", "permutation", "truncated_normal", "poisson", "gamma",
+    "beta", "exponential", "laplace", "multivariate_normal", "cauchy",
+    "logistic", "rademacher", "dirichlet", "loggamma", "maxwell", "ball",
+    "t", "bits", "orthogonal", "generalized_normal",
+}
+#: derivation ops: they take a key but hand back fresh, independent
+#: streams. ``fold_in(base, i)`` is the sanctioned reuse of one base key
+#: across loop iterations; ``split`` CONSUMES its operand (using the
+#: parent key after splitting it reuses its entropy) but the split
+#: results are fresh.
+_NONCONSUMING_LEAVES = {"fold_in", "PRNGKey", "key", "wrap_key_data",
+                        "clone", "key_data"}
+
+
+def _is_sampler(callee: str) -> bool:
+    parts = callee.split(".")
+    return parts[-1] in _SAMPLER_LEAVES and (
+        "random" in parts[:-1] or parts[0] in ("jr", "jrandom"))
+
+
+def _is_split(callee: str) -> bool:
+    parts = callee.split(".")
+    if parts[-1] != "split":
+        return False
+    return len(parts) == 1 or "random" in parts[:-1] \
+        or parts[0] in ("jr", "jrandom")
+
+
+def _is_nonconsuming(callee: str) -> bool:
+    return callee.split(".")[-1] in _NONCONSUMING_LEAVES
+
+
+class _KeyState:
+    """keys: binding -> consumed-at line (None = live/unconsumed)."""
+
+    def __init__(self):
+        self.keys: Dict[str, Optional[int]] = {}
+
+
+def _run_rng_block(block: List[dict], st: _KeyState, ctx: dict,
+                   findings: List[Optional[Finding]],
+                   seen: Set[Tuple[int, str]]) -> bool:
+    """Returns True when the block terminated — see the donate walker."""
+    project: Project = ctx["project"]
+
+    def consume(name: str, line: int, how: str) -> None:
+        prior = st.keys.get(name)
+        if prior is not None:
+            key = (line, name)
+            if key not in seen:
+                seen.add(key)
+                findings.append(_mk_finding(
+                    project, "rng-key-reuse", ctx["path"], line,
+                    f"key '{name}' is consumed again by {how} after "
+                    f"being consumed at line {prior} with no split in "
+                    "between — the two draws are correlated; "
+                    f"`{name}, sub = jax.random.split({name})` first"))
+        else:
+            st.keys[name] = line
+
+    for op in block:
+        t = op["t"]
+        if t == "term":
+            return True
+        if t == "call":
+            callee = op.get("fn")
+            if callee is None:
+                continue
+            if _is_nonconsuming(callee):
+                continue
+            if _is_sampler(callee) or _is_split(callee):
+                how = f"{callee}()"
+                for arg in op["args"]:
+                    if arg is not None and arg in st.keys:
+                        consume(arg, op["l"], how)
+                continue
+            # a call into a project function whose receiving parameter
+            # is key-named consumes the key (sample_logits(sub, ...))
+            r = project.resolve_callee(ctx["module"], ctx["cls"],
+                                       ctx["qual"], callee)
+            if r is not None and r[0] == "fn":
+                rec = project.function(r[1], r[2])
+                params = rec["params"] if rec else []
+                if params and rec["cls"] is not None \
+                        and params[:1] == ["self"]:
+                    params = params[1:]
+                for i, arg in enumerate(op["args"]):
+                    if arg is None or arg not in st.keys:
+                        continue
+                    if i < len(params) and _KEY_PARAM_RE.match(params[i]):
+                        consume(arg, op["l"], f"{callee}()")
+        elif t == "assign":
+            src = op.get("src")
+            for tg in op["tg"]:
+                if src == "key":
+                    st.keys[tg] = None       # fresh, unconsumed
+                elif src is not None and src.startswith("name:") \
+                        and src[5:] in st.keys:
+                    st.keys[tg] = st.keys[src[5:]]   # alias copy
+                elif tg in st.keys:
+                    del st.keys[tg]          # rebound to a non-key
+        elif t == "with":
+            if _run_rng_block(op["b"], st, ctx, findings, seen):
+                return True
+        elif t == "branch":
+            outs = []
+            n_term = 0
+            for b in op["bs"]:
+                bst = _KeyState()
+                bst.keys = dict(st.keys)
+                if _run_rng_block(b, bst, ctx, findings, seen):
+                    n_term += 1
+                else:
+                    outs.append(bst.keys)
+            merged: Dict[str, Optional[int]] = {}
+            for o in outs:
+                for k, v in o.items():
+                    if k in merged and merged[k] is not None:
+                        continue     # keep the consumed-at if any arm set
+                    merged[k] = v if v is not None else merged.get(k)
+            st.keys = merged
+            if n_term == len(op["bs"]) and op["bs"]:
+                return True
+        elif t == "loop":
+            _run_rng_block(op["b"], st, ctx, findings, seen)
+            _run_rng_block(op["b"], st, ctx, findings, seen)
+    return False
+
+
+@project_rule(
+    "rng-key-reuse", "flow", "error",
+    "A jax.random key variable consumed by two sampling ops (or two"
+    " splits, or handed twice into key-named parameters of project"
+    " functions) without an intervening jax.random.split: the draws are"
+    " correlated — a silent determinism bug that breaks the swarm's"
+    " bit-exact parity oracles. fold_in is the sanctioned per-iteration"
+    " derivation and does not consume its base key.")
+def rng_key_reuse(project: Project) -> Iterable[Finding]:
+    findings: List[Optional[Finding]] = []
+    for path, module, qual, rec in iter_functions(project):
+        ctx = {"project": project, "path": path, "module": module,
+               "qual": qual, "cls": rec["cls"]}
+        st = _KeyState()
+        params = rec["params"]
+        if rec["cls"] is not None and params[:1] == ["self"]:
+            params = params[1:]
+        for p in params:
+            if _KEY_PARAM_RE.match(p):
+                st.keys[p] = None
+        seen: Set[Tuple[int, str]] = set()
+        _run_rng_block(rec["body"], st, ctx, findings, seen)
+    return [f for f in findings if f is not None]

@@ -221,7 +221,7 @@ _PULLABLE_ARG_NODES = (ast.Name, ast.Subscript, ast.Attribute)
     " dispatch ahead of the device (SERVING.md \"host loop\"); one pull"
     " per chunk serializes host and device and caps throughput at their"
     " SUM of latencies. Hoist per-completion pulls into helpers outside"
-    " the loop body, or carry a deterministic host mirror.")
+    " the loop body, or carry a deterministic host mirror.", severity="warning")
 def host_sync_in_hot_loop(ctx: FileContext) -> Iterable[Finding]:
     if not ctx.is_serving_module:
         return []

@@ -1,0 +1,820 @@
+"""graftlint project model: the whole-program half of the analyzer.
+
+The per-file rules (``jax_rules``/``concurrency_rules``) see one parsed
+tree at a time; the hazards r9/r10 multiplied — a buffer donated to a
+jitted call and then read, lock acquisitions ordered differently across
+threads, a PRNG key consumed twice — are *whole-program, flow-sensitive*
+properties. This module supplies the two passes the flow rules
+(``flow_rules``) run over:
+
+**Pass 1 — summarize.** :func:`summarize_source` lowers one file into a
+JSON-serializable *summary*: every function's body as a small flow IR
+(reads / calls / assigns / branches / loops / with-blocks, in evaluation
+order), plus the file's import aliases, class attribute types, lock
+attributes, and every jit wrapper it constructs — decorator form
+(``@jax.jit``, ``@functools.partial(jax.jit, donate_argnums=...)``),
+binding form (``g = jax.jit(f, donate_argnums=0)`` at module, class, or
+function scope), factory form (``return jax.jit(...)``), and the
+immediate call form (``jax.jit(f, donate_argnums=1)(x, y)``), each with
+its ``donate_argnums``/``static_argnums``. Summaries are pure data: the
+parse cache (``cache.py``) keys them on the file's content hash, so a
+warm scan never re-parses an unchanged file.
+
+**Pass 2 — assemble.** :class:`Project` indexes the summaries into a
+symbol table (functions, classes, jit bindings per module), resolves
+intra-package imports (``import dalle_tpu.x as m`` / ``from
+dalle_tpu.x import f as g`` / relative forms), and answers the queries
+the flow rules need: *what does this dotted callee resolve to*, *does it
+donate and at which positions*, *which locks does it (transitively)
+acquire*, *what are its parameter names*.
+
+Known approximations (see LINTS.md "Known limits"): resolution is
+name-based — values flowing through data structures, constructor
+parameters (``self.apply_fn = apply_fn``), or ``wrap = jax.jit`` escape
+it; attribute types come from constructor-call assignments in the
+class's own methods; inheritance is not walked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Tuple
+
+from dalle_tpu.analysis.core import _JIT_LEAVES, dotted_name
+
+#: bump when the summary schema or extraction changes — invalidates
+#: cached summaries (cache.py folds this into its version key)
+SUMMARY_SCHEMA = 3
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def module_name_for(path: str) -> str:
+    """``dalle_tpu/serving/engine.py`` -> ``dalle_tpu.serving.engine``;
+    a package ``__init__.py`` names the package itself."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x and x != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _argnums(call: ast.Call, kw_name: str) -> List[int]:
+    for kw in call.keywords:
+        if kw.arg != kw_name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                and not isinstance(v.value, bool):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return [e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                    and not isinstance(e.value, bool)]
+    return []
+
+
+def jit_call_info(call: ast.Call) -> Optional[Dict[str, List[int]]]:
+    """``{'donate': [...], 'static': [...]}`` when ``call`` is a direct
+    jit wrap: ``jax.jit(f, ...)`` / ``pjit(f, ...)``. Returns None for
+    anything else (including ``partial`` — see :func:`jit_deco_info`)."""
+    d = dotted_name(call.func)
+    if d is not None and d.split(".")[-1] in _JIT_LEAVES and call.args:
+        return {"donate": _argnums(call, "donate_argnums"),
+                "static": _argnums(call, "static_argnums")}
+    return None
+
+
+def jit_deco_info(deco: ast.AST) -> Optional[Dict[str, List[int]]]:
+    """jit info for a decorator expression: ``@jax.jit`` (bare),
+    ``@functools.partial(jax.jit, donate_argnums=...)``, or
+    ``@pjit``-style names."""
+    d = dotted_name(deco)
+    if d is not None and d.split(".")[-1] in _JIT_LEAVES:
+        return {"donate": [], "static": []}
+    if isinstance(deco, ast.Call):
+        callee = dotted_name(deco.func)
+        if callee is not None and callee.split(".")[-1] == "partial" \
+                and deco.args:
+            inner = dotted_name(deco.args[0])
+            if inner is not None and inner.split(".")[-1] in _JIT_LEAVES:
+                return {"donate": _argnums(deco, "donate_argnums"),
+                        "static": _argnums(deco, "static_argnums")}
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and (dotted_name(value.func) or "").split(".")[-1]
+            in _LOCK_CTORS)
+
+
+# -- flow IR extraction ----------------------------------------------------
+#
+# Ops (JSON dicts, evaluation order within each statement):
+#   {"t": "read",   "n": dotted, "l": line}
+#   {"t": "call",   "fn": dotted|None, "inner": dotted|None,
+#    "jit": {...}|None, "args": [dotted|None, ...], "l": line}
+#       fn:    the callee when it is a plain name/attribute chain
+#       inner: when the callee is itself a call (factory pattern
+#              `_chunk_fn(cfg)(params, state)`), the inner callee's name
+#       jit:   set when the callee is a direct `jax.jit(f, ...)` call —
+#              the immediate-call form donates on THIS call's args
+#   {"t": "assign", "tg": [dotted, ...], "src": "key"|"name:<d>"|None}
+#       src tags the RHS for the rng rule: "key" = a fresh
+#       PRNGKey/split/fold_in result, "name:<d>" = a plain alias copy
+#   {"t": "with",   "locks": [dotted, ...], "l": line, "b": Block}
+#   {"t": "branch", "bs": [Block, ...]}
+#   {"t": "loop",   "b": Block}
+#   {"t": "term"}   — return/raise/break/continue: the rest of the
+#                     enclosing block is unreachable, so a branch ending
+#                     here contributes nothing to the join (this is what
+#                     keeps `if traced: return f(rng)` from leaking its
+#                     consumption into the static path)
+
+_KEY_FRESH_LEAVES = {"PRNGKey", "split", "fold_in", "key", "wrap_key_data",
+                     "clone"}
+
+
+def _is_key_source(callee: Optional[str]) -> bool:
+    if callee is None:
+        return False
+    parts = callee.split(".")
+    if parts[-1] not in _KEY_FRESH_LEAVES:
+        return False
+    # `jax.random.split` / `random.split` / `jrandom.split` / bare
+    # `split` (from jax.random import split); `line.split` is excluded
+    # by requiring a random-ish prefix for dotted forms
+    return len(parts) == 1 or "random" in parts[:-1] \
+        or parts[0] in ("jr", "jrandom")
+
+
+class _Summarizer(ast.NodeVisitor):
+    """One pass over a module: fills the summary dict."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.module = module_name_for(path)
+        self.summary: Dict[str, Any] = {
+            "schema": SUMMARY_SCHEMA,
+            "path": path,
+            "module": self.module,
+            "imports": [],          # [asname_or_None, target, is_from]
+            "classes": {},
+            "module_locks": [],
+            "module_jit": {},       # name -> {"donate": [...], ...}
+            "functions": {},        # qualname -> record
+            "suppress": {},         # line -> [rule, ...]
+        }
+        tree = ast.parse(source)
+        self._collect_imports(tree)
+        for node in tree.body:
+            self._top_level(node)
+
+    # -- imports ----------------------------------------------------------
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        pkg_parts = self.module.split(".")[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.summary["imports"].append(
+                        [a.asname, a.name, False])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    prefix = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(prefix + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.summary["imports"].append(
+                        [a.asname or a.name, f"{base}:{a.name}", True])
+
+    # -- top-level structure ----------------------------------------------
+
+    def _top_level(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._function(node, qual_prefix="", cls=None)
+        elif isinstance(node, ast.ClassDef):
+            self._class(node)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if value is None:
+                return
+            for t in targets:
+                name = dotted_name(t)
+                if name is None or "." in name:
+                    continue
+                if _is_lock_ctor(value):
+                    self.summary["module_locks"].append(name)
+                elif isinstance(value, ast.Call):
+                    info = jit_call_info(value)
+                    if info is not None:
+                        self.summary["module_jit"][name] = info
+
+    def _class(self, node: ast.ClassDef) -> None:
+        cls: Dict[str, Any] = {
+            "line": node.lineno,
+            "attr_types": {},     # self.X = SomeClass(...) -> callee name
+            "lock_attrs": [],
+            "lock_aliases": {},   # Condition(self._lock) sharing
+            "jit_attrs": {},      # self.X = jax.jit(...) -> info
+        }
+        self.summary["classes"][node.name] = cls
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_self_assigns(item, cls)
+                self._function(item, qual_prefix=node.name + ".",
+                               cls=node.name)
+
+    def _scan_self_assigns(self, meth: ast.AST, cls: Dict[str, Any]
+                           ) -> None:
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                attr = t.attr
+                if _is_lock_ctor(value):
+                    assert isinstance(value, ast.Call)
+                    leaf = (dotted_name(value.func) or "").split(".")[-1]
+                    if leaf == "Condition" and value.args:
+                        src = dotted_name(value.args[0])
+                        if src is not None and src.startswith("self."):
+                            # Condition built ON another lock: same
+                            # underlying lock — alias, not a new node
+                            cls["lock_aliases"][attr] = \
+                                src.split(".", 1)[1]
+                    if attr not in cls["lock_attrs"]:
+                        cls["lock_attrs"].append(attr)
+                    continue
+                calls = []
+                if isinstance(value, ast.Call):
+                    calls = [value]
+                elif isinstance(value, ast.BoolOp):
+                    # `self.m = m or ServingMetrics(...)` — take the
+                    # constructor operand
+                    calls = [v for v in value.values
+                             if isinstance(v, ast.Call)]
+                for c in calls:
+                    info = jit_call_info(c)
+                    if info is not None:
+                        cls["jit_attrs"][attr] = info
+                        break
+                    callee = dotted_name(c.func)
+                    if callee is not None and \
+                            callee.split(".")[-1][:1].isupper():
+                        cls["attr_types"].setdefault(attr, callee)
+                        break
+
+    # -- functions ---------------------------------------------------------
+
+    def _function(self, node: ast.AST, qual_prefix: str,
+                  cls: Optional[str]) -> None:
+        qual = qual_prefix + node.name
+        a = node.args
+        params = [x.arg for x in (a.posonlyargs + a.args)]
+        donates = None
+        is_property = False
+        for deco in node.decorator_list:
+            info = jit_deco_info(deco)
+            if info is not None:
+                donates = info
+            leaf = (dotted_name(deco) or "").split(".")[-1]
+            if leaf in ("property", "cached_property"):
+                is_property = True
+        emitter = _BodyEmitter(self, qual_prefix=qual + ".", cls=cls)
+        body = emitter.block(node.body)
+        self.summary["functions"][qual] = {
+            "line": node.lineno,
+            "cls": cls,
+            "params": params,
+            "jit": donates,                 # decorator-jitted
+            "returns_jit": emitter.returns_jit,
+            "jit_locals": emitter.jit_locals,
+            "local_locks": emitter.local_locks,
+            "is_property": is_property,
+            "body": body,
+        }
+
+
+class _BodyEmitter:
+    """Lowers one function body to the flow IR (nested defs recurse into
+    :meth:`_Summarizer._function` and contribute no ops — a closure
+    read of a donated binding is a documented false negative)."""
+
+    def __init__(self, summarizer: _Summarizer, qual_prefix: str,
+                 cls: Optional[str]):
+        self.s = summarizer
+        self.qual_prefix = qual_prefix
+        self.cls = cls
+        self.returns_jit: Optional[Dict[str, List[int]]] = None
+        self.jit_locals: Dict[str, Dict[str, List[int]]] = {}
+        self.local_locks: List[str] = []
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, node: Optional[ast.AST], out: List[dict]) -> None:
+        if node is None or isinstance(node, ast.Constant):
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = dotted_name(node)
+            if d is not None:
+                out.append({"t": "read", "n": d, "l": node.lineno})
+            elif isinstance(node, ast.Attribute):
+                self.expr(node.value, out)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, out)
+            return
+        if isinstance(node, ast.Lambda):
+            return  # separate scope; not lowered (documented limit)
+        if isinstance(node, ast.NamedExpr):
+            self.expr(node.value, out)
+            self._assign([node.target], node.value, out)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.expr(child, out)
+            elif isinstance(child, ast.comprehension):
+                self.expr(child.iter, out)
+                for cond in child.ifs:
+                    self.expr(cond, out)
+            elif isinstance(child, ast.keyword):
+                self.expr(child.value, out)
+
+    def _call(self, node: ast.Call, out: List[dict]) -> None:
+        fn = dotted_name(node.func)
+        inner = None
+        jit = None
+        if fn is None and isinstance(node.func, ast.Call):
+            # factory / immediate-jit form: f(...)(args)
+            self._call(node.func, out)
+            inner = dotted_name(node.func.func)
+            jit = jit_call_info(node.func)
+        elif fn is None:
+            self.expr(node.func, out)
+        elif isinstance(node.func, ast.Attribute):
+            # a method call reads its receiver (state.copy() after a
+            # donation is a use); a plain-name callee is not a read
+            base = dotted_name(node.func.value)
+            if base is not None:
+                out.append({"t": "read", "n": base, "l": node.lineno})
+        args: List[Optional[str]] = []
+        for arg in node.args:
+            d = dotted_name(arg)
+            self.expr(arg, out)
+            args.append(d)
+        for kw in node.keywords:
+            self.expr(kw.value, out)
+        out.append({"t": "call", "fn": fn, "inner": inner, "jit": jit,
+                    "args": args, "l": node.lineno})
+
+    # -- statements --------------------------------------------------------
+
+    def block(self, stmts: List[ast.stmt]) -> List[dict]:
+        out: List[dict] = []
+        for stmt in stmts:
+            self.stmt(stmt, out)
+        return out
+
+    def _assign(self, targets: List[ast.AST], value: Optional[ast.AST],
+                out: List[dict]) -> None:
+        names: List[str] = []
+        for t in targets:
+            stack = [t]
+            while stack:
+                cur = stack.pop()
+                if isinstance(cur, (ast.Tuple, ast.List)):
+                    stack.extend(cur.elts)
+                elif isinstance(cur, ast.Starred):
+                    stack.append(cur.value)
+                elif isinstance(cur, ast.Subscript):
+                    # writing INTO a buffer is a read of the binding,
+                    # never a rebind
+                    self.expr(cur.value, out)
+                    self.expr(cur.slice, out)
+                else:
+                    d = dotted_name(cur)
+                    if d is not None:
+                        names.append(d)
+                    elif isinstance(cur, ast.Attribute):
+                        self.expr(cur.value, out)
+        src = None
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            if _is_key_source(callee):
+                src = "key"
+        elif isinstance(value, (ast.Name, ast.Attribute)):
+            d = dotted_name(value)
+            if d is not None:
+                src = "name:" + d
+        if names:
+            out.append({"t": "assign", "tg": names, "src": src})
+
+    def _record_bindings(self, targets: List[ast.AST],
+                         value: Optional[ast.AST]) -> None:
+        """jit/lock bindings created by this assignment (function-local
+        names and self-attributes)."""
+        if not isinstance(value, ast.Call):
+            return
+        info = jit_call_info(value)
+        is_lock = _is_lock_ctor(value)
+        if info is None and not is_lock:
+            return
+        for t in targets:
+            d = dotted_name(t)
+            if d is None:
+                continue
+            if info is not None and "." not in d:
+                self.jit_locals[d] = info
+            elif is_lock and "." not in d:
+                if d not in self.local_locks:
+                    self.local_locks.append(d)
+
+    def stmt(self, stmt: ast.stmt, out: List[dict]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.s._function(stmt, qual_prefix=self.qual_prefix,
+                             cls=self.cls)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # nested classes: out of scope
+        if isinstance(stmt, ast.Expr):
+            self.expr(stmt.value, out)
+            return
+        if isinstance(stmt, ast.Assign):
+            self.expr(stmt.value, out)
+            self._record_bindings(stmt.targets, stmt.value)
+            self._assign(stmt.targets, stmt.value, out)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.expr(stmt.value, out)
+                self._record_bindings([stmt.target], stmt.value)
+                self._assign([stmt.target], stmt.value, out)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self.expr(stmt.value, out)
+            self.expr(stmt.target, out)     # aug reads the old value
+            self._assign([stmt.target], None, out)
+            return
+        if isinstance(stmt, ast.Return):
+            if isinstance(stmt.value, ast.Call):
+                info = jit_call_info(stmt.value)
+                if info is not None:
+                    self.returns_jit = info
+            self.expr(stmt.value, out)
+            out.append({"t": "term"})
+            return
+        if isinstance(stmt, (ast.If,)):
+            self.expr(stmt.test, out)
+            out.append({"t": "branch",
+                        "bs": [self.block(stmt.body),
+                               self.block(stmt.orelse)]})
+            return
+        if isinstance(stmt, ast.While):
+            self.expr(stmt.test, out)
+            body = self.block(stmt.body)
+            out.append({"t": "loop", "b": body})
+            if stmt.orelse:
+                out.append({"t": "branch",
+                            "bs": [self.block(stmt.orelse), []]})
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.expr(stmt.iter, out)
+            body: List[dict] = []
+            self._assign([stmt.target], None, body)
+            body.extend(self.block(stmt.body))
+            out.append({"t": "loop", "b": body})
+            if stmt.orelse:
+                out.append({"t": "branch",
+                            "bs": [self.block(stmt.orelse), []]})
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            locks: List[str] = []
+            pre: List[dict] = []
+            for item in stmt.items:
+                d = dotted_name(item.context_expr)
+                if d is not None:
+                    locks.append(d)
+                else:
+                    self.expr(item.context_expr, pre)
+                if item.optional_vars is not None:
+                    self._assign([item.optional_vars], None, pre)
+            out.extend(pre)
+            out.append({"t": "with", "locks": locks, "l": stmt.lineno,
+                        "b": self.block(stmt.body)})
+            return
+        if isinstance(stmt, ast.Try):
+            blocks = [self.block(stmt.body + stmt.orelse)]
+            for handler in stmt.handlers:
+                blocks.append(self.block(handler.body))
+            out.append({"t": "branch", "bs": blocks})
+            if stmt.finalbody:
+                out.extend(self.block(stmt.finalbody))
+            return
+        if isinstance(stmt, ast.Raise):
+            self.expr(stmt.exc, out)
+            self.expr(stmt.cause, out)
+            out.append({"t": "term"})
+            return
+        if isinstance(stmt, ast.Assert):
+            self.expr(stmt.test, out)
+            self.expr(stmt.msg, out)
+            return
+        if isinstance(stmt, ast.Delete):
+            # `del x` retires the binding — reads after it are a
+            # NameError, not our hazard
+            self._assign(list(stmt.targets), None, out)
+            return
+        if isinstance(stmt, ast.Match):
+            self.expr(stmt.subject, out)
+            out.append({"t": "branch",
+                        "bs": [self.block(c.body) for c in stmt.cases]})
+            return
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            out.append({"t": "term"})
+            return
+        # Pass, Import, Global, Nonlocal: no ops
+
+
+def summarize_source(path: str, source: str) -> Dict[str, Any]:
+    """Lower one file to its project summary (raises SyntaxError like
+    ``ast.parse``). Suppression lines are included so project-rule
+    findings honor ``# graftlint: disable=`` without re-reading."""
+    from dalle_tpu.analysis.core import _SUPPRESS_RE
+    s = _Summarizer(path, source)
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            s.summary["suppress"][str(i)] = [
+                r.strip() for r in m.group(1).split(",") if r.strip()]
+    return s.summary
+
+
+# -- the assembled project -------------------------------------------------
+
+class Project:
+    """Symbol table + resolution over a set of file summaries."""
+
+    def __init__(self, summaries: Dict[str, Dict[str, Any]],
+                 sources: Optional[Dict[str, str]] = None):
+        #: path -> summary
+        self.files = summaries
+        #: path -> raw source (for finding snippets); optional
+        self.sources = sources or {}
+        #: module dotted name -> path
+        self.modules: Dict[str, str] = {
+            sm["module"]: path for path, sm in summaries.items()}
+        #: per-module alias map: name -> ("mod", module) | ("sym", module, sym)
+        self._aliases: Dict[str, Dict[str, Tuple]] = {}
+        for path, sm in summaries.items():
+            amap: Dict[str, Tuple] = {}
+            for asname, target, is_from in sm["imports"]:
+                if is_from:
+                    mod, sym = target.split(":", 1)
+                    amap[asname] = ("sym", mod, sym)
+                else:
+                    amap[asname or target.split(".")[0]] = (
+                        "mod", target if asname else target.split(".")[0])
+                    if asname is None:
+                        # `import a.b.c` binds `a` but makes the full
+                        # dotted path resolvable too
+                        amap[target] = ("mod", target)
+            self._aliases[sm["module"]] = amap
+
+    # -- lookup helpers ----------------------------------------------------
+
+    def function(self, module: str, qual: str) -> Optional[dict]:
+        path = self.modules.get(module)
+        if path is None:
+            return None
+        return self.files[path]["functions"].get(qual)
+
+    def cls(self, module: str, name: str) -> Optional[dict]:
+        path = self.modules.get(module)
+        if path is None:
+            return None
+        return self.files[path]["classes"].get(name)
+
+    def _resolve_symbol(self, module: str, sym: str
+                        ) -> Optional[Tuple[str, str, str]]:
+        """A symbol name inside ``module`` -> ("fn"|"class"|"jit-name",
+        module, qual) following one from-import hop."""
+        path = self.modules.get(module)
+        if path is None:
+            return None
+        sm = self.files[path]
+        if sym in sm["functions"]:
+            return ("fn", module, sym)
+        if sym in sm["classes"]:
+            return ("class", module, sym)
+        if sym in sm["module_jit"]:
+            return ("jit-name", module, sym)
+        alias = self._aliases.get(module, {}).get(sym)
+        if alias is not None:
+            if alias[0] == "sym":
+                return self._resolve_symbol(alias[1], alias[2])
+            return None
+        return None
+
+    def resolve_callee(self, module: str, cls: Optional[str],
+                       func_qual: str, dotted: str
+                       ) -> Optional[Tuple]:
+        """Resolve a dotted callee written inside ``func_qual`` (of
+        ``cls``) in ``module``. Returns one of::
+
+            ("fn", module, qual)       # plain function / method
+            ("class", module, name)    # constructor
+            ("jit", {"donate": [...], "static": [...]})
+        """
+        parts = dotted.split(".")
+        # self.<...>
+        if parts[0] == "self" and cls is not None:
+            c = self.cls(module, cls)
+            if c is None or len(parts) < 2:
+                return None
+            if len(parts) == 2:
+                attr = parts[1]
+                if attr in c["jit_attrs"]:
+                    return ("jit", c["jit_attrs"][attr])
+                meth = self.function(module, f"{cls}.{attr}")
+                if meth is not None:
+                    return ("fn", module, f"{cls}.{attr}")
+                return None
+            if len(parts) == 3:
+                ty = c["attr_types"].get(parts[1])
+                if ty is None:
+                    return None
+                r = self.resolve_callee(module, None, func_qual, ty)
+                if r is not None and r[0] == "class":
+                    _kind, tmod, tcls = r
+                    meth = self.function(tmod, f"{tcls}.{parts[2]}")
+                    if meth is not None:
+                        return ("fn", tmod, f"{tcls}.{parts[2]}")
+            return None
+        # function-local / enclosing-function jit bindings
+        if len(parts) == 1:
+            qual_parts = func_qual.split(".")
+            for depth in range(len(qual_parts), 0, -1):
+                scope = ".".join(qual_parts[:depth])
+                fn = self.function(module, scope)
+                if fn is not None and dotted in fn["jit_locals"]:
+                    return ("jit", fn["jit_locals"][dotted])
+            # sibling / nested helper in an enclosing scope
+            for depth in range(len(qual_parts) - 1, 0, -1):
+                scope = ".".join(qual_parts[:depth])
+                fn = self.function(module, f"{scope}.{dotted}")
+                if fn is not None:
+                    return ("fn", module, f"{scope}.{dotted}")
+            # same-class method called bare? (not a Python idiom) — skip
+            path = self.modules.get(module)
+            if path is not None:
+                sm = self.files[path]
+                if dotted in sm["module_jit"]:
+                    return ("jit", sm["module_jit"][dotted])
+            return self._resolve_symbol(module, dotted)
+        # module-alias dotted call: m.f / pkg.sub.f / Class.method
+        amap = self._aliases.get(module, {})
+        for cut in range(len(parts) - 1, 0, -1):
+            head = ".".join(parts[:cut])
+            alias = amap.get(head)
+            if alias is None:
+                continue
+            if alias[0] == "mod":
+                target_mod = alias[1]
+                rest = parts[cut:]
+                # the tail may itself dot through submodules
+                while len(rest) > 1 and f"{target_mod}.{rest[0]}" \
+                        in self.modules:
+                    target_mod = f"{target_mod}.{rest[0]}"
+                    rest = rest[1:]
+                if len(rest) == 1:
+                    return self._resolve_symbol(target_mod, rest[0])
+                if len(rest) == 2:
+                    r = self._resolve_symbol(target_mod, rest[0])
+                    if r is not None and r[0] == "class":
+                        meth = self.function(r[1], f"{r[2]}.{rest[1]}")
+                        if meth is not None:
+                            return ("fn", r[1], f"{r[2]}.{rest[1]}")
+                return None
+            if alias[0] == "sym" and cut == 1 and len(parts) == 2:
+                r = self._resolve_symbol(alias[1], alias[2])
+                if r is not None and r[0] == "class":
+                    meth = self.function(r[1], f"{r[2]}.{parts[1]}")
+                    if meth is not None:
+                        return ("fn", r[1], f"{r[2]}.{parts[1]}")
+                return None
+        # local class staticly invoked: Class.method
+        if len(parts) == 2:
+            r = self._resolve_symbol(module, parts[0])
+            if r is not None and r[0] == "class":
+                meth = self.function(r[1], f"{r[2]}.{parts[1]}")
+                if meth is not None:
+                    return ("fn", r[1], f"{r[2]}.{parts[1]}")
+        return None
+
+    # -- donation queries --------------------------------------------------
+
+    def donate_positions(self, module: str, cls: Optional[str],
+                         func_qual: str, op: dict) -> Optional[List[int]]:
+        """Donated arg positions for a flow-IR call op, or None when the
+        call is not known to donate. Covers all four jit forms."""
+        jit = op.get("jit")
+        if jit is not None:
+            return jit["donate"] or None
+        fn = op.get("fn")
+        if fn is not None:
+            r = self.resolve_callee(module, cls, func_qual, fn)
+            if r is None:
+                return None
+            if r[0] == "jit":
+                return r[1]["donate"] or None
+            if r[0] == "fn":
+                rec = self.function(r[1], r[2])
+                if rec is None:
+                    return None
+                if rec["jit"] is not None and rec["jit"]["donate"]:
+                    return rec["jit"]["donate"]
+                # a property returning a jit: `self.apply_step(a, b)`
+                # calls the RETURNED callable
+                if rec["is_property"] and rec["returns_jit"] \
+                        and rec["returns_jit"]["donate"]:
+                    return rec["returns_jit"]["donate"]
+            return None
+        inner = op.get("inner")
+        if inner is not None:
+            r = self.resolve_callee(module, cls, func_qual, inner)
+            if r is not None and r[0] == "fn":
+                rec = self.function(r[1], r[2])
+                if rec is not None and rec["returns_jit"] \
+                        and rec["returns_jit"]["donate"]:
+                    return rec["returns_jit"]["donate"]
+        return None
+
+    # -- lock identity -----------------------------------------------------
+
+    def lock_id(self, module: str, cls: Optional[str], func_qual: str,
+                dotted: str) -> Optional[str]:
+        """Stable identity for an acquired lock: ``module:Class.attr``
+        for self-attributes (Condition-on-lock aliases dereferenced),
+        ``module:name`` for module globals, ``module:qual.name`` for
+        function locals. None when the name is not a known lock."""
+        if dotted.startswith("self.") and cls is not None:
+            c = self.cls(module, cls)
+            if c is None:
+                return None
+            attr = dotted.split(".", 1)[1]
+            attr = c["lock_aliases"].get(attr, attr)
+            if attr in c["lock_attrs"]:
+                return f"{module}:{cls}.{attr}"
+            return None
+        qual_parts = func_qual.split(".")
+        for depth in range(len(qual_parts), 0, -1):
+            scope = ".".join(qual_parts[:depth])
+            fn = self.function(module, scope)
+            if fn is not None and dotted in fn["local_locks"]:
+                return f"{module}:{scope}.{dotted}"
+        path = self.modules.get(module)
+        if path is not None and dotted in self.files[path]["module_locks"]:
+            return f"{module}:{dotted}"
+        return None
+
+    # -- suppression -------------------------------------------------------
+
+    def suppressed(self, path: str, line: int, rule: str) -> bool:
+        sm = self.files.get(path)
+        if sm is None:
+            return False
+        sup = sm["suppress"]
+        for src_line in (line, line - 1):
+            rules = sup.get(str(src_line))
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    def snippet(self, path: str, line: int) -> str:
+        src = self.sources.get(path)
+        if src is None:
+            return ""
+        lines = src.splitlines()
+        if 0 < line <= len(lines):
+            return lines[line - 1].strip()
+        return ""
+
+
+def iter_functions(project: Project):
+    """(path, module, qualname, record) for every function summary."""
+    for path, sm in project.files.items():
+        for qual, rec in sm["functions"].items():
+            yield path, sm["module"], qual, rec

@@ -1,0 +1,93 @@
+"""graftlint machine-readable output: JSON lines-of-findings and SARIF.
+
+SARIF 2.1.0 is the interchange format CI annotators (GitHub code
+scanning, most IDE problem panes) ingest; the emitted document is the
+minimal valid subset — one run, the registered rules as
+``tool.driver.rules``, one ``result`` per finding with the rule's
+severity mapped to the SARIF ``level``. The plain JSON format is the
+finding dicts with fingerprints attached (the same fingerprints the
+baseline pins), for scripting without a SARIF parser.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import FrozenSet, Iterable, List, Tuple
+
+from dalle_tpu.analysis.core import (Finding, all_rules,
+                                     fingerprint_findings)
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "note": "note"}
+
+
+def _pairs(findings: Iterable[Finding],
+           exclude_fingerprints: FrozenSet[str]
+           ) -> List[Tuple[Finding, str]]:
+    """(finding, fingerprint) pairs to report. Fingerprints are computed
+    over the FULL list and filtered afterwards — the occurrence index
+    that disambiguates identical snippets is positional, so
+    fingerprinting a subset (e.g. only unbaselined findings) would
+    renumber it and emit exactly the fingerprint the baseline already
+    pins for an earlier duplicate. ``exclude_fingerprints`` is how
+    ``--check`` reporting selects the unbaselined remainder: it is the
+    same selection :func:`~dalle_tpu.analysis.core.diff_baseline`
+    makes, so the two never disagree."""
+    return [(f, fp) for f, fp in fingerprint_findings(findings)
+            if fp not in exclude_fingerprints]
+
+
+def to_json(findings: Iterable[Finding],
+            exclude_fingerprints: FrozenSet[str] = frozenset()) -> str:
+    out = []
+    for f, fp in _pairs(findings, exclude_fingerprints):
+        d = f.to_dict()
+        d["fingerprint"] = fp
+        out.append(d)
+    return json.dumps({"findings": out}, indent=1)
+
+
+def to_sarif(findings: Iterable[Finding],
+             exclude_fingerprints: FrozenSet[str] = frozenset()) -> str:
+    pairs = _pairs(findings, exclude_fingerprints)
+    rules = all_rules()
+    used: List[str] = sorted({f.rule for f, _fp in pairs} & set(rules))
+    rule_index = {rid: i for i, rid in enumerate(used)}
+    results = []
+    for f, fp in pairs:
+        res = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "error"),
+            "message": {"text": f.message},
+            "partialFingerprints": {"graftlint/v1": fp},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "snippet": {"text": f.snippet}},
+                },
+            }],
+        }
+        if f.rule in rule_index:
+            res["ruleIndex"] = rule_index[f.rule]
+        results.append(res)
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "LINTS.md",
+                "rules": [{
+                    "id": rid,
+                    "shortDescription": {"text": rules[rid].doc.strip()},
+                    "defaultConfiguration": {
+                        "level": _SARIF_LEVEL.get(rules[rid].severity,
+                                                  "error")},
+                } for rid in used],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1)

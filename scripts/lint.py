@@ -1,17 +1,25 @@
 #!/usr/bin/env python
-"""graftlint CLI — run the AST hazard analyzer over the codebase.
+"""graftlint CLI — run the project-aware hazard analyzer over the codebase.
 
 Usage:
     python scripts/lint.py [paths...]           # report all findings
     python scripts/lint.py --check              # exit 1 on unbaselined
+    python scripts/lint.py --check --diff       # changed files only
     python scripts/lint.py --write-baseline     # triage current findings
+    python scripts/lint.py --format sarif       # SARIF 2.1.0 to stdout
+    python scripts/lint.py --jobs 0             # parallel scan (cpu count)
     python scripts/lint.py --list-rules
 
 Default path is ``dalle_tpu/``; the baseline lives at
 ``lint_baseline.json`` in the repo root (override with --baseline).
 ``--check`` is the tier-1 face (tests/test_static_analysis.py runs the
-same comparison in-process) and a fast pre-test hook: it parses ~70
-files with stdlib ast only — ~1 s on a 2-core box, no subprocesses.
+same comparison in-process) and the pre-commit path: per-file rules
+parse ~70 files with stdlib ast, whole-program flow rules (use-after-
+donate, lock-order-cycle, rng-key-reuse) run over the assembled project
+model, and the content-hash parse cache (``.graftlint_cache.json``)
+keeps a warm full scan inside the ~2 s r7 budget — ``--diff`` restricts
+the per-file report to git-changed files while the flow rules still see
+the whole tree through cached summaries.
 
 Suppression: ``# graftlint: disable=<rule>`` on the flagged line or the
 line above. Baseline entries pin (rule, path, snippet, occurrence), not
@@ -22,13 +30,39 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from dalle_tpu.analysis import (RULES, analyze_paths, diff_baseline,  # noqa: E402
-                                load_baseline, save_baseline)
+from dalle_tpu.analysis import (all_rules, analyze_paths,  # noqa: E402
+                                diff_baseline, load_baseline,
+                                save_baseline)
+from dalle_tpu.analysis import sarif  # noqa: E402
+
+
+def _git_changed_files(repo: str):
+    """Relative paths of modified/added/renamed/untracked ``*.py`` files
+    (vs HEAD) — the ``--diff`` scope. Returns None when git is absent or
+    errors, so callers can fall back to a full scan loudly."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo, timeout=30,
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed = set()
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:                    # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if path.endswith(".py"):
+            changed.add(path.replace(os.sep, "/"))
+    return changed
 
 
 def main(argv=None) -> int:
@@ -48,24 +82,51 @@ def main(argv=None) -> int:
                              "baseline file (triage step)")
     parser.add_argument("--rule", action="append", dest="rules",
                         help="restrict to specific rule id(s)")
+    parser.add_argument("--diff", action="store_true",
+                        help="per-file rules on git-changed files only "
+                             "(flow rules still see the whole tree); "
+                             "the documented pre-commit mode")
+    parser.add_argument("--jobs", type=int, default=1,
+                        help="parallel per-file analysis processes "
+                             "(0 = cpu count; default 1)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text", help="output format")
+    parser.add_argument("--cache",
+                        default=os.path.join(_REPO,
+                                             ".graftlint_cache.json"),
+                        help="content-hash parse cache path")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the parse cache")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
+    rules = all_rules()
     if args.list_rules:
-        for rid in sorted(RULES):
-            r = RULES[rid]
-            print(f"{rid}  [{r.family}]\n    {r.doc.strip()}\n")
+        for rid in sorted(rules):
+            r = rules[rid]
+            print(f"{rid}  [{r.family}/{r.severity}]\n"
+                  f"    {r.doc.strip()}\n")
         return 0
 
-    unknown = set(args.rules or ()) - set(RULES)
+    unknown = set(args.rules or ()) - set(rules)
     if unknown:
         print(f"unknown rule id(s): {', '.join(sorted(unknown))} "
               "(see --list-rules)", file=sys.stderr)
         return 2
 
-    scoped = bool(args.paths) or bool(args.rules)
+    scoped = bool(args.paths) or bool(args.rules) or args.diff
     paths = args.paths or [os.path.join(_REPO, "dalle_tpu")]
-    findings = analyze_paths(paths, root=_REPO, rules=args.rules)
+    changed_only = None
+    if args.diff:
+        changed_only = _git_changed_files(_REPO)
+        if changed_only is None:
+            print("warning: git status failed; --diff falling back to a "
+                  "full scan", file=sys.stderr)
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
+    cache_path = None if args.no_cache else args.cache
+    findings = analyze_paths(paths, root=_REPO, rules=args.rules,
+                             jobs=jobs, cache_path=cache_path,
+                             changed_only=changed_only)
 
     if args.write_baseline:
         if scoped:
@@ -73,8 +134,9 @@ def main(argv=None) -> int:
             # writing it out would silently drop every other triaged
             # baseline entry (and the next full --check would fail)
             print("--write-baseline requires the full default scope "
-                  "(no path arguments, no --rule): the baseline is "
-                  "written whole, not merged", file=sys.stderr)
+                  "(no path arguments, no --rule, no --diff): the "
+                  "baseline is written whole, not merged",
+                  file=sys.stderr)
             return 2
         save_baseline(args.baseline, findings)
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")
@@ -82,6 +144,18 @@ def main(argv=None) -> int:
 
     baseline = load_baseline(args.baseline)
     fresh, stale = diff_baseline(findings, baseline)
+
+    # --check reporting excludes by baseline fingerprint rather than
+    # serializing the `fresh` list: fingerprints must be computed over
+    # the full finding set or the occurrence index renumbers and a
+    # fresh duplicate emits its baselined twin's fingerprint
+    exclude = frozenset(baseline) if args.check else frozenset()
+    if args.format == "json":
+        print(sarif.to_json(findings, exclude_fingerprints=exclude))
+        return 1 if (args.check and fresh) else 0
+    if args.format == "sarif":
+        print(sarif.to_sarif(findings, exclude_fingerprints=exclude))
+        return 1 if (args.check and fresh) else 0
 
     if args.check:
         for f in fresh:

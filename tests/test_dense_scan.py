@@ -74,9 +74,17 @@ class TestDenseScan:
             g_su["params"])[0])
         for path, a in flat_u:
             b = flat_s[path]
+            # atol 5e-6, not 1e-6: the scanned model's backward pass
+            # accumulates the embedding-grad carry in scan order while
+            # the unrolled model sums per-layer contributions — two f32
+            # reduction orders. Seed repro (this box, jax 0.4.37 CPU):
+            # 1/8192 token_emb elements off by 1.07e-6 absolute
+            # (3e-4 relative on a ~3.5e-3 element) — reassociation
+            # noise, orders of magnitude below any real wiring bug,
+            # which this test catches at O(1e-1).
             np.testing.assert_allclose(
                 np.asarray(b, np.float32), np.asarray(a, np.float32),
-                rtol=1e-5, atol=1e-6,
+                rtol=1e-5, atol=5e-6,
                 err_msg=jax.tree_util.keystr(path))
 
     def test_overhang_discarded(self):

@@ -20,8 +20,39 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 REPO = Path(__file__).resolve().parent.parent
+
+
+def _cpu_multiprocess_collectives() -> str:
+    """The configured CPU collectives implementation ("none" when
+    multiprocess CPU computations are unsupported).
+
+    Every child below pins ``JAX_PLATFORMS=cpu``, so what decides
+    whether these tests CAN pass is whether the CPU client gets a
+    collectives backend (gloo/mpi). jaxlib ships gloo, but the
+    ``jax_cpu_collectives_implementation`` flag defaults to "none" — and
+    with "none" the very first cross-process computation raises
+    ``XlaRuntimeError: Multiprocess computations aren't implemented on
+    the CPU backend``, which makes all three subprocess tests
+    guaranteed failures (each burning its full matchmaking/averaging
+    timeout). Children inherit our environment, so reading the parent's
+    flag is faithful: export ``JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo``
+    (or ``jax.config.update`` in a conftest) and the skip lifts.
+    """
+    try:
+        from jax._src import xla_bridge
+        return xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value or "none"
+    except Exception:
+        return "none"
+
+
+pytestmark = pytest.mark.skipif(
+    _cpu_multiprocess_collectives() == "none",
+    reason="Multiprocess computations aren't implemented on the CPU "
+           "backend: jax_cpu_collectives_implementation is 'none' (set "
+           "JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo to run these)")
 
 _CHILD = r"""
 import json, sys
