@@ -107,11 +107,13 @@ def _load_params(args, cfg):
 
 
 def _build_pixel_fn(args, cfg):
-    """Jitted codes -> pixels (+ CLIP score) closure for the overlap
-    worker, or None when no VQGAN checkpoint is configured. Mirrors the
-    run_inference pipeline stages."""
+    """(pixel_fn, degraded_fn) for the overlap worker, or (None, None)
+    when no VQGAN checkpoint is configured. ``pixel_fn`` mirrors the
+    run_inference pipeline stages; ``degraded_fn`` is the brownout
+    variant — VQGAN decode WITHOUT the CLIP rerank, trading candidate
+    scoring for latency under sustained saturation."""
     if not args.vqgan_checkpoint:
-        return None
+        return None, None
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -155,7 +157,11 @@ def _build_pixel_fn(args, cfg):
             out["clip_score"] = score_fn(jnp.asarray(imgs))
         return out
 
-    return pixel_fn
+    def degraded_fn(codes):
+        imgs = np.asarray(decode(jnp.asarray(codes[None])))
+        return {"images": imgs[0]}   # brownout: pixels yes, rerank no
+
+    return pixel_fn, degraded_fn
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -192,8 +198,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     metrics = ServingMetrics(n_slots=serving.n_slots,
                              jsonl_path=args.metrics_file,
                              interval_s=serving.metrics_interval_s)
-    pixel_fn = _build_pixel_fn(args, cfg)
-    pipeline = (PixelPipeline(pixel_fn, metrics=metrics)
+    pixel_fn, degraded_fn = _build_pixel_fn(args, cfg)
+    pipeline = (PixelPipeline(pixel_fn, metrics=metrics,
+                              degraded_fn=degraded_fn)
                 if pixel_fn is not None else None)
     engine = DecodeEngine(
         params, cfg, serving,
@@ -211,7 +218,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 serving.steps_per_call, engine.n_buckets,
                 ", pixel overlap" if pipeline else "")
     logger.info("POST /generate {\"text\"|\"tokens\", \"n_images\", "
-                "\"seed\"} | GET /stats | GET /healthz")
+                "\"seed\", \"lane\", \"deadline_s\"} | GET /stats | "
+                "GET /healthz (live) | GET /readyz (placement)")
+    if engine.chaos is not None:
+        logger.warning("serve chaos plan ACTIVE (--chaos-plan) — this "
+                       "server injects faults on purpose")
     logger.info("=" * 60)
 
     # SIGTERM (k8s/systemd stop) drains exactly like Ctrl-C: the handler

@@ -403,9 +403,34 @@ class ServingConfig:
     # the stall it measures.
     host_sync_loop: bool = False
     # Queued (not yet admitted) requests beyond this are rejected at
-    # submit — backpressure instead of unbounded growth.
+    # submit — backpressure instead of unbounded growth. The capacity
+    # is shared across priority lanes.
     queue_capacity: int = 256
-    # How long a front-end waits on a request future before 504.
+    # Starvation bound for the low priority lane: after this many
+    # consecutive boundaries where the low lane had queued work but
+    # every grant went high, one admission is reserved for it. None =
+    # strict priority (the low lane may starve under sustained load).
+    low_lane_bypass: Optional[int] = 8
+    # Default per-request completion deadline (seconds from submit)
+    # when the request carries none; None = no deadline (never shed).
+    # A request whose predicted completion (queue depth x measured
+    # decode cadence) misses its deadline is SHED at submit, before
+    # any decode is spent.
+    default_deadline_s: Optional[float] = None
+    # Brownout hysteresis: degraded serving (front-end trims n_images
+    # to brownout_max_images, pixel stage skips CLIP rerank) engages
+    # once the queue sits at/above high_frac x queue_capacity for
+    # hold_s seconds, and disengages at low_frac x queue_capacity.
+    brownout_high_frac: float = 0.75
+    brownout_low_frac: float = 0.25
+    brownout_hold_s: float = 1.0
+    brownout_max_images: int = 1
+    # Serving fault plan (serving/chaos.py ServeFaultPlan: inline JSON
+    # or a file path). None = the bit-transparent clean path.
+    chaos_plan: Optional[str] = None
+    # How long a front-end waits on a request future before 504 (the
+    # timeout also CANCELS the request mid-decode — slots are
+    # reclaimed, not left decoding for a client that gave up).
     request_timeout_s: float = 300.0
     # stop(drain=True) bound: finish queued + in-flight work within this
     # window, then the engine thread is joined regardless.
@@ -428,6 +453,31 @@ class ServingConfig:
         if self.admit_burst is not None and self.admit_burst < 1:
             raise ValueError(
                 f"admit_burst must be >= 1 or None (got {self.admit_burst})")
+        if self.low_lane_bypass is not None and self.low_lane_bypass < 1:
+            raise ValueError(
+                f"low_lane_bypass must be >= 1 or None "
+                f"(got {self.low_lane_bypass})")
+        if self.default_deadline_s is not None \
+                and not self.default_deadline_s > 0:
+            raise ValueError(
+                f"default_deadline_s must be > 0 or None "
+                f"(got {self.default_deadline_s})")
+        if not 0.0 < self.brownout_high_frac <= 1.0:
+            raise ValueError(
+                f"brownout_high_frac must be in (0, 1] "
+                f"(got {self.brownout_high_frac})")
+        if not 0.0 <= self.brownout_low_frac < self.brownout_high_frac:
+            raise ValueError(
+                "brownout_low_frac must satisfy 0 <= low < high_frac "
+                f"(got {self.brownout_low_frac})")
+        if self.brownout_hold_s < 0:
+            raise ValueError(
+                f"brownout_hold_s must be >= 0 "
+                f"(got {self.brownout_hold_s})")
+        if self.brownout_max_images < 1:
+            raise ValueError(
+                f"brownout_max_images must be >= 1 "
+                f"(got {self.brownout_max_images})")
 
 
 @dataclass(frozen=True)

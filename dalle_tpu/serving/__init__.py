@@ -6,25 +6,43 @@ runs start-to-finish together, and pixel decode + CLIP rerank serialize
 behind token generation. This package replaces that with an online
 engine built on ``decode_step``'s per-slot position vector:
 
-- :mod:`engine`    — the slot-recycled KV-cache decode engine
-- :mod:`scheduler` — admission by free slots + KV budget, graceful drain
+- :mod:`engine`    — the slot-recycled KV-cache decode engine with
+  priority lanes, deadline shedding, mid-decode cancellation and
+  brownout mode (SERVING.md "Overload SLOs")
+- :mod:`scheduler` — admission by free slots + KV budget across
+  priority lanes (bounded low-lane bypass), deadline prediction
 - :mod:`metrics`   — per-request TTFT/latency, occupancy, queue depth,
-  img/s, p50/p95, JSONL sink
+  img/s, per-lane p50/p95/p99, shed/brownout/cancel counters, goodput,
+  JSONL sink
 - :mod:`pixels`    — VQGAN pixel decode + CLIP rerank of finished slots
   on a bounded worker thread, overlapped with ongoing token generation
-- :mod:`server`    — stdlib-HTTP front-end (``cli/run_server.py``)
+  (with a degraded brownout variant)
+- :mod:`chaos`     — seeded declarative fault injection for the serving
+  plane (``ServeFaultPlan``: slow/vanished clients, pixel stalls and
+  failures, admission crashes, queue floods — CHAOS.md)
+- :mod:`server`    — stdlib-HTTP front-end (``cli/run_server.py``) with
+  liveness (``/healthz``) split from readiness (``/readyz``)
 """
 
-from dalle_tpu.serving.engine import DecodeEngine, RequestHandle
+from dalle_tpu.serving.chaos import (ServeChaos, ServeFaultPlan,
+                                     maybe_wrap_serving)
+from dalle_tpu.serving.engine import (DeadlineShedError, DecodeEngine,
+                                      RequestHandle)
 from dalle_tpu.serving.metrics import ServingMetrics
 from dalle_tpu.serving.pixels import PixelPipeline
-from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
+from dalle_tpu.serving.scheduler import (LANES, SlotScheduler,
+                                         kv_bytes_per_slot)
 
 __all__ = [
+    "LANES",
+    "DeadlineShedError",
     "DecodeEngine",
     "PixelPipeline",
     "RequestHandle",
+    "ServeChaos",
+    "ServeFaultPlan",
     "ServingMetrics",
     "SlotScheduler",
     "kv_bytes_per_slot",
+    "maybe_wrap_serving",
 ]

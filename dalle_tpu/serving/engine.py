@@ -41,6 +41,18 @@ Prefix buckets: attention reads are statically truncated to the
 smallest bucket bound covering every live slot's chunk-end position
 (``resolve_buckets`` picks the bucket count — the SAME measured policy
 ``generate_images`` uses, not a re-derivation).
+
+Overload SLOs (r12, SERVING.md "Overload SLOs"): admission runs over
+priority lanes (``scheduler.LANES``, bounded low-lane bypass); a
+request with a deadline is SHED before any decode is spent when the
+predicted completion (queue depth × measured service cadence) misses
+it, and re-shed from the queue when its deadline becomes unmeetable;
+:meth:`DecodeEngine.cancel` frees a live slot at the next call boundary
+(one donated ``_release_fn`` dispatch — the front-end wires its
+timeout/disconnect paths here); sustained saturation engages brownout
+(trimmed image counts, degraded pixel stage) instead of a 429 wall.
+The seeded serving fault seam (``serving/chaos.py``) hooks admission
+(crash/stall) and timed queue floods directly in this loop.
 """
 
 from __future__ import annotations
@@ -48,6 +60,7 @@ from __future__ import annotations
 import functools
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
@@ -59,8 +72,10 @@ from dalle_tpu.config import ModelConfig, ServingConfig
 from dalle_tpu.models.decode import (SamplingConfig, bucket_bounds,
                                      decode_step, init_cache,
                                      resolve_buckets, sample_logits)
+from dalle_tpu.serving.chaos import ServeChaos, maybe_wrap_serving
 from dalle_tpu.serving.metrics import ServingMetrics
-from dalle_tpu.serving.scheduler import SlotScheduler, kv_bytes_per_slot
+from dalle_tpu.serving.scheduler import (LANES, SlotScheduler,
+                                         kv_bytes_per_slot)
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +88,15 @@ class QueueFullError(RuntimeError):
 class EngineStoppedError(RuntimeError):
     """submit() refused: the engine is stopping or its thread is gone
     (the front-end maps this to HTTP 503)."""
+
+
+class DeadlineShedError(RuntimeError):
+    """submit() refused BEFORE any decode was spent: the predicted
+    completion (queue depth × measured service cadence, see
+    ``SlotScheduler.predict_completion_s``) already misses the
+    request's deadline. The front-end maps this to HTTP 429 with
+    ``"shed": true`` — the honest answer under overload is an instant
+    cheap no, not a 504 after burning a slot."""
 
 
 class EngineState(NamedTuple):
@@ -182,6 +206,25 @@ def _admit_fn(cfg: ModelConfig, k: int):
     return jax.jit(admit, donate_argnums=0)
 
 
+@functools.lru_cache(maxsize=64)
+def _release_fn(cfg: ModelConfig, k: int):
+    """Jitted batched slot release for mid-decode cancellation: the
+    ``k`` cancelled slots' positions jump to ``total_seq_len`` (the
+    free/finished sentinel) so the next chunk treats them as inactive
+    and the scheduler can re-grant them. State donated like every other
+    state-touching dispatch. A cancelled slot's stale cache rows are
+    invisible to the next occupant for the same reason recycling is
+    safe: admission rewrites pos/tokens/rngs/text/codes, and the new
+    request rewrites cache rows 0..p before the causal mask lets it
+    read them."""
+    total = cfg.total_seq_len
+
+    def release(state: EngineState, slots) -> EngineState:
+        return state._replace(pos=state.pos.at[slots].set(total))
+
+    return jax.jit(release, donate_argnums=0)
+
+
 class RequestHandle:
     """Future for one submitted request. ``result()`` blocks until the
     engine (or the pixel worker, when attached) resolves it."""
@@ -196,6 +239,12 @@ class RequestHandle:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until resolved (or ``timeout``); True when resolved.
+        Unlike :meth:`result` this never raises — front-end wait loops
+        interleave it with client-disconnect probes."""
+        return self._event.wait(timeout)
+
     def result(self, timeout: Optional[float] = None) -> dict:
         """Payload dict: ``codes`` (image_seq_len,) int32 plus, with a
         pixel pipeline, ``images``/``clip_score``; plus the timing row
@@ -205,7 +254,11 @@ class RequestHandle:
             raise TimeoutError(
                 f"request {self.request_id} not done within {timeout}s")
         if "error" in self._payload:
-            raise RuntimeError(
+            # the typed shed marker rides the payload so the front-end
+            # maps a queued-shed to 429 without matching message text
+            exc = (DeadlineShedError if self._payload.get("shed")
+                   else RuntimeError)
+            raise exc(
                 f"request {self.request_id}: {self._payload['error']}")
         return self._payload
 
@@ -241,6 +294,12 @@ class _Pending:
     key: np.ndarray
     handle: RequestHandle
     sampling: SamplingConfig
+    lane: str = LANES[0]
+    #: absolute monotonic completion deadline; None = never shed
+    deadline: Optional[float] = None
+    #: chaos-flood filler: occupies queue + decode capacity like real
+    #: work but resolves out of band and never feeds the ledger
+    synthetic: bool = False
     first_code_seen: bool = field(default=False)
 
 
@@ -260,7 +319,8 @@ class DecodeEngine:
                  serving: Optional[ServingConfig] = None,
                  sampling: SamplingConfig = SamplingConfig(),
                  pixel_pipeline=None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 chaos: Optional[ServeChaos] = None):
         serving = serving or ServingConfig()
         serving.validate()
         self._params = params
@@ -276,15 +336,22 @@ class DecodeEngine:
         n_buckets = resolve_buckets(serving.decode_buckets, s)
         self._bounds = bucket_bounds(total, n_buckets)
         self._chunk = serving.steps_per_call
-        self.scheduler = SlotScheduler(s, kv_bytes_per_slot(cfg),
-                                       serving.kv_budget_mb,
-                                       admit_burst=serving.admit_burst)
+        self.scheduler = SlotScheduler(
+            s, kv_bytes_per_slot(cfg), serving.kv_budget_mb,
+            admit_burst=serving.admit_burst,
+            low_lane_bypass=serving.low_lane_bypass)
         self.metrics = metrics or ServingMetrics(
             n_slots=s, interval_s=serving.metrics_interval_s)
+        # ONE ServeChaos per serving process: the front-end and pixel
+        # worker reach it through the engine, so flood state and the
+        # admission counter are shared the way real load is
+        self._chaos = (chaos if chaos is not None
+                       else maybe_wrap_serving(serving.chaos_plan))
         if pixel_pipeline is not None:
             # a pipeline built without metrics adopts the engine's —
             # submit/admit and complete/fail must share one ledger
             pixel_pipeline.bind_metrics(self.metrics)
+            pixel_pipeline.bind_chaos(self._chaos)
         self._state = EngineState(
             cache=init_cache(cfg, s),
             pos=jnp.full((s,), total, jnp.int32),
@@ -312,7 +379,15 @@ class DecodeEngine:
         # path cancel so a mid-admission failure can't orphan a handle
         self._admitting: List[_Pending] = []
         self._cv = threading.Condition()
-        self._queue: List[_Pending] = []       # guarded by _cv
+        # per-lane FIFO queues, priority order (scheduler.LANES)
+        self._queues: Dict[str, List[_Pending]] = \
+            {ln: [] for ln in LANES}           # guarded by _cv
+        # mid-decode cancellations flagged for the engine thread:
+        # rid -> reason; processed (slot freed) at the next boundary
+        self._cancel_rids: Dict[int, str] = {}  # guarded by _cv
+        # brownout state: engine thread writes, front-end reads (bool)
+        self._brownout = False
+        self._saturated_since: Optional[float] = None
         self._handles: Dict[int, RequestHandle] = {}   # guarded by _cv
         self._handles_prune_at = 2 * serving.queue_capacity  # guarded by _cv
         self._next_id = 0                      # guarded by _cv
@@ -328,18 +403,39 @@ class DecodeEngine:
         return self
 
     def submit(self, text_tokens, rng=0,
-               sampling: Optional[SamplingConfig] = None) -> RequestHandle:
+               sampling: Optional[SamplingConfig] = None,
+               lane: str = LANES[0],
+               deadline_s: Optional[float] = None) -> RequestHandle:
         """Queue one image request. ``text_tokens``: (text_seq_len,)
         tokenizer ids; ``rng``: an int seed or a PRNG key — the SAME key
         handed to ``generate_images`` samples the SAME codes.
         ``sampling``: this request's SamplingConfig (default: the
         engine's). Per-request knobs are runtime operands of the chunk
-        program — a novel temperature never triggers a compile."""
+        program — a novel temperature never triggers a compile.
+        ``lane``: priority lane (``"high"`` default / ``"low"``).
+        ``deadline_s``: seconds from now this request's artifact is
+        worth delivering (default ``ServingConfig.default_deadline_s``);
+        when the predicted completion already misses it, submit raises
+        :class:`DeadlineShedError` BEFORE the request costs any decode,
+        and a queued request whose deadline becomes unmeetable is shed
+        at the next boundary."""
         text = np.asarray(text_tokens, np.int32).reshape(-1)
         if text.shape[0] != self._cfg.text_seq_len:
             raise ValueError(
                 f"text must be ({self._cfg.text_seq_len},) tokenizer ids, "
                 f"got shape {text.shape}")
+        if lane not in LANES:
+            raise ValueError(f"lane must be one of {LANES}, got {lane!r}")
+        if deadline_s is None:
+            deadline_s = self._serving.default_deadline_s
+        if deadline_s is not None and not (
+                np.isfinite(deadline_s) and deadline_s > 0):
+            # malformed input is a 400, not a shed: a non-positive
+            # deadline inflating the shed counter would masquerade as
+            # load the SLO machinery refused
+            raise ValueError(
+                f"deadline_s must be a finite positive number or None, "
+                f"got {deadline_s!r}")
         if np.ndim(rng) == 0:
             key = np.asarray(jax.random.PRNGKey(int(rng)))
         else:
@@ -350,13 +446,26 @@ class DecodeEngine:
             if self._stopping:
                 raise EngineStoppedError("engine is stopping; submit "
                                          "refused")
-            if len(self._queue) >= self._serving.queue_capacity:
+            if sum(len(q) for q in self._queues.values()) \
+                    >= self._serving.queue_capacity:
                 raise QueueFullError(
                     f"request queue full ({self._serving.queue_capacity})")
+            deadline = None
+            if deadline_s is not None:
+                predicted = self._predict_completion_locked(lane)
+                if predicted is not None and predicted > deadline_s:
+                    self.metrics.record_shed(lane)
+                    raise DeadlineShedError(
+                        f"shed: predicted completion {predicted:.2f}s "
+                        f"misses the {deadline_s:.2f}s deadline "
+                        f"(lane {lane!r})")
+                deadline = time.monotonic() + deadline_s
             rid = self._next_id
             self._next_id += 1
             handle = RequestHandle(rid)
-            self._queue.append(_Pending(rid, text, key, handle, sampling))
+            self._queues[lane].append(_Pending(
+                rid, text, key, handle, sampling, lane=lane,
+                deadline=deadline))
             if len(self._handles) >= self._handles_prune_at:
                 # lazy prune: resolved handles leave the abandonment
                 # registry so a long-lived server stays O(outstanding).
@@ -369,9 +478,58 @@ class DecodeEngine:
                     2 * self._serving.queue_capacity,
                     2 * len(self._handles))
             self._handles[rid] = handle
-            self.metrics.record_submit(rid)
+            self.metrics.record_submit(rid, lane)
             self._cv.notify()
         return handle
+
+    def _predict_completion_locked(self, lane: str) -> Optional[float]:
+        """Predicted completion (seconds from now) for a request queued
+        on ``lane`` NOW: same-or-higher-lane queue depth and live slots
+        through ``SlotScheduler.predict_completion_s`` at the measured
+        service cadence. None until the first harvest has measured one
+        (admit optimistically rather than shed on a guess). Caller
+        holds ``_cv``; the lock order _cv → metrics._lock is the same
+        one every metrics call under submit already takes."""
+        service = self.metrics.service_ema_s
+        if service is None:
+            return None
+        ahead = 0
+        for ln in LANES:
+            ahead += len(self._queues[ln])
+            if ln == lane:
+                break
+        live = sum(p is not None for p in self._slots)
+        return self.scheduler.predict_completion_s(ahead, live, service)
+
+    def cancel(self, request_id: int,
+               reason: str = "cancelled by client") -> bool:
+        """Cancel an outstanding request (the client timed out, hung
+        up, or gave up). Still queued: resolved here, immediately.
+        Mid-decode: flagged for the engine thread, which frees the slot
+        at the NEXT call boundary — the grant that follows sees it, so
+        the slot returns to the scheduler within one boundary. Already
+        resolved (or unknown): returns False, changes nothing. A cancel
+        racing a completion is safe by the ``_claim``/``_deliver``
+        discipline: first resolution wins, the loser is a no-op."""
+        with self._cv:
+            for lane in LANES:
+                q = self._queues[lane]
+                for i, pend in enumerate(q):
+                    if pend.rid == request_id:
+                        q.pop(i)
+                        if pend.handle._resolve({"error": reason}) \
+                                and not pend.synthetic:
+                            # synthetic flood filler never recorded a
+                            # submit; counting its cancel would break
+                            # the ledger identity the soak audits
+                            self.metrics.record_cancelled(pend.rid)
+                        return True
+            handle = self._handles.get(request_id)
+            if handle is None or handle.done():
+                return False
+            self._cancel_rids[request_id] = reason
+            self._cv.notify()
+        return True
 
     def _validated_sampling(self, sampling: Optional[SamplingConfig]
                             ) -> SamplingConfig:
@@ -441,13 +599,63 @@ class DecodeEngine:
     def n_buckets(self) -> int:
         return len(self._bounds)
 
+    @property
+    def brownout_active(self) -> bool:
+        """Whether sustained saturation has engaged degraded serving
+        (the front-end trims image counts and the pixel stage skips
+        CLIP rerank while this holds)."""
+        return self._brownout
+
+    @property
+    def chaos(self) -> Optional[ServeChaos]:
+        """The process-wide ServeChaos (None on the clean path) — the
+        front-end and pixel worker reach the shared seam through here."""
+        return self._chaos
+
+    @property
+    def alive(self) -> bool:
+        """Liveness: the engine can still make progress — its thread is
+        running, or it has not been started yet. False once the loop
+        exited (clean stop or crash): /healthz flips and the
+        orchestrator restarts or reroutes."""
+        if self._thread.ident is None:
+            return not self._stopping
+        return self._thread.is_alive()
+
+    def readiness(self) -> dict:
+        """The cheap readiness slice for /readyz: queue state + the
+        counter telemetry a router places by — no percentile math, no
+        record-window scan (those stay on /stats)."""
+        with self._cv:
+            depths = {ln: len(self._queues[ln]) for ln in LANES}
+            draining = self._stopping
+        out = self.metrics.counters()
+        out["queue_depth_by_lane"] = depths
+        out["queue_depth"] = sum(depths.values())
+        out["queue_capacity"] = self._serving.queue_capacity
+        out["brownout"] = self._brownout
+        out["draining"] = draining
+        return out
+
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         with self._cv:
-            snap["queue_depth"] = len(self._queue)
+            depths = {ln: len(self._queues[ln]) for ln in LANES}
+            draining = self._stopping
+        snap["queue_depth"] = sum(depths.values())
+        snap["queue_depth_by_lane"] = depths
+        snap["queue_capacity"] = self._serving.queue_capacity
+        snap["brownout"] = self._brownout
+        snap["draining"] = draining
         snap["n_slots"] = self._serving.n_slots
         snap["max_live_slots"] = self.scheduler.max_live
         return snap
+
+    @property
+    def serving(self) -> ServingConfig:
+        """The resolved ServingConfig (the front-end reads the brownout
+        image cap and queue capacity from here)."""
+        return self._serving
 
     # -- engine thread --------------------------------------------------
 
@@ -486,7 +694,8 @@ class DecodeEngine:
         for pending, slot in zip(admitted, slots):
             self._slots[slot] = pending
             self._pos_host[slot] = 0
-            self.metrics.record_admit(pending.rid)
+            if not pending.synthetic:
+                self.metrics.record_admit(pending.rid)
 
     def _after_chunk(self, live_slots: List[int], queue_depth: int,
                      mirror_current: bool = False) -> List[int]:
@@ -520,6 +729,10 @@ class DecodeEngine:
         execution reads it before admission zeroes the slot."""
         for slot in slots:
             pending = self._slots[slot]
+            if not pending.synthetic:
+                # decode service sample for the shed predictor (host
+                # clocks only — the admit timestamp is already local)
+                self.metrics.note_service(pending.rid)
             # slice BEFORE clearing the slot: if the slice dispatch
             # raises, the pending is still reachable from _slots for
             # the crash-path cancel sweep (first-claim-wins dedupes the
@@ -546,16 +759,28 @@ class DecodeEngine:
 
     def _finish_harvest(self, pending: _Pending, row: jax.Array) -> None:
         codes = np.asarray(row)
+        if pending.synthetic:
+            # chaos-flood filler: load, not work — resolve out of band,
+            # never feed the completion ledger or the pixel stage
+            pending.handle._resolve({"codes": codes, "synthetic": True})
+            return
         if self._pixels is not None:
-            self._pixels.submit(pending.handle, pending.rid, codes)
+            # the deadline verdict is judged AFTER pixels, where the
+            # client actually receives the artifact (pixels.py)
+            self._pixels.submit(pending.handle, pending.rid, codes,
+                                degraded=self._brownout,
+                                deadline=pending.deadline)
         elif pending.handle._claim():
             # claim BEFORE touching the ledger: a handle the stop()-
             # abandonment sweep already resolved must not also count
             # as completed (and its popped timers would fabricate a
             # ~0s latency row, skewing the percentiles)
+            deadline_ok = (None if pending.deadline is None
+                           else time.monotonic() <= pending.deadline)
             pending.handle._deliver(
                 {"codes": codes,
-                 **self.metrics.record_complete(pending.rid)})
+                 **self.metrics.record_complete(pending.rid,
+                                                deadline_ok=deadline_ok)})
         else:
             logger.debug("request %d resolved elsewhere before "
                          "harvest landed", pending.rid)
@@ -570,8 +795,9 @@ class DecodeEngine:
 
     def _cancel_outstanding(self) -> None:
         with self._cv:
-            leftover = list(self._queue)
-            self._queue.clear()
+            leftover = [p for ln in LANES for p in self._queues[ln]]
+            for q in self._queues.values():
+                q.clear()
         harvests, self._harvests = self._harvests, []
         # _admitting covers the popped-but-not-yet-in-_slots window (a
         # loop crash mid-admission): those pendings belong to none of
@@ -583,7 +809,8 @@ class DecodeEngine:
         for pend in (leftover + admitting
                      + [p for p in self._slots if p is not None]
                      + [p for p, _row in harvests]):
-            if pend.handle._resolve({"error": "cancelled at engine stop"}):
+            if pend.handle._resolve({"error": "cancelled at engine stop"}) \
+                    and not pend.synthetic:
                 self.metrics.record_cancelled(pend.rid)
         self._slots = [None] * self._serving.n_slots
 
@@ -616,18 +843,139 @@ class DecodeEngine:
                 self._stopping = True
             self._cancel_outstanding()
 
+    def _take_cancels(self) -> Dict[int, str]:
+        with self._cv:
+            cancels, self._cancel_rids = self._cancel_rids, {}
+        return cancels
+
+    def _release_cancelled(self, cancels: Dict[int, str]) -> None:
+        """Free the slots of mid-decode-cancelled requests: resolve each
+        handle (first claim wins — a completion already harvested keeps
+        its win and its slot was already recycled), clear the slot
+        table + host mirror, and mark the device positions free in ONE
+        donated dispatch. Runs at the boundary top, so the grant that
+        follows can hand the freed slots straight to the queue. A rid
+        whose decode already finished (riding _harvests or the pixel
+        queue) is deliberately skipped — its slot is free and its
+        completion resolves the handle."""
+        slots = []
+        total = self._cfg.total_seq_len
+        for slot, pending in enumerate(self._slots):
+            if pending is None or pending.rid not in cancels:
+                continue
+            if pending.handle._resolve({"error": cancels[pending.rid]}) \
+                    and not pending.synthetic:
+                self.metrics.record_cancelled(pending.rid, mid_decode=True)
+            self._slots[slot] = None
+            self._pos_host[slot] = total
+            slots.append(slot)
+        if slots:
+            self._state = _release_fn(self._cfg, len(slots))(
+                self._state, jnp.asarray(np.asarray(slots, np.int32)))
+
+    def _maybe_flood(self) -> None:
+        """Chaos seam: inject any due artificial queue flood as
+        synthetic low-lane requests (bounded by queue capacity — a
+        flood models pressure, and pressure is what a full queue is)."""
+        if self._chaos is None or self._stopping:
+            # no synthetic load once a drain has begun: the fault
+            # harness must exercise shutdown, not extend it
+            return
+        burst = self._chaos.flood_due()
+        if not burst:
+            return
+        n = 0
+        with self._cv:
+            room = self._serving.queue_capacity - sum(
+                len(q) for q in self._queues.values())
+            n = max(0, min(burst, room))
+            for _ in range(n):
+                rid = self._next_id
+                self._next_id += 1
+                self._queues[LANES[-1]].append(_Pending(
+                    rid, np.zeros(self._cfg.text_seq_len, np.int32),
+                    np.zeros(2, np.uint32), RequestHandle(rid),
+                    self._sampling, lane=LANES[-1], synthetic=True))
+        if n:
+            self._chaos.note_flood(n)
+            self.metrics.record_flood(n)
+            logger.warning("chaos: queue flood injected %d synthetic "
+                           "request(s) (%d in plan burst)", n, burst)
+
+    def _expire_queued_deadlines(self) -> None:
+        """Shed queued requests whose deadline has become unmeetable
+        BEFORE they reach a slot — the decode they would burn can serve
+        a request that can still win. Unmeetable: the deadline already
+        passed, or now + one measured service time exceeds it (even an
+        immediate grant loses). Without a measured cadence yet, only
+        already-expired deadlines shed. Caller holds ``_cv``."""
+        service = self.metrics.service_ema_s
+        now = time.monotonic()
+        for lane in LANES:
+            kept = []
+            for pend in self._queues[lane]:
+                limit = pend.deadline
+                if limit is not None and not pend.synthetic and (
+                        now > limit
+                        or (service is not None
+                            and now + service > limit)):
+                    if pend.handle._resolve(
+                            {"error": "shed: deadline became unmeetable "
+                                      "while queued", "shed": True}):
+                        self.metrics.record_shed(lane, rid=pend.rid)
+                    continue
+                kept.append(pend)
+            self._queues[lane][:] = kept
+
+    def _update_brownout(self, queue_depth: int) -> None:
+        """Brownout hysteresis + hold: engage once the total queue has
+        sat at/above ``brownout_high_frac × queue_capacity`` for
+        ``brownout_hold_s`` seconds; disengage when it falls to
+        ``brownout_low_frac × capacity``. Engine thread only; readers
+        (the front-end trimming image counts, /readyz) see a bool."""
+        cfg = self._serving
+        now = time.monotonic()
+        if queue_depth >= cfg.brownout_high_frac * cfg.queue_capacity:
+            if self._saturated_since is None:
+                self._saturated_since = now
+            if (not self._brownout
+                    and now - self._saturated_since >= cfg.brownout_hold_s):
+                self._brownout = True
+                logger.warning(
+                    "brownout ENGAGED: queue %d/%d sustained %.2fs — "
+                    "serving degraded (image cap %d, rerank off)",
+                    queue_depth, cfg.queue_capacity,
+                    now - self._saturated_since, cfg.brownout_max_images)
+        else:
+            self._saturated_since = None
+            if self._brownout \
+                    and queue_depth <= cfg.brownout_low_frac \
+                    * cfg.queue_capacity:
+                self._brownout = False
+                logger.info("brownout disengaged: queue depth %d",
+                            queue_depth)
+
     def _serve_loop(self) -> None:
         sync = self._serving.host_sync_loop
         while True:
+            cancels = self._take_cancels()
+            if cancels:
+                self._release_cancelled(cancels)
+            self._maybe_flood()
             with self._cv:
                 if self._stopping and not self._draining:
                     break
+                self._expire_queued_deadlines()
                 free = [i for i, p in enumerate(self._slots) if p is None]
                 live = self._serving.n_slots - len(free)
-                n_admit = self.scheduler.grant(len(self._queue), live,
-                                               len(free))
-                admitted = [self._queue.pop(0) for _ in range(n_admit)]
-                queue_depth = len(self._queue)
+                grants = self.scheduler.grant_lanes(
+                    [len(self._queues[ln]) for ln in LANES], live,
+                    len(free))
+                admitted = []
+                for ln, n_adm in zip(LANES, grants):
+                    for _ in range(n_adm):
+                        admitted.append(self._queues[ln].pop(0))
+                queue_depth = sum(len(q) for q in self._queues.values())
                 if not admitted and live == 0:
                     if self._stopping:
                         break      # drained: queue empty, slots empty
@@ -636,6 +984,7 @@ class DecodeEngine:
                     idle = True
                 else:
                     idle = False
+            self._update_brownout(queue_depth)
             if idle:
                 # a finished wave may still be riding the harvest
                 # pipeline, and the JSONL trace must keep ticking while
@@ -646,6 +995,11 @@ class DecodeEngine:
                 continue
             if admitted:
                 self._admitting = admitted
+                if self._chaos is not None:
+                    # the crash-at-admission seam fires INSIDE the
+                    # _admitting window, so the crash-path sweep is
+                    # what keeps these handles from orphaning
+                    self._chaos.on_admit(len(admitted))
                 self._admit_batch(admitted, free[: len(admitted)])
                 self._admitting = []
             live_slots = [i for i, p in enumerate(self._slots)

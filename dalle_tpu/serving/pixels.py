@@ -14,6 +14,13 @@ discipline (same shape as ``training/remote_sink.UploadWorker``). The
 bounded queue is deliberate backpressure: if the pixel stage truly is
 the bottleneck, the engine blocks on submit rather than queueing
 unboundedly.
+
+Overload hooks (r12): a job handed off while the engine is browned out
+runs ``degraded_fn`` when one is configured (typically VQGAN decode
+WITHOUT the CLIP rerank — brownout trades candidate quality for
+latency, never correctness), and the serve-chaos seam
+(``serving/chaos.py``) may stall or fail a job here exactly where a
+real VQGAN/CLIP hiccup would land.
 """
 
 from __future__ import annotations
@@ -21,10 +28,12 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from dalle_tpu.serving.chaos import ServeChaos
 from dalle_tpu.serving.metrics import ServingMetrics
 
 logger = logging.getLogger(__name__)
@@ -38,13 +47,25 @@ class PixelPipeline:
     dict to merge into the result payload — typically ``{"images":
     (H, W, 3) uint8}`` and optionally ``{"clip_score": float}``. It runs
     only on this thread, so a jitted closure needs no locking.
+
+    ``degraded_fn``: the brownout variant (same contract). When the
+    engine hands off a job with ``degraded=True`` and a degraded fn
+    exists, it runs instead and the payload is marked
+    ``"degraded": true`` — the client learns its artifact was served
+    under brownout. Without a degraded fn the full fn still runs (the
+    flag still rides the payload; brownout then only trims image
+    counts at the front-end).
     """
 
     def __init__(self, pixel_fn: Callable[[np.ndarray], dict],
                  metrics: Optional[ServingMetrics] = None,
+                 degraded_fn: Optional[Callable[[np.ndarray], dict]] = None,
+                 chaos: Optional[ServeChaos] = None,
                  maxsize: int = 32):
         self._fn = pixel_fn
+        self._degraded_fn = degraded_fn
         self._metrics = metrics
+        self._chaos = chaos
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._thread = threading.Thread(target=self._run,
                                         name="pixel-worker", daemon=True)
@@ -57,9 +78,21 @@ class PixelPipeline:
         if self._metrics is None:
             self._metrics = metrics
 
-    def submit(self, handle, rid: int, codes: np.ndarray) -> None:
-        """Blocking put — backpressure when the pixel stage lags."""
-        self._q.put((handle, rid, codes))
+    def bind_chaos(self, chaos: Optional[ServeChaos]) -> None:
+        """Adopt the engine's ServeChaos (one shared seam per serving
+        process — DecodeEngine calls this, mirroring bind_metrics)."""
+        if self._chaos is None:
+            self._chaos = chaos
+
+    def submit(self, handle, rid: int, codes: np.ndarray,
+               degraded: bool = False,
+               deadline: Optional[float] = None) -> None:
+        """Blocking put — backpressure when the pixel stage lags.
+        ``degraded``: the engine was browned out at harvest;
+        ``deadline``: the request's absolute monotonic deadline (its
+        met/missed verdict is judged AFTER pixels, where the client
+        actually receives the artifact)."""
+        self._q.put((handle, rid, codes, degraded, deadline))
 
     def stop(self, timeout: float = 60.0) -> None:
         """Drain everything already queued, then reap the worker. The
@@ -83,24 +116,35 @@ class PixelPipeline:
             item = self._q.get()
             if item is None:
                 return
-            handle, rid, codes = item
+            handle, rid, codes, degraded, deadline = item
             if not handle._claim():
                 # resolved elsewhere (the engine's stop()-abandonment
-                # sweep won the race): skip the work AND the ledger —
-                # a request must never count both cancelled and
-                # completed/failed
+                # sweep or a mid-decode cancel won the race): skip the
+                # work AND the ledger — a request must never count both
+                # cancelled and completed/failed
                 continue
+            fn = (self._degraded_fn
+                  if degraded and self._degraded_fn is not None
+                  else self._fn)
             try:
-                extra = self._fn(codes)
-            except Exception as e:  # noqa: BLE001 - a pixel-stage failure
-                # must fail THAT request, never kill the worker the
-                # engine relies on for every later completion
+                if self._chaos is not None:
+                    self._chaos.on_pixel(rid)
+                extra = fn(codes)
+            except Exception as e:  # noqa: BLE001 - a pixel-stage
+                # failure (ChaosInjectedError included) must fail THAT
+                # request, never kill the worker the engine relies on
+                # for every later completion
                 logger.warning("pixel stage failed for request %d: %s",
                                rid, e)
                 if self._metrics:   # failed, NOT completed: keep /stats
                     self._metrics.record_failed(rid)   # throughput honest
                 handle._deliver({"error": f"pixel stage failed: {e}"})
                 continue
-            row = (self._metrics.record_complete(rid)
+            if degraded:
+                extra = {**extra, "degraded": True}
+            deadline_ok = (None if deadline is None
+                           else time.monotonic() <= deadline)
+            row = (self._metrics.record_complete(rid,
+                                                 deadline_ok=deadline_ok)
                    if self._metrics else {})
             handle._deliver({"codes": codes, **extra, **row})

@@ -1,20 +1,41 @@
 """Admission control for the continuous-batching engine.
 
-Policy: FIFO over the request queue, admitted when (a) a cache slot is
-free and (b) the KV budget allows another live slot. Image generation is
-fixed-length (every request decodes exactly ``total_seq_len`` positions)
-so there is no preemption and no starvation: admission order is
-completion order up to slot-level skew.
+r8 policy was FIFO over one queue, admitted when (a) a cache slot is
+free and (b) the KV budget allows another live slot. This round adds
+**priority lanes** and **deadline awareness** on top of the same slot
+machinery:
+
+- Lanes (:data:`LANES`): ``"high"`` (interactive — strict priority) and
+  ``"low"`` (batch/bulk). Admission serves the high lane first, with a
+  **bounded bypass** for starvation freedom: after ``low_lane_bypass``
+  consecutive boundaries where the low lane had queued work but every
+  grant went high, one slot is reserved for the low lane before the
+  high queue is served. Image generation is fixed-length, so within a
+  lane admission order is completion order up to slot-level skew; the
+  bypass bounds cross-lane starvation to ``low_lane_bypass`` waves.
+- Deadline prediction (:meth:`SlotScheduler.predict_completion_s`): a
+  pure function of queue depth ahead, live slots and the measured
+  per-request decode service time (an EMA the metrics ledger keeps from
+  admit→harvest timing, which the r9 host position mirror makes exact
+  at chunk granularity). The engine sheds a request — at submit, before
+  any decode is spent — when the prediction strictly exceeds its
+  deadline, and re-sheds queued requests whose deadline has become
+  unmeetable while they waited.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from dalle_tpu.config import ModelConfig
+
+#: priority order, index 0 highest. Two lanes deliberately: every lane
+#: is a head-of-line-blocking boundary and a starvation surface; more
+#: tiers than "interactive" vs "bulk" buys ordering nobody asked for.
+LANES = ("high", "low")
 
 
 def kv_bytes_per_slot(cfg: ModelConfig) -> int:
@@ -29,29 +50,43 @@ def kv_bytes_per_slot(cfg: ModelConfig) -> int:
 
 
 class SlotScheduler:
-    """Free-slot + KV-budget admission.
+    """Free-slot + KV-budget admission with priority lanes.
 
     ``kv_budget_mb`` caps how many slots may be LIVE at once:
     ``floor(budget / bytes-per-slot)``, clamped to [1, n_slots]. The
     cache is statically allocated at ``n_slots`` either way (XLA static
     shapes); the budget models co-tenancy pressure — an engine sharing
     HBM with a trainer admits fewer concurrent requests instead of
-    OOMing mid-flight.
+    OOMing mid-flight. The budget is lane-blind by design: a saturated
+    high lane consumes the whole clamp and the low lane rides only the
+    bypass.
 
-    ``admit_burst`` caps admissions PER CALL BOUNDARY. The pipelined
-    engine scatters a whole admission batch in one dispatch; a huge
-    burst (cold start against a deep queue) puts one outsized
-    scatter + prefix upload between two chunks and dents the dispatch
-    cadence — bounding the burst amortizes admission over several
-    boundaries instead. None = admit everything eligible at once.
+    ``admit_burst`` caps admissions PER CALL BOUNDARY, across all lanes
+    combined. The pipelined engine scatters a whole admission batch in
+    one dispatch; a huge burst (cold start against a deep queue) puts
+    one outsized scatter + prefix upload between two chunks and dents
+    the dispatch cadence — bounding the burst amortizes admission over
+    several boundaries instead. None = admit everything eligible.
+
+    ``low_lane_bypass``: consecutive starved boundaries the low lane
+    tolerates before one admission is reserved for it (None disables —
+    strict priority, the low lane may starve forever under sustained
+    high-lane load).
     """
 
     def __init__(self, n_slots: int, bytes_per_slot: int,
                  kv_budget_mb: Optional[int] = None,
-                 admit_burst: Optional[int] = None):
+                 admit_burst: Optional[int] = None,
+                 low_lane_bypass: Optional[int] = None):
         self.n_slots = n_slots
         self.bytes_per_slot = bytes_per_slot
         self.admit_burst = admit_burst
+        if low_lane_bypass is not None and low_lane_bypass < 1:
+            raise ValueError(
+                f"low_lane_bypass must be >= 1 or None, "
+                f"got {low_lane_bypass}")
+        self.low_lane_bypass = low_lane_bypass
+        self._low_starved = 0
         if kv_budget_mb is None:
             self.max_live = n_slots
         else:
@@ -59,8 +94,60 @@ class SlotScheduler:
             self.max_live = int(max(1, min(n_slots, by_budget)))
 
     def grant(self, queued: int, live: int, free: int) -> int:
-        """How many queued requests to admit this call boundary."""
+        """Total admissions this call boundary (lane-blind: the r8
+        contract, still the budget/burst arbiter under lanes)."""
         n = max(0, min(queued, free, self.max_live - live))
         if self.admit_burst is not None:
             n = min(n, self.admit_burst)
         return n
+
+    def grant_lanes(self, queued: Sequence[int], live: int,
+                    free: int) -> List[int]:
+        """Per-lane admissions this boundary, ``queued`` in
+        :data:`LANES` priority order. The total is exactly
+        ``grant(sum(queued), live, free)`` — lanes change WHO is
+        admitted, never how many — and higher lanes are served first
+        except for the bounded low-lane bypass.
+
+        Starvation bookkeeping lives here (one scheduler per engine,
+        called once per boundary from the engine thread): a boundary
+        counts as starving the low lane when it had queued work, some
+        OTHER lane was granted, and it got nothing. A zero-grant
+        boundary (no free slot / budget) starves nobody — there was
+        nothing to bypass into.
+        """
+        if len(queued) != len(LANES):
+            raise ValueError(
+                f"queued must have one entry per lane {LANES}, "
+                f"got {len(queued)}")
+        budget = self.grant(sum(queued), live, free)
+        grants = [0] * len(LANES)
+        low = len(LANES) - 1
+        if (budget > 0 and queued[low] > 0
+                and self.low_lane_bypass is not None
+                and self._low_starved >= self.low_lane_bypass):
+            grants[low] = 1
+            budget -= 1
+        for i, q in enumerate(queued):
+            take = min(budget, q - grants[i])
+            grants[i] += take
+            budget -= take
+        if grants[low] > 0:
+            self._low_starved = 0
+        elif queued[low] > 0 and sum(grants) > 0:
+            self._low_starved += 1
+        return grants
+
+    def predict_completion_s(self, ahead: int, live: int,
+                             service_s: float) -> float:
+        """Predicted seconds until a request queued behind ``ahead``
+        same-or-higher-lane requests (with ``live`` slots already
+        decoding) completes, given the measured per-request decode
+        service time. Wave model: the queue drains ``max_live`` at a
+        time, and the candidate rides wave ``1 + (ahead+live)//max_live``
+        — exact for saturated fixed-length decode (every request costs
+        the same chunk count), optimistic by partial-wave progress
+        otherwise, which is the right bias for a shed decision (never
+        reject work a healthy engine would have finished)."""
+        waves = 1 + (ahead + live) // max(1, self.max_live)
+        return waves * service_s

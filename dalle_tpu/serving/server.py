@@ -7,34 +7,59 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
   optional ``"n_images"`` (default 1), ``"seed"`` (default 0; image
   *i* of a request uses ``fold_in(seed, i)`` so a multi-image query is
   n independent single-image requests — exactly how the engine recycles
-  slots), and per-request sampling knobs ``"temperature"`` / ``"top_k"``
-  / ``"top_p"`` (default: the engine's config; knobs are traced runtime
-  operands of the chunk program, so a novel value never compiles).
-  Blocks until every image resolves; the response carries each
-  request's codes (and ``clip_score`` when the pixel stage reranks)
-  with its TTFT / latency / queue-wait accounting.
-- ``GET /stats``  — the metrics snapshot + live queue depth.
-- ``GET /healthz`` — liveness + slot occupancy.
+  slots), per-request sampling knobs ``"temperature"`` / ``"top_k"`` /
+  ``"top_p"``, a priority ``"lane"`` (``"high"`` default / ``"low"``)
+  and a ``"deadline_s"`` (seconds from receipt the artifact is worth
+  delivering). Blocks until every image resolves; the response carries
+  each request's codes (and ``clip_score`` when the pixel stage
+  reranks) with its TTFT / latency / queue-wait accounting.
+- ``GET /stats``  — the metrics snapshot + live queue depth (per lane),
+  shed / brownout / cancel counters and goodput.
+- ``GET /healthz`` — LIVENESS only: is the engine thread able to make
+  progress. Flips false on a crashed/stopped engine so an orchestrator
+  restarts the pod; it says nothing about load.
+- ``GET /readyz`` — READINESS: whether a router should place new work
+  here. Reports (and 503s on) draining and queue-full states, plus the
+  overload telemetry a placement decision wants: brownout flag,
+  per-lane queue depth, shed/brownout/cancel counters, goodput.
+
+Overload behavior: queue full → **429**; deadline shed (predicted
+completion already misses ``deadline_s``) → **429** with
+``"shed": true`` — both cheap instant refusals, spent before any decode.
+Under brownout the front-end trims ``n_images`` to the configured cap
+and marks the response ``"brownout": true`` instead of collapsing into
+429s. A request that times out (``request_timeout_s``) or whose client
+vanishes mid-wait is **cancelled mid-decode** — every sibling handle is
+cancelled too, so slots return to the scheduler instead of decoding for
+nobody (the r8→r11 front-end leaked the slot here).
 
 One handler thread per in-flight connection (``ThreadingHTTPServer``,
-daemonized); the engine's queue capacity is the real admission bound —
-a full queue surfaces as HTTP 429 (back off and retry), a stopping or
-crashed engine as HTTP 503.
+daemonized); a stopping or crashed engine surfaces as HTTP 503.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import select
+import socket
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import numpy as np
 
 from dalle_tpu.models.decode import SamplingConfig
-from dalle_tpu.serving.engine import EngineStoppedError, QueueFullError
+from dalle_tpu.serving.engine import (DeadlineShedError, EngineStoppedError,
+                                      QueueFullError)
+from dalle_tpu.serving.scheduler import LANES
 
 logger = logging.getLogger(__name__)
+
+
+class _ClientGone(Exception):
+    """The requester hung up mid-wait (EOF on the connection): cancel
+    its work, write nothing."""
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
@@ -66,11 +91,29 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - stdlib handler contract
         engine = self.server.engine
         if self.path == "/healthz":
-            stats = engine.stats()
-            self._reply(200, {"ok": True,
-                              "n_slots": stats["n_slots"],
-                              "queue_depth": stats["queue_depth"],
-                              "completed": stats["completed"]})
+            # liveness ONLY — no locks, no queue math: a health probe
+            # must stay cheap and truthful when everything else is on
+            # fire. Restart-worthy states (crashed/stopped loop) 503.
+            alive = engine.alive
+            self._reply(200 if alive else 503, {"ok": alive})
+        elif self.path == "/readyz":
+            # counters-only telemetry (engine.readiness): a router may
+            # probe this every few seconds — it must never pay /stats'
+            # percentile math under the metrics lock
+            state = engine.readiness()
+            full = state["queue_depth"] >= state["queue_capacity"]
+            ready = engine.alive and not state["draining"] and not full
+            self._reply(200 if ready else 503, {
+                "ready": ready,
+                "draining": state["draining"],
+                "queue_full": full,
+                "brownout": state["brownout"],
+                "queue_depth_by_lane": state["queue_depth_by_lane"],
+                "shed": state["shed"],
+                "browned": state["browned"],
+                "cancelled_mid_decode": state["cancelled_mid_decode"],
+                "goodput_img_per_s": state["goodput_img_per_s"],
+            })
         elif self.path == "/stats":
             self._reply(200, engine.stats())
         else:
@@ -80,14 +123,26 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/generate":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
+        engine = self.server.engine
+        chaos = engine.chaos
+        # one stable channel per seam: the per-channel call index keeps
+        # decisions seed-reproducible given the same connection ORDER
+        # (keying on the client's ephemeral port would re-roll every
+        # run and break replayability of a soak failure)
+        conn_key = "http"
+        if chaos is not None:
+            chaos.on_client_recv(conn_key)     # the slow/stalled client
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             tokens = self._tokens_from(body)
-            sampling = self._sampling_from(
-                body, self.server.engine.default_sampling)
+            sampling = self._sampling_from(body, engine.default_sampling)
             n_images = int(body.get("n_images", 1))
             seed = int(body.get("seed", 0))
+            lane = body.get("lane", LANES[0])
+            deadline_s = body.get("deadline_s")
+            if deadline_s is not None:
+                deadline_s = float(deadline_s)
             if not (1 <= n_images <= 64):
                 raise ValueError(f"n_images must be in [1, 64], "
                                  f"got {n_images}")
@@ -97,38 +152,127 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": str(e)})
             return
 
+        # a request accepted while brownout is engaged is SERVED UNDER
+        # BROWNOUT whether or not its image count needed trimming (the
+        # pixel stage degrades it either way): the reply marker and the
+        # browned counter cover both, and the counter lands only once
+        # the submits succeed — a trimmed-then-rejected request was
+        # never served degraded and must not skew placement telemetry
+        browned = engine.brownout_active
+        if browned:
+            # fewer CLIP candidates, same caption, same parity for the
+            # images that ARE produced
+            n_images = min(n_images, engine.serving.brownout_max_images)
+
+        handles = []
         try:
-            handles = [self.server.engine.submit(
-                tokens, np.asarray(jax.random.fold_in(base, i)),
-                sampling=sampling)
-                for i in range(n_images)]
+            for i in range(n_images):
+                handles.append(engine.submit(
+                    tokens, np.asarray(jax.random.fold_in(base, i)),
+                    sampling=sampling, lane=lane, deadline_s=deadline_s))
         except ValueError as e:         # wrong-length token vector /
-            # out-of-range sampling knob
+            # out-of-range sampling knob / bad lane
+            self._cancel_all(handles)
             self._reply(400, {"error": str(e)})
             return
+        except DeadlineShedError as e:  # predicted miss: instant cheap
+            # no — retry against a less-loaded replica (readyz routes)
+            self._cancel_all(handles)
+            self._reply(429, {"error": str(e), "shed": True})
+            return
         except QueueFullError as e:     # backpressure: retry later
+            self._cancel_all(handles)
             self._reply(429, {"error": str(e)})
             return
-        except (EngineStoppedError, RuntimeError) as e:  # stopping/crashed;
-            # NOTE a mid-loop failure discards already-submitted sibling
-            # handles — those images still decode and are dropped (the
-            # engine has no mid-flight cancel yet; ROADMAP serving track)
+        except (EngineStoppedError, RuntimeError) as e:  # stopping/
+            # crashed; already-submitted sibling handles are cancelled
+            # so their slots return to the scheduler (the r8 leak)
+            self._cancel_all(handles)
             self._reply(503, {"error": str(e)})
             return
-        results = []
-        for h in handles:
+        if browned:
+            engine.metrics.record_brownout()
+
+        if chaos is not None and chaos.on_client_send(conn_key):
+            # the half-closed / vanished client: sever our read side so
+            # the disconnect probe below sees EOF — the request's slots
+            # must be reclaimed, not decoded for nobody
             try:
-                payload = h.result(timeout=self.server.request_timeout_s)
-            except TimeoutError as e:
-                self._reply(504, {"error": str(e)})
-                return
-            except RuntimeError as e:   # pixel-stage failure / cancelled:
-                # a deterministic server error, NOT a timeout — retrying
-                # it verbatim would just duplicate full-decode work
-                self._reply(500, {"error": str(e)})
-                return
-            results.append(self._result_row(payload))
-        self._reply(200, {"seed": seed, "results": results})
+                self.connection.shutdown(socket.SHUT_RD)
+            except OSError:
+                pass
+
+        deadline = time.monotonic() + self.server.request_timeout_s
+        results = []
+        try:
+            for h in handles:
+                payload = self._await_result(h, deadline)
+                results.append(self._result_row(payload))
+        except TimeoutError as e:
+            # the satellite fix: a timed-out request USED to keep
+            # decoding (the front-end returned 504 and leaked the slot
+            # for the full decode) — now every sibling is cancelled and
+            # the slots return to the scheduler within one boundary
+            self._cancel_all(handles)
+            self._reply(504, {"error": str(e)})
+            return
+        except _ClientGone:
+            self._cancel_all(handles)
+            logger.info("client %s vanished mid-wait; cancelled %d "
+                        "in-flight request(s)", conn_key, len(handles))
+            self.close_connection = True
+            return
+        except DeadlineShedError as e:
+            # shed while queued (the handle's payload carried the typed
+            # shed marker): same contract as the submit-time shed
+            self._cancel_all(handles)
+            self._reply(429, {"error": str(e), "shed": True})
+            return
+        except RuntimeError as e:
+            self._cancel_all(handles)   # siblings must not keep decoding
+            # pixel-stage failure / cancelled: a deterministic server
+            # error, NOT a timeout — retrying it verbatim would just
+            # duplicate full-decode work
+            self._reply(500, {"error": str(e)})
+            return
+        reply = {"seed": seed, "results": results}
+        if browned:
+            reply["brownout"] = True
+        self._reply(200, reply)
+
+    def _await_result(self, handle, deadline: float) -> dict:
+        """Block on one handle with a disconnect probe: a client that
+        hung up must free its slots now, not at request_timeout_s."""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"request {handle.request_id} not done within "
+                    f"{self.server.request_timeout_s}s")
+            if handle.wait(min(0.1, remaining)):
+                return handle.result(timeout=0)
+            if self._client_vanished():
+                raise _ClientGone()
+
+    def _client_vanished(self) -> bool:
+        """EOF probe on the connection: readable + empty peek means the
+        peer closed (or half-closed) its end while we decode for it."""
+        try:
+            readable, _, _ = select.select([self.connection], [], [], 0)
+            if not readable:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _cancel_all(self, handles) -> None:
+        """Cancel every outstanding sibling of a failed/abandoned
+        request (idempotent: resolved handles are skipped by the
+        engine's first-claim discipline)."""
+        engine = self.server.engine
+        for h in handles:
+            engine.cancel(h.request_id,
+                          reason="cancelled: client gone or timed out")
 
     @staticmethod
     def _result_row(payload: dict) -> dict:

@@ -389,6 +389,71 @@ def exclusive(rng, traced):
     return jax.random.uniform(rng, ())       # exclusive, no reuse
 """,
     ),
+    # r12 serving-overload shapes: the cancel/shed paths ride the same
+    # rule families — pin the hazardous variants of each new shape
+    (
+        "unchecked-pool-future",
+        "dalle_tpu/serving/fake_cancel.py",
+        """
+import concurrent.futures
+def cancel_all(engine, rids):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(engine.cancel, r) for r in rids]
+        concurrent.futures.wait(futs)   # a failed cancel vanishes
+""",
+        """
+import concurrent.futures
+def cancel_all(engine, rids):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(engine.cancel, r) for r in rids]
+        return [f.result() for f in futs]   # surfaced per cancel
+""",
+    ),
+    (
+        "host-sync-in-hot-loop",
+        "dalle_tpu/serving/fake_shed.py",
+        """
+def shed_expired(state, queue, now, service):
+    while queue:
+        pend = queue[0]
+        pos = int(state.pos[0])            # device pull per iteration
+        if pos > 0 and now + service > pend.deadline:
+            queue.pop(0)
+        else:
+            break
+""",
+        """
+def shed_expired(pos_host, queue, now, service):
+    while queue:
+        pend = queue[0]                     # host mirror + host clocks:
+        if pos_host[0] > 0 and now + service > pend.deadline:
+            queue.pop(0)                    # no device round-trip
+        else:
+            break
+""",
+    ),
+    (
+        "use-after-donate",
+        "dalle_tpu/fake_release.py",
+        """
+import jax
+def release(state, slots):
+    return state
+_rel = jax.jit(release, donate_argnums=0)
+def cancel_slots(state, slots):
+    _rel(state, slots)               # donated, never rebound...
+    return state.pos                 # ...then a read through the corpse
+""",
+        """
+import jax
+def release(state, slots):
+    return state
+_rel = jax.jit(release, donate_argnums=0)
+def cancel_slots(state, slots):
+    state = _rel(state, slots)       # rebind: the sanctioned shape
+    return state.pos
+""",
+    ),
     (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
