@@ -324,6 +324,36 @@ class CollabConfig:
     delay_optimizer_step: bool = True  # task.py:129
     reuse_grad_buffers: bool = True    # task.py:133
     metrics_expiration: float = 600.0  # statistics_expiration, arguments.py:129-131
+    # --- Byzantine defense (swarm/screening.py + swarm/health.py;
+    # CHAOS.md "Defense in depth"). Signatures and strict parsing stop
+    # forged/malformed traffic; these knobs govern the CONTENT layer:
+    # screening of valid-but-wrong gradients, the sender-weight clamp,
+    # and gossiped signed strike receipts.
+    # Norm/cosine outlier screening of scatter contributions at each
+    # part owner (drop/keep, never reweight — surviving rounds stay
+    # bit-identical to an honest-only round). Auto-skipped below
+    # screen_min_senders weighted contributors (small swarms keep the
+    # pre-screening semantics byte-for-byte).
+    screen_gradients: bool = True
+    screen_min_senders: int = 4
+    # never drop a majority (see screening.ScreenPolicy for the
+    # calibration rationale on every threshold)
+    screen_max_drop_frac: float = 0.49
+    screen_norm_tolerance: float = 8.0
+    screen_cosine_floor: float = -0.5
+    # Clamp on sender-supplied frame weights (a single signed frame
+    # claiming weight=1e9 otherwise drowns the swarm with no value
+    # screen tripping): claims outside [0, max_peer_weight] are dropped
+    # with an attributable strike. None -> target_batch_size (no single
+    # peer can legitimately carry more than the whole swarm's target);
+    # 0 disables the clamp.
+    max_peer_weight: "float | None" = None
+    # Gossip attributable strikes as Ed25519-signed receipts under
+    # {run_id}_strikes and fold verified remote receipts into the local
+    # ledger (bounded influence: no issuer veto, and remote evidence
+    # alone can never convict — health.py). Off = ledger stays local.
+    gossip_strikes: bool = True
+    strike_gossip_period: float = 5.0
     # Deterministic fault injection (swarm/chaos.py, CHAOS.md): a
     # FaultPlan as inline JSON ('{...}') or a path to a JSON file. The
     # plan wraps this peer's DHT transport with seeded message

@@ -22,6 +22,15 @@ def __getattr__(name):
     if name == "ProgressTracker":
         from dalle_tpu.swarm.progress import ProgressTracker
         return ProgressTracker
+    if name == "GradientScreen":
+        from dalle_tpu.swarm.screening import GradientScreen
+        return GradientScreen
+    if name == "ScreenPolicy":
+        from dalle_tpu.swarm.screening import ScreenPolicy
+        return ScreenPolicy
+    if name == "StrikeGossip":
+        from dalle_tpu.swarm.health import StrikeGossip
+        return StrikeGossip
     raise AttributeError(name)
 
 
@@ -29,5 +38,5 @@ __all__ = [
     "DHT", "Identity", "RecordValidatorBase", "SchemaValidator",
     "SignatureValidator", "ValueWithExpiration", "get_dht_time", "key_hash",
     "owner_public_key", "strip_owner", "CollaborativeOptimizer",
-    "ProgressTracker",
+    "ProgressTracker", "GradientScreen", "ScreenPolicy", "StrikeGossip",
 ]

@@ -174,7 +174,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   report: Optional[dict] = None,
                   chunk_elems: int = CHUNK_ELEMS,
                   codec_backend: str = compression.HOST_BACKEND,
-                  ledger=None) -> List[np.ndarray]:
+                  ledger=None,
+                  screen=None,
+                  max_peer_weight: Optional[float] = None
+                  ) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
     ``report`` (optional dict) receives ``{"complete": bool}``: True iff
@@ -209,6 +212,44 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     alike — is AEAD-wrapped with it (crypto.py), so gradients are opaque to
     anyone outside the round's membership.
 
+    ``screen`` (optional :class:`~dalle_tpu.swarm.screening
+    .GradientScreen`) enables Byzantine content screening on this
+    peer's part: when the weighted-sender roster is large enough
+    (``ScreenPolicy.min_senders``), fully-delivered contributions are
+    BUFFERED through the reduce phase instead of streamed into the
+    accumulator, then norm/cosine-screened against the leave-one-out
+    aggregate; outliers are hard-DROPPED (never reweighted) with the
+    same weight renormalization as a corrupt ban, an attributable
+    ``screen-outlier`` ledger strike, and ``report["screened_senders"]``
+    naming them. Costs one extra part-sized buffer per live sender
+    while the round is in flight. Below ``min_senders`` (and always
+    when ``screen`` is None) the original streaming accumulation runs
+    unmodified — small swarms keep the pre-screening semantics
+    byte-for-byte, because with 2-3 senders a leave-one-out "consensus"
+    is one peer's word against another's. A round whose ROSTER cleared
+    the quorum but whose DELIVERIES did not (churn, or a roster split
+    while offenders are penalized at different peers) is stricter
+    still: the part is WITHHELD (dead-owner elasticity — members keep
+    local values) rather than averaged unscreened, because an
+    under-delivered round is exactly the window a colluding minority
+    could otherwise slip tampered data through.
+
+    ``max_peer_weight`` (optional) clamps the sender-supplied frame
+    weight: a signed frame claiming a weight outside ``[0,
+    max_peer_weight]`` (or a non-finite one) has its sender's whole
+    contribution dropped with an attributable ``weight-overclaim``
+    strike — without it, one frame claiming ``weight=1e9`` drowns every
+    honest contribution without any *value* screen tripping. The
+    caller's own ``weight`` is clamped to the same bound (a buggy local
+    accumulator must not make this peer the over-claimer).
+
+    When the transport is chaos-wrapped with an active ``byzantine``
+    plan (swarm/chaos.py), the wrapper's ``tamper_contribution`` hook
+    rewrites this peer's OWN tensors/claimed weight before flatten and
+    signing — attacks are injected above the signature so the wire
+    carries validly-signed wrong data, which is exactly what the screen
+    exists to catch.
+
     ``codec_backend="device"`` runs the u8/f16 wire codec as jitted
     device programs (swarm/device_codec.py): ``tensors`` may be jax
     device arrays (flattened on device, no per-leaf host pull), each
@@ -224,15 +265,41 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     codec_mod = compression.backend_module(codec_backend)
     use_device = codec_mod is not compression
     device_codec = codec_mod if use_device else None
+    if max_peer_weight is not None and not (0.0 <= weight
+                                            <= max_peer_weight):
+        # self-clamp: a buggy caller claiming an absurd local weight
+        # would earn this peer weight-overclaim strikes at every honest
+        # part owner — clamp here and say so
+        logger.warning(
+            "allreduce: local weight %r outside [0, %r] — clamped "
+            "(receivers drop over-claiming senders outright)",
+            weight, max_peer_weight)
+        weight = min(max(weight, 0.0), max_peer_weight) \
+            if np.isfinite(weight) else max_peer_weight
+    # Byzantine injection seam (swarm/chaos.py), AFTER the self-clamp:
+    # an active byzantine op rewrites this peer's own contribution
+    # before flatten and signing, so the wire carries validly-signed
+    # wrong data. frame_weight is the weight claimed on scatter frames
+    # — a weight_inflate op's claim deliberately bypasses the clamp
+    # (it exists to exercise the receivers' check); the local
+    # accumulate keeps the honest ``weight`` either way.
+    tamper = getattr(dht, "tamper_contribution", None)
+    frame_weight = weight
+    if tamper is not None:
+        tensors, frame_weight = tamper(epoch, tensors, weight)
     phases: Dict[str, float] = {}
     corrupt_senders: List[str] = []
     timeout_senders: List[str] = []
+    screened_senders: List[str] = []
+    overweight_senders: List[str] = []
     struck: set = set()  # (peer_id, reason) pairs already sent to the ledger
     if report is not None:
         report["complete"] = True  # falsified below on any missing chunk
         report["phases"] = phases  # wall time per protocol phase
         report["corrupt_senders"] = corrupt_senders
         report["timeout_senders"] = timeout_senders
+        report["screened_senders"] = screened_senders
+        report["overweight_senders"] = overweight_senders
 
     def ban_peer(peer_id: str, reason: str, strike: bool = True) -> None:
         """Cross-round memory of an in-round ban: one ledger strike per
@@ -242,8 +309,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         when the failure is unattributable (a round where NOTHING
         arrived from several peers points at the local node, and
         striking every honest sender would self-isolate it)."""
-        sink = (corrupt_senders if reason == "corrupt-chunk"
-                else timeout_senders)
+        sink = {"corrupt-chunk": corrupt_senders,
+                "screen-outlier": screened_senders,
+                "weight-overclaim": overweight_senders} \
+            .get(reason, timeout_senders)
         if peer_id not in sink:
             sink.append(peer_id)
         # the report sinks dedup per (peer, phase-family) but strikes
@@ -345,8 +414,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             src = flat_dev if use_device else flat
             payload = codec_mod.compress(src[lo + clo:lo + chi], c)
         body = _make_frame(dht.identity, ctx, group.group_hash,
-                           group.my_index, weight, nelem, c, payload,
-                           chunk=ci, n_chunks=n_chunks)
+                           group.my_index, frame_weight, nelem, c,
+                           payload, chunk=ci, n_chunks=n_chunks)
         wire_body = maybe_encrypt(gkey, body)
         return addr, tag, wire_body, send_raw(addr, tag, wire_body)
 
@@ -379,13 +448,30 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             mine = flat[lo:hi]
             n_mine = hi - lo
             my_chunks = _chunk_slices(n_mine, chunk_elems)
-            acc = mine * weight
-            total_w = weight
             # weight-0 members contribute nothing (and send nothing):
             # never wait on them
             expected = {i for i, m in enumerate(group.members)
                         if m.peer_id != me.peer_id and m.weight > 0}
             n_expected0 = len(expected)
+            # Byzantine screening engages only when the weighted roster
+            # (self included) is big enough for a leave-one-out
+            # consensus; otherwise — and whenever screening is off —
+            # the pre-screening streaming accumulation below runs
+            # UNMODIFIED, byte-for-byte (small-swarm transparency).
+            n_weighted = n_expected0 + (1 if weight > 0 else 0)
+            screen_active = (screen is not None
+                             and n_weighted >= screen.policy.min_senders)
+            # screened mode BUFFERS fully-delivered contributions (one
+            # part-sized array per live sender) and accumulates after
+            # the verdict, in sender order — same f32 multiply-add
+            # sequence as the streaming path over the survivors
+            complete: Dict[int, Tuple[float, np.ndarray]] = {}
+            if screen_active:
+                acc = None  # summed after the screen verdict
+                total_w = 0.0
+            else:
+                acc = mine * weight
+                total_w = weight
             # a sender's contribution applies ATOMICALLY once all its
             # chunks arrived (partial senders are dropped wholesale, the
             # same elasticity semantics as the unchunked protocol)
@@ -440,6 +526,29 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         "this receiver can never apply",
                         group.members[sender].peer_id[:16])
                     return True  # the roster shrank: that is progress
+                if max_peer_weight is not None and not (
+                        0.0 <= w <= max_peer_weight):
+                    # a VALIDLY SIGNED frame claiming an absurd (or
+                    # non-finite) weight: without this clamp a single
+                    # weight=1e9 claim drowns the swarm while every
+                    # value-level screen stays quiet (the data can be
+                    # perfectly honest). The signature makes the claim
+                    # attributable — drop the whole contribution and
+                    # strike, exactly like authenticated garbage.
+                    expected.discard(sender)
+                    bufs.pop(sender, None)
+                    got.pop(sender, None)
+                    banned_reduce += 1
+                    ban_peer(group.members[sender].peer_id,
+                             "weight-overclaim")
+                    if report is not None:
+                        report["complete"] = False
+                    logger.warning(
+                        "allreduce: banned sender %s for claiming "
+                        "weight %r outside [0, %r] (contribution "
+                        "dropped)", group.members[sender].peer_id[:16],
+                        w, max_peer_weight)
+                    return True
                 if sender not in bufs:
                     bufs[sender] = np.zeros(n_mine, np.float32)
                     got[sender] = set()
@@ -449,9 +558,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 bufs[sender][clo:chi] = data
                 got[sender].add(ci)
                 if len(got[sender]) == len(my_chunks):
-                    acc += bufs.pop(sender) * w
+                    if screen_active:
+                        # buffer for the post-drain screen; weight and
+                        # accumulation are deferred to the verdict
+                        complete[sender] = (w, bufs.pop(sender))
+                    else:
+                        acc += bufs.pop(sender) * w
+                        total_w += w
                     got.pop(sender)
-                    total_w += w
                     expected.discard(sender)
                 return True
 
@@ -506,6 +620,62 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                          strike=blame_remote)
             if expected and report is not None:
                 report["complete"] = False
+            if screen_active:
+                # every fully-delivered contribution (self included) is
+                # screened together: the screen's verdict is drop/keep
+                # only, so the surviving sum below is bit-identical to
+                # an honest-only round over the same survivors
+                if weight > 0:
+                    complete[group.my_index] = (weight, mine)
+                verdict = screen.screen(complete)
+                for k in sorted(verdict.dropped):
+                    ban_peer(group.members[k].peer_id, "screen-outlier")
+                    if report is not None:
+                        report["complete"] = False
+                    logger.warning(
+                        "allreduce: screened out sender %s (%s) — "
+                        "validly signed but content-outlying "
+                        "contribution dropped, weight renormalized "
+                        "out%s", group.members[k].peer_id[:16],
+                        verdict.dropped[k],
+                        " [own contribution]"
+                        if k == group.my_index else "")
+                if verdict.skipped:
+                    # the ROSTER promised a screenable quorum
+                    # (screen_active) but actual deliveries fell below
+                    # min_senders — churn, or a mid-epoch roster split
+                    # while offenders are being penalized at different
+                    # peers. The screen cannot certify ANYTHING about
+                    # this under-delivered set, and averaging it
+                    # unscreened is exactly the window an attacker
+                    # needs (observed in the byzantine soak: a
+                    # transition epoch landed tampered data through
+                    # the skip). WITHHOLD the part — the dead-owner
+                    # elasticity path: every member keeps its local
+                    # values and the round reports incomplete.
+                    acc = np.zeros(n_mine, np.float32)
+                    total_w = 0.0
+                    if report is not None:
+                        report["complete"] = False
+                    logger.warning(
+                        "allreduce: %d/%d contributions delivered — "
+                        "below the screen quorum (%d); withholding "
+                        "this part (members keep local values)",
+                        len(complete), n_weighted,
+                        screen.policy.min_senders)
+                elif weight > 0 and group.my_index not in verdict.dropped:
+                    acc = mine * weight
+                    total_w = weight
+                else:
+                    acc = np.zeros(n_mine, np.float32)
+                    total_w = 0.0
+                if not verdict.skipped:
+                    for k in sorted(complete):
+                        if k == group.my_index or k in verdict.dropped:
+                            continue
+                        w_k, seg = complete[k]
+                        acc += seg * w_k
+                        total_w += w_k
             if report is not None:
                 # contributors whose full data reached this part (self
                 # included when weight > 0) — an assistant uses this to
@@ -525,7 +695,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # round looks complete. Withhold the part: receivers
                 # fall back to their local values and flag the round
                 # incomplete, the same dead-owner elasticity path.
-                # (A weight>0 member always has total_w >= weight > 0.)
+                # (Without screening a weight>0 member always has
+                # total_w >= weight > 0; with it, a round whose every
+                # contribution was screened out — own included — takes
+                # this same withhold path.)
                 averaged_mine = None
             phases["reduce_s"] = round(time.monotonic() - t_built, 3)
 

@@ -44,6 +44,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import math
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -59,6 +60,14 @@ FAULT_OPS = ("send", "recv", "fetch", "store", "get", "post")
 #: an over-aggressive plan must degrade a round, not wedge a thread
 #: past every protocol deadline.
 MAX_INJECTED_SLEEP_S = 5.0
+
+#: byzantine attack kinds a plan may inject. Unlike every transport
+#: fault above, these fire ABOVE the signature: the peer's own
+#: contribution is rewritten before it is flattened and signed, so the
+#: wire carries validly-signed wrong data — the attack class the
+#: content screen (swarm/screening.py) exists to catch, invisible to
+#: signature checks and strict parsing by construction.
+BYZANTINE_KINDS = ("sign_flip", "scale", "garbage", "weight_inflate")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +151,54 @@ class Blackout:
 
 
 @dataclasses.dataclass(frozen=True)
+class ByzantineOp:
+    """One byzantine clause: make this peer contribute valid-but-wrong
+    data for epochs in ``[start_epoch, end_epoch)``.
+
+    - ``sign_flip`` — negate the gradient (``factor`` unused);
+    - ``scale`` — multiply it by ``factor`` (e.g. -10.0);
+    - ``garbage`` — replace it with seeded N(0, factor^2) noise drawn
+      deterministically from (plan.seed, epoch), then signed with the
+      attacker's REAL identity like any honest contribution;
+    - ``weight_inflate`` — claim ``factor`` as the frame weight on the
+      wire (the classic "my batch was 1e9 samples"); the data itself
+      stays honest, so only the weight clamp can catch it.
+
+    The first active op wins (FaultRule precedence semantics).
+    """
+
+    kind: str
+    factor: float = 10.0
+    start_epoch: int = 0
+    end_epoch: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in BYZANTINE_KINDS:
+            raise ValueError(
+                f"unknown byzantine kind {self.kind!r}; expected one of "
+                f"{BYZANTINE_KINDS}")
+        if not math.isfinite(self.factor):
+            raise ValueError("byzantine factor must be finite")
+        if self.kind == "weight_inflate" and self.factor <= 0:
+            raise ValueError(
+                f"weight_inflate factor must be > 0 (it is the claimed "
+                f"frame weight), got {self.factor!r}")
+        if self.kind == "scale" and self.factor == 0:
+            raise ValueError("scale factor 0 is a zero contribution, "
+                             "not an attack; use garbage instead")
+        if self.start_epoch < 0 or (self.end_epoch is not None
+                                    and self.end_epoch < self.start_epoch):
+            raise ValueError(
+                "byzantine window must satisfy 0 <= start_epoch <= "
+                f"end_epoch, got [{self.start_epoch!r}, "
+                f"{self.end_epoch!r})")
+
+    def active(self, epoch: int) -> bool:
+        return epoch >= self.start_epoch and (
+            self.end_epoch is None or epoch < self.end_epoch)
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """Declarative, seeded fault schedule for one peer's transport."""
 
@@ -151,10 +208,13 @@ class FaultPlan:
     #: the peer's transport self-destructs when the training loop
     #: reports this epoch (optimizer calls ``note_epoch``); None = never
     crash_at_epoch: Optional[int] = None
+    #: byzantine data attacks (valid-but-wrong contributions), injected
+    #: at the contribution seam rather than the transport seam
+    byzantine: Tuple[ByzantineOp, ...] = ()
 
     @property
     def enabled(self) -> bool:
-        return bool(self.rules or self.blackouts
+        return bool(self.rules or self.blackouts or self.byzantine
                     or self.crash_at_epoch is not None)
 
     # -- (de)serialization -------------------------------------------------
@@ -204,10 +264,23 @@ class FaultPlan:
             Blackout(start_s=float(b["start_s"]), end_s=float(b["end_s"]),
                      peers=tuple(b.get("peers", ())))
             for b in obj.get("blackouts", ()))
+        byz = []
+        for z in obj.get("byzantine", ()):
+            cls._reject_unknown_keys(z, ByzantineOp, "byzantine op")
+            if "kind" not in z:
+                raise ValueError("byzantine op needs a 'kind' "
+                                 f"(one of {BYZANTINE_KINDS})")
+            byz.append(ByzantineOp(
+                kind=str(z["kind"]),
+                factor=float(z.get("factor", 10.0)),
+                start_epoch=int(z.get("start_epoch", 0)),
+                end_epoch=(None if z.get("end_epoch") is None
+                           else int(z["end_epoch"]))))
         crash = obj.get("crash_at_epoch")
         return cls(seed=int(obj.get("seed", 0)), rules=tuple(rules),
                    blackouts=blackouts,
-                   crash_at_epoch=None if crash is None else int(crash))
+                   crash_at_epoch=None if crash is None else int(crash),
+                   byzantine=tuple(byz))
 
     @classmethod
     def from_json(cls, text: str) -> "FaultPlan":
@@ -282,6 +355,45 @@ class ChaosDHT:
             self.kill()
             return True
         return False
+
+    def byzantine_op(self, epoch: int) -> Optional[ByzantineOp]:
+        """The first byzantine clause active at ``epoch``, or None."""
+        for op in self.plan.byzantine:
+            if op.active(epoch):
+                return op
+        return None
+
+    def tamper_contribution(self, epoch: int, tensors, weight: float):
+        """The byzantine injection seam, called by ``run_allreduce``
+        BEFORE flatten and signing: returns (tensors, frame_weight) —
+        possibly rewritten — so the wire carries this peer's
+        valid-but-wrong contribution under its real identity. The
+        garbage draw is deterministic in (plan.seed, epoch), keeping
+        soak runs seed-reproducible. A plan with no byzantine clauses
+        (or none active this epoch) returns the inputs untouched, so
+        an inert wrapper stays bit-transparent."""
+        op = self.byzantine_op(epoch)
+        if op is None:
+            return tensors, weight
+        import numpy as np
+        self._count(f"byz_{op.kind}")
+        logger.warning("chaos: byzantine %s active at epoch %d "
+                       "(factor=%r)", op.kind, epoch, op.factor)
+        if op.kind == "weight_inflate":
+            return tensors, float(op.factor)
+        if op.kind == "sign_flip":
+            return [np.negative(np.asarray(t, np.float32))
+                    for t in tensors], weight
+        if op.kind == "scale":
+            return [np.asarray(t, np.float32) * np.float32(op.factor)
+                    for t in tensors], weight
+        # garbage: seeded, epoch-varying noise at |factor| magnitude
+        digest = hashlib.sha256(
+            f"{self.plan.seed}|byz-garbage|{epoch}".encode()).digest()
+        rng = np.random.RandomState(
+            int.from_bytes(digest[:4], "big"))
+        return [rng.standard_normal(np.shape(t)).astype(np.float32)
+                * np.float32(abs(op.factor)) for t in tensors], weight
 
     # -- deterministic decisions -------------------------------------------
 
@@ -525,7 +637,8 @@ def maybe_wrap(dht, chaos_plan: Optional[str]):
         return dht
     logger.warning(
         "CHAOS ENABLED: transport faults injected per plan (seed=%d, "
-        "%d rule(s), %d blackout(s), crash_at_epoch=%s) — this peer is "
-        "deliberately unreliable", plan.seed, len(plan.rules),
-        len(plan.blackouts), plan.crash_at_epoch)
+        "%d rule(s), %d blackout(s), %d byzantine op(s), "
+        "crash_at_epoch=%s) — this peer is deliberately unreliable",
+        plan.seed, len(plan.rules), len(plan.blackouts),
+        len(plan.byzantine), plan.crash_at_epoch)
     return ChaosDHT(dht, plan)

@@ -1,14 +1,42 @@
-"""Peer-health ledger: strike counts with epoch decay.
+"""Peer-health ledger: strike counts with epoch decay + gossiped
+signed strike receipts.
 
 The all-reduce already bans a misbehaving sender *within* a round
-(corrupt chunks, no-progress timeouts — ``allreduce.py``), but until
-now that knowledge died with the round: the same flapping or hostile
-peer re-entered matchmaking the very next epoch and cost every survivor
-another ban timeout. The ledger is the cross-round memory: bans feed
-strikes, strikes decay after a few epochs, and repeat offenders are
-down-ranked — dropped from this peer's matchmaking candidate view
+(corrupt chunks, no-progress timeouts, content screening —
+``allreduce.py`` / ``screening.py``), but until now that knowledge died
+with the round: the same flapping or hostile peer re-entered
+matchmaking the very next epoch and cost every survivor another ban
+timeout. The ledger is the cross-round memory: bans feed strikes,
+strikes decay after a few epochs, and repeat offenders are down-ranked
+— dropped from this peer's matchmaking candidate view
 (``matchmaking._read_candidates``) and ignored by the progress
 aggregation (``progress.ProgressTracker``) until their strikes age out.
+
+Two evidence planes:
+
+- **Local strikes** are this node's own verdicts. They can cross the
+  penalty threshold on their own — the node SAW the offense.
+- **Remote receipts** (:class:`StrikeGossip`) are other peers' signed
+  verdicts, gossiped under a DHT strike prefix. They are folded in
+  with bounded influence: at most ``max_issuer_influence`` per
+  (issuer, offender) — so no single issuer can evict anyone (no veto)
+  — and at most ``max_remote_influence`` total per offender, chosen
+  BELOW the penalty threshold so remote receipts ALONE can never
+  convict: a Sybil flock minting fresh identities to co-sign receipts
+  against an honest peer tips the scale at most to
+  ``max_remote_influence``; conviction still requires local evidence.
+  What gossip buys is speed: one honest victim's attributable verdict
+  reaches the whole swarm within a gossip period, so a repeat offender
+  is down-ranked swarm-wide within ~2 epochs instead of per-victim —
+  and a fresh joiner inherits the swarm's evidence instead of paying
+  its own ban timeouts to rediscover it.
+
+Only ATTRIBUTABLE reasons gossip (:data:`GOSSIP_REASONS`): a receipt
+is a signed accusation, and the issuer must have held proof (a valid
+signature over bad content) the accused peer cannot disown. Timeout
+strikes never gossip — silence is unattributable (the issuer's own
+inbound path is an equally good explanation), and gossiping it would
+let one badly-connected node spray blame across the swarm.
 
 The ledger is LOCAL knowledge. Peers' ledgers can disagree (one peer
 saw the corrupt chunk, another didn't) and the matchmaking roster can
@@ -17,14 +45,18 @@ contract: followers prefer the leader's signed roster, and residual
 disagreement falls out through group-hash mismatch drops. Down-ranking
 is a *bias*, not a consensus verdict.
 
-Thread-safety: strikes arrive from wire/round worker threads while the
-training thread reads penalties — every mutation holds the lock.
+Thread-safety: strikes arrive from wire/round worker threads and the
+gossip worker while the training thread reads penalties — every
+mutation holds the lock.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 #: default strike weights by reason (anything else counts 1.0).
 #: "confirm-timeout" is deliberately sub-threshold on its own: a
@@ -34,45 +66,102 @@ from typing import Dict, List, Tuple
 #: default penalty threshold (3.0) without corroborating allreduce
 #: evidence — unattributable signals tip the scale, they don't convict.
 STRIKE_WEIGHTS = {
-    "corrupt-chunk": 2.0,     # affirmatively malformed traffic
-    "reduce-timeout": 1.0,    # never delivered its contribution
-    "gather-timeout": 1.0,    # owned a part and never served it
-    "confirm-timeout": 0.5,   # announced leader, never confirmed
+    "corrupt-chunk": 2.0,       # affirmatively malformed traffic
+    "screen-outlier": 2.0,      # validly signed, content-outlying data
+    "weight-overclaim": 2.0,    # validly signed absurd frame weight
+    "progress-overclaim": 1.0,  # absurd signed progress claim
+    "reduce-timeout": 1.0,      # never delivered its contribution
+    "gather-timeout": 1.0,      # owned a part and never served it
+    "confirm-timeout": 0.5,     # announced leader, never confirmed
 }
+
+#: reasons whose strikes may be gossiped as signed receipts: every one
+#: is an AUTHENTICATED verdict — the issuer verified a valid signature
+#: over provably-wrong content, so the receipt is an accusation the
+#: accused produced the evidence for. Timeout/no-show reasons are
+#: deliberately absent (see module docstring).
+GOSSIP_REASONS = frozenset({
+    "corrupt-chunk", "screen-outlier", "weight-overclaim",
+    "progress-overclaim"})
+
+#: receipts, events and seen-sets are bounded everywhere: gossip is an
+#: attacker-writable plane and must not become a memory amplifier
+_MAX_EVENTS = 4096
+_MAX_SEEN = 8192
 
 
 class PeerHealthLedger:
-    """Decaying per-peer strike counts.
+    """Decaying per-peer strike counts, local + bounded remote.
 
     A strike is recorded with the epoch it happened in; only strikes
     from the last ``ttl_epochs`` epochs count toward the penalty score.
     ``penalized(pid)`` is True while the live score is at or above
     ``penalty_threshold`` — "down-ranked for the next few epochs".
+
+    ``score(pid)`` = live local strikes + remote evidence, where remote
+    evidence is capped per issuer (``max_issuer_influence``) and in
+    total (``max_remote_influence`` — default strictly below the
+    penalty threshold, see the module docstring's no-veto argument).
     """
 
     def __init__(self, ttl_epochs: int = 3,
                  penalty_threshold: float = 3.0,
-                 max_peers: int = 4096):
+                 max_peers: int = 4096,
+                 max_issuer_influence: float = 1.0,
+                 max_remote_influence: float = 2.0):
         self.ttl_epochs = ttl_epochs
         self.penalty_threshold = penalty_threshold
         self.max_peers = max_peers
+        self.max_issuer_influence = max_issuer_influence
+        self.max_remote_influence = max_remote_influence
         self._lock = threading.Lock()
         self._epoch = 0
         # peer_id -> [(epoch, weight), ...]
         self._strikes: Dict[str, List[Tuple[int, float]]] = {}
+        # peer_id -> issuer_id -> [(epoch, weight), ...]
+        self._remote: Dict[str, Dict[str, List[Tuple[int, float]]]] = {}
+        # (epoch, peer, reason) local gossipable verdicts awaiting
+        # publication (StrikeGossip drains this)
+        self._events: List[Tuple[int, str, str]] = []
 
     # -- writes ------------------------------------------------------------
 
     def strike(self, peer_id: str, reason: str = "",
                weight: float = 0.0) -> None:
-        """Record one offense. ``weight`` 0 looks the reason up in
-        STRIKE_WEIGHTS (unknown reasons count 1.0)."""
+        """Record one LOCAL offense. ``weight`` 0 looks the reason up in
+        STRIKE_WEIGHTS (unknown reasons count 1.0). Attributable
+        reasons (GOSSIP_REASONS) also queue a gossip event."""
         w = weight or STRIKE_WEIGHTS.get(reason, 1.0)
         with self._lock:
             if (peer_id not in self._strikes
                     and len(self._strikes) >= self.max_peers):
                 return  # bound memory against an id-churning flood
             self._strikes.setdefault(peer_id, []).append((self._epoch, w))
+            if reason in GOSSIP_REASONS and len(self._events) < _MAX_EVENTS:
+                self._events.append((self._epoch, peer_id, reason))
+
+    def remote_strike(self, issuer_id: str, peer_id: str, reason: str,
+                      epoch: int, weight: float = 0.0) -> None:
+        """Fold one verified REMOTE receipt in. The receipt's epoch is
+        clamped to this ledger's clock — a forward-dated receipt must
+        not outlive the decay window — and influence caps are applied
+        at read time (``score``), so late caps-config changes apply
+        retroactively."""
+        w = weight or STRIKE_WEIGHTS.get(reason, 1.0)
+        with self._lock:
+            e = min(int(epoch), self._epoch)
+            if e <= self._epoch - self.ttl_epochs:
+                return  # already aged out on arrival
+            if (peer_id not in self._remote
+                    and len(self._remote) >= self.max_peers):
+                return
+            issuers = self._remote.setdefault(peer_id, {})
+            if (issuer_id not in issuers
+                    and len(issuers) >= self.max_peers):
+                return
+            rec = issuers.setdefault(issuer_id, [])
+            if len(rec) < _MAX_EVENTS:
+                rec.append((e, w))
 
     def advance_epoch(self, epoch: int) -> None:
         """Move the decay clock forward (never backward) and prune
@@ -88,23 +177,269 @@ class PeerHealthLedger:
                     self._strikes[pid] = live
                 else:
                     del self._strikes[pid]
+            for pid in list(self._remote):
+                issuers = self._remote[pid]
+                for iid in list(issuers):
+                    live = [(e, w) for e, w in issuers[iid] if e > floor]
+                    if live:
+                        issuers[iid] = live
+                    else:
+                        del issuers[iid]
+                if not issuers:
+                    del self._remote[pid]
+
+    def drain_events(self) -> List[Tuple[int, str, str]]:
+        """Pop the queued gossipable verdicts (StrikeGossip publishes
+        them as signed receipts)."""
+        with self._lock:
+            out, self._events = self._events, []
+            return out
+
+    def requeue_events(self, events: List[Tuple[int, str, str]]) -> None:
+        """Put drained-but-unpublished verdicts back (a failed store —
+        transient DHT outage, blackout — must retry next period, not
+        silently lose the receipt). Bounded like the queue itself."""
+        if not events:
+            return
+        with self._lock:
+            self._events = (list(events) + self._events)[:_MAX_EVENTS]
 
     # -- reads -------------------------------------------------------------
 
+    def _local_score(self, peer_id: str, floor: int) -> float:
+        return sum(w for e, w in self._strikes.get(peer_id, ())
+                   if e > floor)
+
+    def _remote_score(self, peer_id: str, floor: int) -> float:
+        issuers = self._remote.get(peer_id)
+        if not issuers:
+            return 0.0
+        total = 0.0
+        for rec in issuers.values():
+            live = sum(w for e, w in rec if e > floor)
+            total += min(live, self.max_issuer_influence)
+        return min(total, self.max_remote_influence)
+
     def score(self, peer_id: str) -> float:
-        """Live (un-decayed) strike weight for a peer."""
+        """Live (un-decayed) strike weight for a peer: local evidence
+        plus capped remote evidence."""
         with self._lock:
             floor = self._epoch - self.ttl_epochs
-            return sum(w for e, w in self._strikes.get(peer_id, ())
-                       if e > floor)
+            return (self._local_score(peer_id, floor)
+                    + self._remote_score(peer_id, floor))
+
+    def remote_score(self, peer_id: str) -> float:
+        """The (capped) remote-receipt component of ``score`` alone —
+        observability for the byzantine soak's gossip oracle."""
+        with self._lock:
+            floor = self._epoch - self.ttl_epochs
+            return self._remote_score(peer_id, floor)
 
     def penalized(self, peer_id: str) -> bool:
         return self.score(peer_id) >= self.penalty_threshold
 
     def snapshot(self) -> Dict[str, float]:
-        """{peer_id: live score} for logging/metrics."""
+        """{peer_id: live score} for logging/metrics (local + capped
+        remote, same arithmetic as ``score``)."""
         with self._lock:
             floor = self._epoch - self.ttl_epochs
-            out = {pid: sum(w for e, w in rec if e > floor)
-                   for pid, rec in self._strikes.items()}
-            return {pid: s for pid, s in out.items() if s > 0}
+            out = {}
+            for pid in set(self._strikes) | set(self._remote):
+                s = (self._local_score(pid, floor)
+                     + self._remote_score(pid, floor))
+                if s > 0:
+                    out[pid] = s
+            return out
+
+
+# -- signed strike receipts ------------------------------------------------
+
+def _receipt_ctx(prefix: str) -> bytes:
+    """Domain-separation context for receipt signatures: bound to the
+    run prefix so a receipt cannot be replayed into another swarm."""
+    return f"{prefix}:strike-receipt".encode()
+
+
+def strike_key(prefix: str) -> str:
+    """The DHT key receipts gossip under."""
+    return f"{prefix}_strikes"
+
+
+def make_receipt(identity, prefix: str, peer_id: str, reason: str,
+                 epoch: int) -> bytes:
+    """An Ed25519-signed (peer, reason, epoch) verdict from
+    ``identity``. The issuer IS the signing key — receipts carry no
+    separate issuer field to forge."""
+    import msgpack
+
+    from dalle_tpu.swarm.identity import signed_frame
+    payload = msgpack.packb(
+        {"peer": peer_id, "reason": reason, "epoch": int(epoch)},
+        use_bin_type=True)
+    return signed_frame(identity, _receipt_ctx(prefix), b"", payload)
+
+
+def open_receipt(raw: bytes, prefix: str
+                 ) -> Optional[Tuple[str, str, str, int]]:
+    """(issuer_id, peer_id, reason, epoch) iff ``raw`` is a validly
+    signed receipt with a well-formed, gossipable payload; None
+    otherwise. STRICT on content: unknown reasons and malformed ids
+    are rejected outright — the strike plane is attacker-writable and
+    a verifier must never fold a claim it cannot price."""
+    import msgpack
+
+    from dalle_tpu.swarm.identity import open_frame
+    opened = open_frame(bytes(raw), _receipt_ctx(prefix), 0,
+                        expected_pid=None)
+    if opened is None:
+        return None
+    _head, payload, issuer = opened
+    try:
+        obj = msgpack.unpackb(payload, raw=False)
+        peer = str(obj["peer"])
+        reason = str(obj["reason"])
+        epoch = int(obj["epoch"])
+    # rejecting unparseable receipts IS the verifier contract (hostile
+    # writers expected on this plane); logging per record would hand a
+    # flood a log-spam amplifier
+    # graftlint: disable=silent-except
+    except Exception:  # noqa: BLE001 - any parse failure = invalid
+        return None
+    if reason not in GOSSIP_REASONS or epoch < 0:
+        return None
+    if len(peer) != 64 or any(c not in "0123456789abcdef" for c in peer):
+        return None  # peer ids are hex sha256 digests
+    return issuer, peer, reason, epoch
+
+
+class StrikeGossip(threading.Thread):
+    """The gossip worker: publish this node's attributable verdicts as
+    signed receipts, and fold other peers' verified receipts into the
+    local ledger.
+
+    Receipts live under ``{prefix}_strikes`` with one subkey per
+    (issuer, peer, reason, epoch) — the dedup unit: re-publishing the
+    same verdict refreshes its TTL instead of stacking influence, and
+    the fold-side ``_seen`` set makes folding idempotent even when the
+    DHT returns the record on every poll. Verification happens on READ
+    (the store/routing plane is native and validates nothing): the
+    receipt's own Ed25519 signature names the issuer, so forged or
+    tampered receipts drop before they touch the ledger.
+
+    Lifecycle mirrors RendezvousAdvertiser: a daemon worker looping
+    every ``period`` seconds; ``stop()`` signals AND bounded-joins so
+    the owner can tear the DHT down afterwards without racing an
+    in-flight publish. ``step()`` runs one publish+fold synchronously —
+    the deterministic face the tests and the soak drive directly.
+    """
+
+    def __init__(self, dht, ledger: PeerHealthLedger, prefix: str,
+                 period: float = 5.0, receipt_ttl: float = 180.0,
+                 max_fold_per_poll: int = 512):
+        super().__init__(daemon=True, name="strike-gossip")
+        self.dht = dht
+        self.ledger = ledger
+        self.prefix = prefix
+        self.period = period
+        self.receipt_ttl = receipt_ttl
+        self.max_fold_per_poll = max_fold_per_poll
+        self._stop_event = threading.Event()
+        self._seen: set = set()     # (issuer, peer, reason, epoch)
+        self.published = 0          # observability counters
+        self.folded = 0
+
+    # -- one synchronous round (tests / soak drive this directly) ---------
+
+    def publish_once(self) -> int:
+        from dalle_tpu.swarm.dht import get_dht_time
+        n = 0
+        events = self.ledger.drain_events()
+        failed: List[Tuple[int, str, str]] = []
+        for i, (epoch, peer, reason) in enumerate(events):
+            if peer == self.dht.peer_id:
+                continue  # self-verdicts are local bookkeeping only
+            receipt = make_receipt(self.dht.identity, self.prefix,
+                                   peer, reason, epoch)
+            sub = f"{self.dht.peer_id}.{peer}.{reason}.{epoch}"
+            try:
+                ok = self.dht.store(strike_key(self.prefix), sub, receipt,
+                                    expiration_time=get_dht_time()
+                                    + self.receipt_ttl)
+            except Exception:  # noqa: BLE001 - requeued, not lost
+                # the rest of the batch must not be dropped because one
+                # store raised mid-loop: requeue everything unpublished
+                # (this event included), log, and let fold still run
+                self.ledger.requeue_events(
+                    failed + [e for e in events[i:]
+                              if e[1] != self.dht.peer_id])
+                logger.warning("strike receipt store raised; batch "
+                               "requeued for the next period",
+                               exc_info=True)
+                self.published += n
+                return n
+            if ok:
+                n += 1
+            else:
+                # a False store (outage, blackout rule) retries next
+                # period — a one-shot offense's receipt must not vanish
+                failed.append((epoch, peer, reason))
+        if failed:
+            self.ledger.requeue_events(failed)
+        self.published += n
+        return n
+
+    def fold_once(self) -> int:
+        entries = self.dht.get(strike_key(self.prefix)) or {}
+        n = 0
+        for _subkey, item in entries.items():
+            if n >= self.max_fold_per_poll:
+                break  # bounded work per poll under a receipt flood
+            if not isinstance(item.value, (bytes, bytearray)):
+                continue
+            opened = open_receipt(item.value, self.prefix)
+            if opened is None:
+                continue
+            issuer, peer, reason, epoch = opened
+            if issuer == self.dht.peer_id:
+                continue  # our own verdicts are already local strikes
+            if peer == self.dht.peer_id:
+                continue  # never fold accusations against self
+            if peer == issuer:
+                continue  # self-confessions carry no information
+            mark = (issuer, peer, reason, epoch)
+            if mark in self._seen:
+                continue
+            if len(self._seen) >= _MAX_SEEN:
+                self._seen.clear()  # re-folds are idempotent-ish: the
+                # per-issuer influence cap bounds any double count
+            self._seen.add(mark)
+            self.ledger.remote_strike(issuer, peer, reason, epoch)
+            n += 1
+        self.folded += n
+        return n
+
+    def step(self) -> None:
+        self.publish_once()
+        self.fold_once()
+
+    # -- worker lifecycle --------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - gossip must not die
+                logger.warning("strike gossip round failed",
+                               exc_info=True)
+            self._stop_event.wait(max(0.1, self.period))
+
+    def stop(self, join_timeout: Optional[float] = 10.0) -> None:
+        """Signal AND (bounded) join: an in-flight step() touching a
+        torn-down native DHT node is a use-after-free, so the owner
+        must not proceed to DHT.shutdown while this thread may still
+        be inside a publish/fold. ``join_timeout=None`` skips the join
+        (signal-only)."""
+        self._stop_event.set()
+        if join_timeout is not None and self.is_alive() \
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)

@@ -145,15 +145,41 @@ class CollaborativeOptimizer:
         # Peer-health ledger (swarm/health.py): allreduce bans feed
         # strikes; matchmaking and progress aggregation down-rank repeat
         # offenders until the strikes decay. Local knowledge only.
+        # Byzantine defense wiring (CHAOS.md "Defense in depth"):
+        # content screening + the frame-weight clamp ride every
+        # allreduce call below; the gossip worker publishes/folds
+        # signed strike receipts until shutdown() reaps it.
+        self._gossip = None
         if self.role.swarm_enabled:
-            from dalle_tpu.swarm.health import PeerHealthLedger
+            from dalle_tpu.swarm.health import PeerHealthLedger, StrikeGossip
             self.ledger = PeerHealthLedger()
             self.tracker = ProgressTracker(
                 dht, cfg.run_id, cfg.target_batch_size,
                 client_mode=client_mode, ledger=self.ledger)
+            if getattr(cfg, "screen_gradients", False):
+                from dalle_tpu.swarm.screening import (GradientScreen,
+                                                       ScreenPolicy)
+                self._screen = GradientScreen(ScreenPolicy(
+                    min_senders=cfg.screen_min_senders,
+                    max_drop_frac=cfg.screen_max_drop_frac,
+                    norm_tolerance=cfg.screen_norm_tolerance,
+                    cosine_floor=cfg.screen_cosine_floor))
+            else:
+                self._screen = None
+            mpw = getattr(cfg, "max_peer_weight", None)
+            if mpw is None:
+                mpw = float(cfg.target_batch_size)
+            self._max_peer_weight = mpw if mpw > 0 else None
+            if getattr(cfg, "gossip_strikes", False):
+                self._gossip = StrikeGossip(
+                    dht, self.ledger, cfg.run_id,
+                    period=cfg.strike_gossip_period)
+                self._gossip.start()
         else:
             self.ledger = None
             self.tracker = _FollowerTracker()
+            self._screen = None
+            self._max_peer_weight = None
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
         # Wire-codec execution backend (swarm/device_codec.py): "device"
@@ -409,7 +435,8 @@ class CollaborativeOptimizer:
                         allreduce_timeout=budget, codec=self._grad_codec,
                         adaptive_threshold=self.cfg.size_adaptive_threshold,
                         codec_backend=self._codec_backend,
-                        ledger=self.ledger)
+                        ledger=self.ledger, screen=self._screen,
+                        max_peer_weight=self._max_peer_weight)
                 pending.result = averaged
                 pending.timings["allreduce_s"] = round(
                     time.monotonic() - t_match, 4)
@@ -577,7 +604,9 @@ class CollaborativeOptimizer:
                     self.local_epoch, grads_local, weight=weight,
                     allreduce_timeout=budget, codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
-                    codec_backend=self._codec_backend, ledger=self.ledger)
+                    codec_backend=self._codec_backend, ledger=self.ledger,
+                    screen=self._screen,
+                    max_peer_weight=self._max_peer_weight)
         else:
             # alone this epoch: with a deferred pull the grads never left
             # the device — they flow straight into the jitted apply
@@ -666,7 +695,8 @@ class CollaborativeOptimizer:
                     codec=self._grad_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
                     report=rep, codec_backend=self._codec_backend,
-                    ledger=self.ledger)
+                    ledger=self.ledger, screen=self._screen,
+                    max_peer_weight=self._max_peer_weight)
                 if not rep.get("complete", False):
                     ok = 0
             if sharded:
@@ -802,7 +832,8 @@ class CollaborativeOptimizer:
                     codec=self._state_codec,
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
                     codec_backend=self._codec_backend,
-                    ledger=self.ledger)
+                    ledger=self.ledger, screen=self._screen,
+                    max_peer_weight=self._max_peer_weight)
         if not broadcast_decision(0 if averaged is None else 1):
             return
         if floats is None:  # follower of a slice whose coordinator averaged
@@ -901,6 +932,12 @@ class CollaborativeOptimizer:
         if self._server is not None:
             self._server.stop()
             self._server = None
+        if self._gossip is not None:
+            # signal AND bounded-join BEFORE the caller tears the DHT
+            # down: an in-flight publish/fold on a destroyed native
+            # node is a use-after-free (dht.shutdown ordering contract)
+            self._gossip.stop()
+            self._gossip = None
 
     def __enter__(self) -> "CollaborativeOptimizer":
         return self

@@ -22,10 +22,13 @@ liveness: dead peers' contributions expire away
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Optional
 
 from dalle_tpu.swarm.dht import DHT, get_dht_time
+
+logger = logging.getLogger(__name__)
 
 
 class PerformanceEMA:
@@ -93,7 +96,9 @@ class ProgressTracker:
                  metadata_expiration: float = 60.0,
                  min_refresh_period: float = 0.5,
                  client_mode: bool = False,
-                 ledger=None):
+                 ledger=None,
+                 max_peer_samples: Optional[int] = None,
+                 overclaim_factor: float = 100.0):
         self.dht = dht
         self.key = f"{run_id}_progress"
         self.target_batch_size = target_batch_size
@@ -107,6 +112,28 @@ class ProgressTracker:
         # the swarm's sample total. Strikes decay, so a rehabilitated
         # peer re-enters the aggregate after a few clean epochs.
         self.ledger = ledger
+        # Per-peer share cap on the progress aggregate (the progress
+        # twin of allreduce's max_peer_weight clamp): one signed record
+        # claiming samples_accumulated=1e9 would fire ready_to_update
+        # on every honest peer instantly, stealing the epoch
+        # advancement the swarm hasn't earned. The CLAMP is the
+        # defense: each peer's contribution to the aggregate is capped
+        # at the swarm-wide target, so an inflated claim moves the
+        # clock by at most one honest peer's worth. The STRIKE fires
+        # only far beyond the cap (``overclaim_factor`` x, default
+        # 100x): honest overshoot is real and can be large — samples
+        # keep accumulating for the whole wall-clock of matchmaking +
+        # allreduce, so a fast peer over a slow round legitimately
+        # claims MANY multiples of a small target (observed 12x in the
+        # 2-peer CPU drive) — while a fabricated claim is orders of
+        # magnitude out. Strikes dedup per (peer, claimed epoch) so the
+        # sub-second polling loop cannot turn one bad record into a
+        # strike flood.
+        self.max_peer_samples = (int(target_batch_size)
+                                 if max_peer_samples is None
+                                 else int(max_peer_samples))
+        self.overclaim_factor = overclaim_factor
+        self._overclaim_struck: set = set()
         self.performance_ema = PerformanceEMA()
         self.local_epoch = 0
         self.samples_accumulated = 0
@@ -184,6 +211,29 @@ class ProgressTracker:
                     client_mode=bool(rec.get("client_mode", False)))
             except (KeyError, TypeError, ValueError):
                 continue
+            if prog.samples_accumulated < 0:
+                continue  # nonsense claim: not part of our clock
+            cap = self.max_peer_samples
+            if cap > 0 and prog.samples_accumulated > cap:
+                if (bound != self.dht.peer_id
+                        and self.ledger is not None
+                        and prog.samples_accumulated
+                        > self.overclaim_factor * cap
+                        and (bound, prog.epoch)
+                        not in self._overclaim_struck
+                        and len(self._overclaim_struck) < 4096):
+                    # strike ONLY when the dedup mark landed: once the
+                    # set is full (an epoch-churning flooder), further
+                    # claims are clamped but not struck — otherwise the
+                    # full set would re-enable the exact per-poll
+                    # strike/log flood it exists to prevent
+                    self._overclaim_struck.add((bound, prog.epoch))
+                    self.ledger.strike(bound, "progress-overclaim")
+                    logger.warning(
+                        "progress: peer %s claims %d samples at epoch "
+                        "%d (cap %d) — clamped and struck", bound[:16],
+                        prog.samples_accumulated, prog.epoch, cap)
+                prog = dataclasses.replace(prog, samples_accumulated=cap)
             by_peer[bound] = prog
         peers = list(by_peer.values())
 

@@ -41,11 +41,33 @@ reproduces the same fault schedule). The tier-1 fast variant and the
 slow-marked full soak both live in tests/test_chaos.py; see CHAOS.md
 for methodology and the 2-core-box caveats.
 
+**Byzantine mode** (``--byzantine``, CHAOS.md "Byzantine peers"): the
+same harness pointed at the CONTENT trust model instead of churn. Two
+seeded attackers (one sign-flip, one scale) contribute valid-but-wrong
+gradients through the chaos layer's byzantine seam while every peer
+runs the full defense stack — norm/cosine screening
+(swarm/screening.py), the frame-weight clamp, and gossiped signed
+strike receipts (swarm/health.py). Two passes share one schedule:
+
+- a **control** pass with the attacks stripped — the false-positive
+  oracle: the defense must record ZERO strikes on an honest swarm and
+  converge bit-exactly;
+- the **attack** pass — honest peers must still converge bit-exactly
+  to the honest-only analytic reference (screening is drop/keep, never
+  reweight), and every attacker must appear in every honest peer's
+  ledger within <= 2 epochs of the attack starting, with gossiped
+  remote receipts corroborating (the swarm-wide conviction, not just
+  per-victim).
+
+Results land in BYZANTINE_SOAK.json. The fast tier-1 variant and the
+slow-marked full soak live in tests/test_screening.py.
+
 Usage::
 
     python scripts/churn_soak.py                  # full soak, defaults
     python scripts/churn_soak.py --peers 3 --epochs 4 --kills 1 \
         --joins 1 --matchmaking-time 1.2 --allreduce-timeout 5
+    python scripts/churn_soak.py --byzantine      # byzantine gate
 """
 
 from __future__ import annotations
@@ -68,9 +90,13 @@ import numpy as np  # noqa: E402
 from dalle_tpu.swarm import DHT, Identity  # noqa: E402
 from dalle_tpu.swarm import compression  # noqa: E402
 from dalle_tpu.swarm.allreduce import run_allreduce  # noqa: E402
-from dalle_tpu.swarm.chaos import Blackout, ChaosDHT, FaultPlan  # noqa: E402
-from dalle_tpu.swarm.health import PeerHealthLedger  # noqa: E402
+from dalle_tpu.swarm.chaos import (Blackout, ByzantineOp,  # noqa: E402
+                                   ChaosDHT, FaultPlan)
+from dalle_tpu.swarm.health import (PeerHealthLedger,  # noqa: E402
+                                    StrikeGossip)
 from dalle_tpu.swarm.matchmaking import make_group  # noqa: E402
+from dalle_tpu.swarm.screening import (GradientScreen,  # noqa: E402
+                                       ScreenPolicy)
 from dalle_tpu.swarm.state_transfer import (StateServer,  # noqa: E402
                                             load_state_from_peers)
 
@@ -126,7 +152,10 @@ class SoakPeer:
     def __init__(self, name: str, node: DHT, plan: FaultPlan, prefix: str,
                  target_epochs: int, deadline: float,
                  matchmaking_time: float, allreduce_timeout: float,
-                 state: Optional[np.ndarray] = None, epoch: int = 0):
+                 state: Optional[np.ndarray] = None, epoch: int = 0,
+                 screen: Optional[GradientScreen] = None,
+                 max_peer_weight: Optional[float] = None,
+                 gossip: bool = False):
         self.name = name
         self.node = node
         self.dht = ChaosDHT(node, plan) if plan.enabled else node
@@ -141,6 +170,20 @@ class SoakPeer:
         self.epoch = epoch
         self.epoch_log: List[int] = [epoch]
         self.ledger = PeerHealthLedger()
+        # byzantine-mode defenses: content screen + frame-weight clamp
+        # on every round, plus the strike-receipt gossip — driven
+        # synchronously (one step() per epoch) so receipt propagation
+        # is deterministic relative to the epoch clock the oracles
+        # measure against
+        self.screen = screen
+        self.max_peer_weight = max_peer_weight
+        self.gossip = (StrikeGossip(self.dht, self.ledger, prefix)
+                       if gossip else None)
+        # first epoch each offender showed up in this ledger, split by
+        # evidence plane (score = any; remote = gossiped receipts) —
+        # the byzantine soak's "struck within <= 2 epochs" oracle
+        self.first_strike: Dict[str, int] = {}
+        self.first_remote: Dict[str, int] = {}
         self.died = False
         self.errors: List[str] = []
         self.server = StateServer(self.dht, prefix, self._provide,
@@ -182,7 +225,9 @@ class SoakPeer:
                             [grads], weight=1.0,
                             allreduce_timeout=self.at,
                             sender_timeout=min(2.0, self.at / 3),
-                            codec=compression.NONE, ledger=self.ledger)
+                            codec=compression.NONE, ledger=self.ledger,
+                            screen=self.screen,
+                            max_peer_weight=self.max_peer_weight)
                         averaged = out[0]
                 except Exception as e:  # noqa: BLE001 - degraded epoch
                     # a failed round is an ALONE-equivalent epoch (the
@@ -190,6 +235,17 @@ class SoakPeer:
                     self.errors.append(f"epoch {self.epoch}: {e!r}")
                     averaged = grads
                 self.ledger.advance_epoch(self.epoch)
+                if self.gossip is not None:
+                    try:
+                        self.gossip.step()
+                    except Exception as e:  # noqa: BLE001 - degraded
+                        self.errors.append(
+                            f"gossip at epoch {self.epoch}: {e!r}")
+                for pid, _s in self.ledger.snapshot().items():
+                    self.first_strike.setdefault(pid, self.epoch)
+                    if (pid not in self.first_remote
+                            and self.ledger.remote_score(pid) > 0):
+                        self.first_remote[pid] = self.epoch
                 with self.lock:
                     self.state = self.state + averaged
                     self.epoch += 1
@@ -220,6 +276,10 @@ class SoakPeer:
                     "fingerprint": fingerprint(self.state),
                     "epoch_log": self.epoch_log,
                     "round_errors": self.errors,
+                    "strikes": self.ledger.snapshot(),
+                    "first_strike": dict(self.first_strike),
+                    "first_remote": dict(self.first_remote),
+                    "peer_id": self.node.peer_id,
                     "injected": dict(getattr(self.dht, "injected", {}))}
 
 
@@ -379,6 +439,147 @@ def run_soak(args) -> dict:
             "pass": not violations}
 
 
+def build_byzantine_schedule(seed: int, n_peers: int, epochs: int) -> dict:
+    """Seeded attacker assignment: one sign-flip and one (negatively)
+    scaled attacker, distinct peers, active from epoch 0 for the whole
+    run. Deterministic in the seed, recorded in the report."""
+    rng = random.Random(seed ^ 0xB12A)
+    flip, scale = rng.sample(range(n_peers), 2)
+    return {"seed": seed, "epochs": epochs,
+            "attacks": [
+                {"peer": flip, "kind": "sign_flip", "factor": 1.0,
+                 "start_epoch": 0},
+                {"peer": scale, "kind": "scale", "factor": -10.0,
+                 "start_epoch": 0}]}
+
+
+def _byzantine_pass(args, schedule: dict, attacks_on: bool,
+                    violations: List[str]) -> List[Dict]:
+    """One full swarm run of the byzantine schedule (attacks active or
+    stripped), every peer armed with the whole defense stack. Returns
+    per-peer results; liveness violations land in ``violations``."""
+    tag = "atk" if attacks_on else "ctl"
+    prefix = f"byz{args.seed}{tag}"
+    by_peer = {}
+    if attacks_on:
+        for a in schedule["attacks"]:
+            by_peer.setdefault(a["peer"], []).append(ByzantineOp(
+                kind=a["kind"], factor=a["factor"],
+                start_epoch=a["start_epoch"]))
+    deadline = time.monotonic() + args.deadline
+    nodes: List[DHT] = []
+    for i in range(args.peers):
+        boots = [nodes[0].visible_address] if nodes else []
+        nodes.append(DHT(initial_peers=boots,
+                         identity=Identity.generate(), rpc_timeout=2.0))
+    peers = [
+        SoakPeer(f"peer{i}", node,
+                 FaultPlan(seed=args.seed,
+                           byzantine=tuple(by_peer.get(i, ()))),
+                 prefix, target_epochs=args.epochs, deadline=deadline,
+                 matchmaking_time=args.matchmaking_time,
+                 allreduce_timeout=args.allreduce_timeout,
+                 screen=GradientScreen(ScreenPolicy()),
+                 max_peer_weight=100.0, gossip=True)
+        for i, node in enumerate(nodes)]
+    for p in peers:
+        p.start()
+    while time.monotonic() < deadline:
+        if all(not p.thread.is_alive() for p in peers):
+            break
+        time.sleep(0.2)
+    for p in peers:
+        p.finish()
+    results = []
+    attacker_idx = {a["peer"] for a in schedule["attacks"]} \
+        if attacks_on else set()
+    for i, p in enumerate(peers):
+        r = p.result(killed=False)
+        r["attacker"] = i in attacker_idx
+        results.append(r)
+        if r["final_epoch"] < args.epochs and not r["attacker"]:
+            violations.append(
+                f"[{tag}] {r['name']} wedged: epoch "
+                f"{r['final_epoch']}/{args.epochs} at the deadline")
+    return results
+
+
+def run_byzantine(args) -> dict:
+    """The byzantine gate: a control pass (attacks stripped — the
+    false-positive oracle) and an attack pass over one seeded schedule.
+    See the module docstring for the oracles."""
+    schedule = build_byzantine_schedule(args.seed, args.peers, args.epochs)
+    t0 = time.monotonic()
+    threads_before = set(threading.enumerate())
+    violations: List[str] = []
+    want = fingerprint(sum((grads_for_epoch(e) for e in range(args.epochs)),
+                           np.zeros(STATE_ELEMS, np.float32)))
+
+    control = _byzantine_pass(args, schedule, attacks_on=False,
+                              violations=violations)
+    # -- control oracles: zero strikes, bit-exact convergence -------------
+    for r in control:
+        if r["first_strike"]:
+            violations.append(
+                f"[ctl] {r['name']} recorded strikes on an honest "
+                f"swarm (false positives): {r['first_strike']}")
+        if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
+            violations.append(
+                f"[ctl] {r['name']} fingerprint {r['fingerprint']} != "
+                f"analytic {want}")
+
+    attack = _byzantine_pass(args, schedule, attacks_on=True,
+                             violations=violations)
+    # -- attack oracles ----------------------------------------------------
+    attacker_pids = [r["peer_id"] for r in attack if r["attacker"]]
+    attack_start = max(a["start_epoch"] for a in schedule["attacks"])
+    for r in attack:
+        if r["attacker"]:
+            continue
+        # honest survivors converge bit-exactly to the honest-only
+        # reference: screening is drop/keep, so the attackers' data
+        # (and weight) must leave no trace in any honest accumulator
+        if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
+            violations.append(
+                f"[atk] honest {r['name']} fingerprint "
+                f"{r['fingerprint']} != analytic {want} — byzantine "
+                "data reached a state accumulator")
+        for pid in attacker_pids:
+            seen = r["first_strike"].get(pid)
+            if seen is None or seen > attack_start + 2:
+                violations.append(
+                    f"[atk] {r['name']} never struck attacker "
+                    f"{pid[:16]} within 2 epochs (first: {seen})")
+            remote = r["first_remote"].get(pid)
+            if remote is None or remote > attack_start + 2:
+                violations.append(
+                    f"[atk] {r['name']} has no gossiped receipt "
+                    f"against {pid[:16]} within 2 epochs "
+                    f"(first: {remote})")
+
+    # -- thread hygiene ----------------------------------------------------
+    settle = time.monotonic() + 5.0
+    leaked: List[str] = []
+    while time.monotonic() < settle:
+        leaked = [t.name for t in threading.enumerate()
+                  if t not in threads_before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.2)
+    if leaked:
+        violations.append(f"leaked threads: {leaked}")
+
+    return {"mode": "byzantine", "seed": args.seed,
+            "params": {"peers": args.peers, "epochs": args.epochs,
+                       "matchmaking_time": args.matchmaking_time,
+                       "allreduce_timeout": args.allreduce_timeout,
+                       "deadline": args.deadline},
+            "schedule": schedule,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "control": control, "attack": attack,
+            "violations": violations, "pass": not violations}
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--peers", type=int, default=5,
@@ -395,24 +596,47 @@ def main(argv=None) -> int:
     parser.add_argument("--deadline", type=float, default=420.0,
                         help="hard wall for the whole soak (liveness "
                              "bound: a wedged round fails here)")
-    parser.add_argument("--out", type=str,
-                        default=os.path.join(_REPO, "CHURN_SOAK.json"))
+    parser.add_argument("--byzantine", action="store_true",
+                        help="run the byzantine gate instead of churn: "
+                             "control pass (zero-strike oracle) + "
+                             "attack pass (1 sign-flip + 1 scale "
+                             "attacker) over one seeded schedule, full "
+                             "defense stack on every peer")
+    parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args(argv)
+    if args.out is None:
+        args.out = os.path.join(
+            _REPO, "BYZANTINE_SOAK.json" if args.byzantine
+            else "CHURN_SOAK.json")
 
-    report = run_soak(args)
+    if args.byzantine:
+        report = run_byzantine(args)
+    else:
+        report = run_soak(args)
     with open(args.out, "w", encoding="utf-8") as fh:
         json.dump(report, fh, indent=1)
         fh.write("\n")
     ok = report["pass"]
-    print(f"churn soak: {'PASS' if ok else 'FAIL'} in "
-          f"{report['elapsed_s']}s — {len(report['peers'])} peers, "
-          f"{len(report['schedule']['kills'])} kill(s), "
-          f"{len(report['schedule']['joins'])} join(s), partition="
-          f"{report['schedule']['partition']}")
-    for r in report["peers"]:
-        print(f"  {r['name']:>8}: epoch {r['final_epoch']} "
-              f"fp={r['fingerprint']} killed={r['killed']} "
-              f"injected={r['injected']}")
+    if args.byzantine:
+        print(f"byzantine soak: {'PASS' if ok else 'FAIL'} in "
+              f"{report['elapsed_s']}s — {args.peers} peers x 2 passes, "
+              f"attacks={[a['kind'] for a in report['schedule']['attacks']]}")
+        for tag in ("control", "attack"):
+            for r in report[tag]:
+                print(f"  [{tag[:3]}] {r['name']:>8}: epoch "
+                      f"{r['final_epoch']} fp={r['fingerprint']} "
+                      f"attacker={r['attacker']} "
+                      f"first_strike={r['first_strike']}")
+    else:
+        print(f"churn soak: {'PASS' if ok else 'FAIL'} in "
+              f"{report['elapsed_s']}s — {len(report['peers'])} peers, "
+              f"{len(report['schedule']['kills'])} kill(s), "
+              f"{len(report['schedule']['joins'])} join(s), partition="
+              f"{report['schedule']['partition']}")
+        for r in report["peers"]:
+            print(f"  {r['name']:>8}: epoch {r['final_epoch']} "
+                  f"fp={r['fingerprint']} killed={r['killed']} "
+                  f"injected={r['injected']}")
     for v in report["violations"]:
         print(f"  VIOLATION: {v}")
     print(f"report: {args.out}")

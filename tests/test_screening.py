@@ -1,0 +1,808 @@
+"""Byzantine defense layer (swarm/screening.py + health.py receipts +
+chaos.py byzantine ops): the content trust model above signatures.
+
+Four layers, mirroring CHAOS.md "Defense in depth":
+
+- the GradientScreen's pure math: norm/cosine boundaries, leave-one-out
+  correctness, the iterative (masking-resistant) drop order, the
+  small-swarm skip, the max_drop_frac ceiling, and the honest-
+  heterogeneity false-positive pin;
+- signed strike receipts: sign/verify/dedup, bounded per-issuer and
+  total remote influence (no veto: remote receipts alone can never
+  convict), decay;
+- real-socket integration: a sign-flip attacker screened at every
+  honest part owner with bit-exact honest averages, the frame-weight
+  clamp, the screening-disabled transparency pin, and the 2-peer
+  unattributability rule;
+- the byzantine soak gate (scripts/churn_soak.py --byzantine): fast
+  variant in tier-1, full soak slow-marked (pytest.ini).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_tpu.swarm import DHT, Identity
+from dalle_tpu.swarm import compression
+from dalle_tpu.swarm.allreduce import (_part_slices, flatten_tensors,
+                                       run_allreduce)
+from dalle_tpu.swarm.chaos import ByzantineOp, ChaosDHT, FaultPlan
+from dalle_tpu.swarm.dht import ValueWithExpiration
+from dalle_tpu.swarm.health import (GOSSIP_REASONS, PeerHealthLedger,
+                                    StrikeGossip, make_receipt,
+                                    open_receipt)
+from dalle_tpu.swarm.matchmaking import make_group
+from dalle_tpu.swarm.screening import GradientScreen, ScreenPolicy
+
+
+G = np.arange(1, 17, dtype=np.float32)  # a generic honest segment
+
+
+def contribs(*segs, weights=None):
+    w = weights or [1.0] * len(segs)
+    return {i: (w[i], np.asarray(s, np.float32))
+            for i, s in enumerate(segs)}
+
+
+# -- the screen's pure math ------------------------------------------------
+
+class TestScreenPolicy:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_senders"):
+            ScreenPolicy(min_senders=2)
+        with pytest.raises(ValueError, match="max_drop_frac"):
+            ScreenPolicy(max_drop_frac=1.0)
+        with pytest.raises(ValueError, match="norm_tolerance"):
+            ScreenPolicy(norm_tolerance=1.0)
+        with pytest.raises(ValueError, match="cosine_floor"):
+            ScreenPolicy(cosine_floor=-2.0)
+
+
+class TestGradientScreen:
+    def test_sign_flip_dropped_by_cosine(self):
+        v = GradientScreen().screen(contribs(G, G, G, G, -G))
+        assert list(v.dropped) == [4]
+        assert v.dropped[4].startswith("cosine")
+
+    def test_scale_dropped_by_norm(self):
+        v = GradientScreen().screen(contribs(G, G, G, G, 100 * G))
+        assert list(v.dropped) == [4]
+        assert v.dropped[4].startswith("norm-ratio")
+
+    def test_loud_outlier_does_not_mask_quiet_one(self):
+        """The masking attack on one-shot outlier tests: a -10x-scaled
+        contribution drags the leave-one-out mean so far that a plain
+        sign flip looks AGREEING (cos(-g, mean incl -10g) = +1). The
+        iterative screen drops the loud one first, re-measures, then
+        catches the quiet one."""
+        v = GradientScreen().screen(contribs(G, G, G, -G, -10 * G))
+        assert set(v.dropped) == {3, 4}
+        assert v.dropped[4].startswith("norm-ratio")
+        assert v.dropped[3].startswith("cosine")
+
+    def test_leave_one_out_math(self):
+        """stats = (norm/median, cos(v_i, loo mean)) — verified by hand
+        on survivors after a no-drop screen."""
+        a = np.array([2.0, 0.0], np.float32)
+        b = np.array([0.0, 2.0], np.float32)
+        c = np.array([2.0, 2.0], np.float32)
+        d = np.array([1.0, 1.0], np.float32)
+        v = GradientScreen().screen(contribs(a, b, c, d))
+        assert not v.dropped
+        # sender 3: loo mean = (a+b+c)/3 = (4/3, 4/3); cos(d, loo) = 1
+        assert v.stats[3][1] == pytest.approx(1.0)
+        # sender 0: loo mean = (b+c+d)/3 = (1, 5/3)
+        loo = np.array([1.0, 5 / 3])
+        want = float(a @ loo / (np.linalg.norm(a) * np.linalg.norm(loo)))
+        assert v.stats[0][1] == pytest.approx(want)
+        # norms: |a|=|b|=2, |c|=2sqrt2, |d|=sqrt2 -> median 2
+        assert v.stats[2][0] == pytest.approx(np.sqrt(2))
+
+    def test_norm_boundary_is_strict(self):
+        pol = ScreenPolicy(norm_tolerance=4.0)
+        # ratio exactly 4.0: NOT an outlier (strict >)
+        v = GradientScreen(pol).screen(contribs(G, G, G, 4 * G))
+        assert not v.dropped
+        v = GradientScreen(pol).screen(contribs(G, G, G, 4.5 * G))
+        assert list(v.dropped) == [3]
+
+    def test_cosine_boundary_is_strict(self):
+        # orthogonal vector: cos = 0, floor = 0.0 -> not dropped
+        pol = ScreenPolicy(cosine_floor=0.0)
+        ortho = np.zeros_like(G)
+        ortho[0], ortho[1] = G[1], -G[0]  # perpendicular to G in 2 dims
+        assert float(ortho @ G) == 0.0
+        v = GradientScreen(pol).screen(contribs(G, G, G, ortho))
+        assert not v.dropped
+        v = GradientScreen(pol).screen(contribs(G, G, G, -G))
+        assert list(v.dropped) == [3]
+
+    def test_small_swarm_skipped_nonfinite_still_dropped(self):
+        """Below min_senders the outlier screen must not run (with 2-3
+        senders a leave-one-out consensus is one peer's word against
+        another's) — but NaN/Inf is poison at any size."""
+        v = GradientScreen().screen(contribs(G, -G))
+        assert v.skipped and not v.dropped
+        v = GradientScreen().screen(contribs(G, G, -G))
+        assert v.skipped and not v.dropped
+        bad = G.copy()
+        bad[3] = np.nan
+        v = GradientScreen().screen(contribs(G, bad))
+        assert v.skipped and v.dropped == {1: "nonfinite"}
+
+    def test_max_drop_frac_ceiling(self):
+        """With outliers beyond the budget, only floor(frac * n) drop —
+        the WORST first — so a coordinated minority can never turn the
+        screen into a majority-eviction tool."""
+        pol = ScreenPolicy(max_drop_frac=0.34)  # floor(0.34 * 6) = 2
+        v = GradientScreen(pol).screen(
+            contribs(G, G, G, -G, 50 * G, 100 * G))
+        assert len(v.dropped) == 2
+        assert set(v.dropped) == {4, 5}  # loudest norms outrank the flip
+
+    def test_weight_zero_contributions_ignored(self):
+        v = GradientScreen().screen(
+            contribs(G, G, G, G, -G, weights=[1, 1, 1, 1, 0]))
+        assert not v.dropped  # the flip never reaches the accumulator
+
+    def test_nonfinite_weight_dropped(self):
+        """A NaN/Inf WEIGHT poisons total_w exactly like NaN data —
+        and NaN slips past a `w <= 0` sign check — so it must be
+        dropped at any roster size (the clamp may be disabled)."""
+        v = GradientScreen().screen(
+            contribs(G, G, G, G, G,
+                     weights=[1, 1, 1, 1, float("nan")]))
+        assert v.dropped == {4: "nonfinite"}
+        v = GradientScreen().screen(
+            contribs(G, G, weights=[1, float("inf")]))
+        assert v.dropped == {1: "nonfinite"}  # even below min_senders
+
+    def test_zero_vector_is_harmless(self):
+        v = GradientScreen().screen(contribs(G, G, G, np.zeros_like(G)))
+        assert not v.dropped
+
+    def test_deterministic(self):
+        c = contribs(G, G + 1, G - 1, -G, 30 * G)
+        a = GradientScreen().screen(c)
+        b = GradientScreen().screen(c)
+        assert a.dropped == b.dropped and a.stats == b.stats
+
+    def test_honest_heterogeneity_never_screened(self):
+        """THE false-positive pin: honest non-IID volunteers — a shared
+        signal plus per-peer noise, per-peer norms spread over ~3x, a
+        couple of weight-imbalanced peers — must never be screened, for
+        any of several draws. A screen that eats honest heterogeneity
+        would silently shrink every round's effective batch."""
+        screen = GradientScreen()
+        for seed in range(10):
+            rng = np.random.RandomState(seed)
+            signal = rng.randn(256).astype(np.float32)
+            c = {}
+            for i in range(8):
+                scale = rng.uniform(0.5, 1.6)       # batch-size spread
+                noise = rng.randn(256).astype(np.float32)
+                c[i] = (float(rng.choice([0.5, 1.0, 2.0, 4.0])),
+                        (signal * scale
+                         + 0.8 * noise).astype(np.float32))
+            v = screen.screen(c)
+            assert not v.dropped, (seed, v.dropped, v.stats)
+
+
+# -- signed strike receipts ------------------------------------------------
+
+class TestReceipts:
+    def test_roundtrip_and_issuer_binding(self):
+        ident = Identity.generate()
+        peer = "cd" * 32
+        raw = make_receipt(ident, "runX", peer, "screen-outlier", 7)
+        opened = open_receipt(raw, "runX")
+        assert opened is not None
+        issuer, got_peer, reason, epoch = opened
+        import hashlib
+        assert issuer == hashlib.sha256(ident.public_bytes).hexdigest()
+        assert (got_peer, reason, epoch) == (peer, "screen-outlier", 7)
+
+    def test_tampered_or_cross_run_rejected(self):
+        ident = Identity.generate()
+        raw = make_receipt(ident, "runX", "cd" * 32, "corrupt-chunk", 1)
+        bad = bytearray(raw)
+        bad[-1] ^= 0x01
+        assert open_receipt(bytes(bad), "runX") is None
+        assert open_receipt(raw[:-2], "runX") is None
+        # the run prefix is signed context: no cross-swarm replay
+        assert open_receipt(raw, "runY") is None
+
+    def test_strict_content(self):
+        """Unknown reasons and malformed ids must be rejected — the
+        strike plane is attacker-writable, and a verifier must never
+        fold a claim it cannot price."""
+        ident = Identity.generate()
+        assert "made-up-reason" not in GOSSIP_REASONS
+        raw = make_receipt(ident, "r", "cd" * 32, "made-up-reason", 1)
+        assert open_receipt(raw, "r") is None
+        raw = make_receipt(ident, "r", "not-a-peer-id", "corrupt-chunk", 1)
+        assert open_receipt(raw, "r") is None
+        raw = make_receipt(ident, "r", "cd" * 32, "corrupt-chunk", -1)
+        assert open_receipt(raw, "r") is None
+        # timeout reasons are unattributable by design: never gossiped,
+        # never folded
+        raw = make_receipt(ident, "r", "cd" * 32, "reduce-timeout", 1)
+        assert open_receipt(raw, "r") is None
+
+
+class TestLedgerRemoteInfluence:
+    def test_per_issuer_cap_no_single_issuer_veto(self):
+        led = PeerHealthLedger(max_issuer_influence=1.0,
+                               max_remote_influence=2.0)
+        for epoch in range(20):  # one issuer flooding receipts
+            led.remote_strike("issuer-a", "p1", "screen-outlier", 0)
+        assert led.score("p1") == pytest.approx(1.0)
+        assert not led.penalized("p1")
+
+    def test_total_remote_cap_below_threshold(self):
+        """Remote receipts ALONE can never convict (Sybil issuers mint
+        identities for free): the total remote influence cap sits below
+        the penalty threshold, so conviction requires local evidence."""
+        led = PeerHealthLedger(penalty_threshold=3.0,
+                               max_remote_influence=2.0)
+        for i in range(10):  # 10 distinct issuers co-signing
+            led.remote_strike(f"issuer-{i}", "p1", "screen-outlier", 0)
+        assert led.score("p1") == pytest.approx(2.0)
+        assert not led.penalized("p1")
+        led.strike("p1", "reduce-timeout")  # any local corroboration
+        assert led.penalized("p1")
+
+    def test_remote_strikes_decay(self):
+        led = PeerHealthLedger(ttl_epochs=2)
+        led.remote_strike("i1", "p1", "screen-outlier", 0)
+        assert led.score("p1") > 0
+        led.advance_epoch(3)
+        assert led.score("p1") == 0.0
+        assert led.snapshot() == {}
+
+    def test_forward_dated_receipt_clamped_to_local_clock(self):
+        """An attacker-issued receipt claiming epoch 10^9 must not
+        outlive the decay window: fold clamps to the local epoch."""
+        led = PeerHealthLedger(ttl_epochs=2)
+        led.advance_epoch(5)
+        led.remote_strike("i1", "p1", "screen-outlier", 10 ** 9)
+        assert led.score("p1") > 0
+        led.advance_epoch(8)  # clamped epoch 5 ages out at 5 + ttl
+        assert led.score("p1") == 0.0
+
+    def test_snapshot_merges_both_planes(self):
+        led = PeerHealthLedger()
+        led.strike("p1", "corrupt-chunk")
+        led.remote_strike("i1", "p1", "screen-outlier", 0)
+        led.remote_strike("i1", "p2", "screen-outlier", 0)
+        snap = led.snapshot()
+        assert snap["p1"] == pytest.approx(3.0)  # 2.0 local + 1.0 capped
+        assert snap["p2"] == pytest.approx(1.0)
+        assert led.remote_score("p1") == pytest.approx(1.0)
+
+
+class _GossipStub:
+    """Record-plane double: every stub shares one store, so receipts
+    published by one 'peer' are visible to the others' fold."""
+
+    def __init__(self, shared):
+        self.identity = Identity.generate()
+        import hashlib
+        self.peer_id = hashlib.sha256(
+            self.identity.public_bytes).hexdigest()
+        self.shared = shared
+
+    def store(self, key, subkey, value, expiration_time):
+        self.shared.setdefault(key, {})[subkey] = ValueWithExpiration(
+            value, expiration_time)
+        return True
+
+    def get(self, key, latest=True):
+        return dict(self.shared.get(key, {})) or None
+
+
+class TestStrikeGossip:
+    def _pair(self):
+        shared = {}
+        a, b = _GossipStub(shared), _GossipStub(shared)
+        la, lb = PeerHealthLedger(), PeerHealthLedger()
+        return (StrikeGossip(a, la, "g"), la), (StrikeGossip(b, lb, "g"),
+                                                lb)
+
+    def test_publish_fold_roundtrip_and_dedup(self):
+        (ga, la), (gb, lb) = self._pair()
+        offender = "ee" * 32
+        la.strike(offender, "screen-outlier")
+        assert ga.publish_once() == 1
+        assert gb.fold_once() == 1
+        assert lb.remote_score(offender) == pytest.approx(1.0)
+        # the DHT returns the same record every poll: folding again
+        # must not stack influence
+        assert gb.fold_once() == 0
+        assert lb.remote_score(offender) == pytest.approx(1.0)
+        # publishing again with no new events is a no-op
+        assert ga.publish_once() == 0
+
+    def test_unattributable_reasons_never_gossip(self):
+        (ga, la), (gb, lb) = self._pair()
+        la.strike("ee" * 32, "reduce-timeout")
+        la.strike("ee" * 32, "gather-timeout")
+        la.strike("ee" * 32, "confirm-timeout")
+        assert ga.publish_once() == 0  # silence is not evidence
+
+    def test_own_and_self_receipts_not_folded(self):
+        (ga, la), (gb, lb) = self._pair()
+        # a receipt naming the READER: never folded (no self-conviction
+        # by gossip), and a receipt the reader itself issued adds
+        # nothing (already a local strike)
+        la.strike(gb.dht.peer_id, "screen-outlier")
+        ga.publish_once()
+        assert gb.fold_once() == 0
+        assert lb.score(gb.dht.peer_id) == 0.0
+        lb.strike("ee" * 32, "corrupt-chunk")
+        gb.publish_once()
+        assert gb.fold_once() == 0  # own receipt skipped
+
+    def test_self_strike_events_not_published(self):
+        (ga, la), _ = self._pair()
+        la.strike(ga.dht.peer_id, "screen-outlier")
+        assert ga.publish_once() == 0
+
+    def test_failed_store_requeues_receipt(self):
+        """A transient store failure (outage, chaos blackout rule on
+        'store') must retry next period, not silently lose a one-shot
+        offense's receipt — the exact hazard the gossip graftlint
+        fixture pins."""
+        (ga, la), (gb, lb) = self._pair()
+        offender = "ee" * 32
+        la.strike(offender, "corrupt-chunk")
+        real_store = ga.dht.store
+        ga.dht.store = lambda *a, **k: False        # outage
+        assert ga.publish_once() == 0
+        ga.dht.store = real_store                   # heals
+        assert ga.publish_once() == 1               # requeued, retried
+        assert gb.fold_once() == 1
+        assert lb.remote_score(offender) > 0
+
+        # a store that RAISES mid-batch requeues the remainder too
+        la.strike("aa" * 32, "corrupt-chunk")
+        la.strike("bb" * 32, "corrupt-chunk")
+
+        def boom(*a, **k):
+            raise OSError("dht down")
+        ga.dht.store = boom
+        assert ga.publish_once() == 0
+        ga.dht.store = real_store
+        assert ga.publish_once() == 2
+
+    def test_garbage_in_store_ignored(self):
+        (ga, la), (gb, lb) = self._pair()
+        ga.dht.store("g_strikes", "junk", b"not a receipt", 10 ** 10)
+        ga.dht.store("g_strikes", "junk2", {"not": "bytes"}, 10 ** 10)
+        assert gb.fold_once() == 0
+
+    def test_worker_thread_stops_clean(self):
+        (ga, la), _ = self._pair()
+        ga.period = 0.05
+        ga.start()
+        la.strike("ee" * 32, "screen-outlier")
+        deadline = time.monotonic() + 5
+        while ga.published == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        ga.stop()
+        assert not ga.is_alive()
+        assert ga.published >= 1
+
+
+# -- byzantine plan parsing / tamper seam ----------------------------------
+
+class TestByzantinePlan:
+    def test_roundtrip_and_strict_parse(self):
+        plan = FaultPlan(seed=3, byzantine=(
+            ByzantineOp(kind="scale", factor=-10.0, start_epoch=1,
+                        end_epoch=5),))
+        assert plan.enabled
+        assert FaultPlan.from_json(plan.to_json()) == plan
+        with pytest.raises(ValueError, match="unknown byzantine kind"):
+            FaultPlan.from_dict({"byzantine": [{"kind": "signflip"}]})
+        with pytest.raises(ValueError, match="unknown byzantine op key"):
+            FaultPlan.from_dict(
+                {"byzantine": [{"kind": "scale", "factr": 2.0}]})
+        with pytest.raises(ValueError, match="needs a 'kind'"):
+            FaultPlan.from_dict({"byzantine": [{"factor": 2.0}]})
+        with pytest.raises(ValueError, match="weight_inflate"):
+            ByzantineOp(kind="weight_inflate", factor=-1.0)
+        with pytest.raises(ValueError, match="finite"):
+            ByzantineOp(kind="scale", factor=float("inf"))
+        with pytest.raises(ValueError, match="finite"):
+            ByzantineOp(kind="scale", factor=float("nan"))
+        with pytest.raises(ValueError, match="window"):
+            ByzantineOp(kind="sign_flip", start_epoch=5, end_epoch=2)
+
+    def test_tamper_kinds_and_epoch_window(self):
+        stub = _GossipStub({})
+        chaos = ChaosDHT(stub, FaultPlan(seed=1, byzantine=(
+            ByzantineOp(kind="sign_flip", start_epoch=2, end_epoch=4),)))
+        t = [np.arange(4, dtype=np.float32)]
+        out, w = chaos.tamper_contribution(1, t, 3.0)
+        assert out is t and w == 3.0          # outside the window: untouched
+        out, w = chaos.tamper_contribution(2, t, 3.0)
+        np.testing.assert_array_equal(out[0], -t[0])
+        assert w == 3.0
+        out, w = chaos.tamper_contribution(4, t, 3.0)
+        assert out is t                        # window closed
+
+        chaos2 = ChaosDHT(stub, FaultPlan(byzantine=(
+            ByzantineOp(kind="weight_inflate", factor=1e9),)))
+        out, w = chaos2.tamper_contribution(0, t, 3.0)
+        assert out is t and w == 1e9           # data honest, claim inflated
+
+        chaos3 = ChaosDHT(stub, FaultPlan(byzantine=(
+            ByzantineOp(kind="scale", factor=-10.0),)))
+        out, _ = chaos3.tamper_contribution(0, t, 3.0)
+        np.testing.assert_array_equal(out[0], -10.0 * t[0])
+
+    def test_garbage_is_seed_deterministic(self):
+        stub = _GossipStub({})
+        plan = FaultPlan(seed=9, byzantine=(
+            ByzantineOp(kind="garbage", factor=100.0),))
+        t = [np.zeros(64, np.float32)]
+        a, _ = ChaosDHT(stub, plan).tamper_contribution(3, t, 1.0)
+        b, _ = ChaosDHT(stub, plan).tamper_contribution(3, t, 1.0)
+        c, _ = ChaosDHT(stub, plan).tamper_contribution(4, t, 1.0)
+        np.testing.assert_array_equal(a[0], b[0])
+        assert not np.array_equal(a[0], c[0])  # epoch-varying
+        assert np.linalg.norm(a[0]) > 100.0    # actually loud
+
+    def test_inert_wrapper_tamper_is_identity(self):
+        stub = _GossipStub({})
+        chaos = ChaosDHT(stub, FaultPlan(seed=1))
+        t = [np.arange(4, dtype=np.float32)]
+        out, w = chaos.tamper_contribution(0, t, 2.0)
+        assert out is t and w == 2.0
+        assert chaos.injected == {}
+
+
+# -- real-socket integration ----------------------------------------------
+
+def _det_swarm(n, base=61):
+    from dalle_tpu.swarm.identity import Ed25519PrivateKey
+    nodes = []
+    for i in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([base + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=2.0))
+    return nodes
+
+
+def _run_threads(fns, timeout=60):
+    results = [None] * len(fns)
+    errors = []
+
+    def wrap(i, fn):
+        try:
+            results[i] = fn()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _round(dhts, prefix, tensors, *, screen=None, max_peer_weight=None,
+           reports=None, ledgers=None, min_group=None, at=8.0):
+    n = len(dhts)
+    min_group = n if min_group is None else min_group
+
+    def peer(i):
+        g = make_group(dhts[i], prefix, epoch=0, weight=1.0,
+                       matchmaking_time=3.0, min_group_size=min_group)
+        assert g is not None and g.size == n
+        return g, run_allreduce(
+            dhts[i], g, prefix, 0, tensors[i], weight=1.0,
+            allreduce_timeout=at, sender_timeout=1.5,
+            codec=compression.NONE,
+            report=None if reports is None else reports[i],
+            ledger=None if ledgers is None else ledgers[i],
+            screen=screen, max_peer_weight=max_peer_weight)
+
+    return _run_threads([lambda i=i: peer(i) for i in range(n)])
+
+
+class TestScreeningIntegration:
+    def test_sign_flip_screened_at_every_honest_owner(self):
+        """Tentpole pin: 5 peers, one contributing validly-signed
+        sign-flipped data through the byzantine seam. Every honest part
+        owner must drop it (attributable screen-outlier strike) and
+        average the honest contributions EXACTLY — drop/keep, never
+        reweight."""
+        nodes = _det_swarm(5)
+        pids = [n.peer_id for n in nodes]
+        bad_i = 2
+        dhts = list(nodes)
+        dhts[bad_i] = ChaosDHT(nodes[bad_i], FaultPlan(
+            seed=1, byzantine=(ByzantineOp(kind="sign_flip"),)))
+        rng = np.random.RandomState(5)
+        base = rng.randint(-8, 9, size=400).astype(np.float32)
+        tensors = [[base + i] for i in range(5)]  # integer, non-IID
+        reports = [dict() for _ in range(5)]
+        ledgers = [PeerHealthLedger() for _ in range(5)]
+        try:
+            results = _round(dhts, "sf", tensors,
+                             screen=GradientScreen(),
+                             reports=reports, ledgers=ledgers)
+        finally:
+            for n in nodes:
+                n.shutdown()
+        honest = [i for i in range(5) if i != bad_i]
+        group = results[honest[0]][0]
+        member_ids = [m.peer_id for m in group.members]
+        flats = [flatten_tensors(t) for t in tensors]
+        slices = _part_slices(flats[0].size, 5)
+        honest_avg = sum(flats[i] for i in honest) / len(honest)
+        for i in honest:
+            assert pids[bad_i] in reports[i]["screened_senders"]
+            assert not reports[i]["complete"]
+            assert ledgers[i].score(pids[bad_i]) == pytest.approx(2.0)
+            my_part = member_ids.index(pids[i])
+            lo, hi = slices[my_part]
+            got = flatten_tensors(results[i][1])
+            np.testing.assert_array_equal(got[lo:hi], honest_avg[lo:hi])
+
+    def test_weight_overclaim_dropped_and_struck(self):
+        """Satellite pin: a signed frame claiming weight=1e9 (honest
+        DATA — no value screen can see it) is dropped wholesale with an
+        attributable weight-overclaim strike; honest parts average over
+        honest claims only."""
+        nodes = _det_swarm(3, base=81)
+        pids = [n.peer_id for n in nodes]
+        bad_i = 1
+        dhts = list(nodes)
+        dhts[bad_i] = ChaosDHT(nodes[bad_i], FaultPlan(
+            seed=2, byzantine=(
+                ByzantineOp(kind="weight_inflate", factor=1e9),)))
+        rng = np.random.RandomState(3)
+        base = rng.randint(-8, 9, size=300).astype(np.float32)
+        tensors = [[base + 2 * i] for i in range(3)]
+        reports = [dict() for _ in range(3)]
+        ledgers = [PeerHealthLedger() for _ in range(3)]
+        try:
+            results = _round(dhts, "wo", tensors, max_peer_weight=100.0,
+                             reports=reports, ledgers=ledgers)
+        finally:
+            for n in nodes:
+                n.shutdown()
+        honest = [i for i in range(3) if i != bad_i]
+        group = results[honest[0]][0]
+        member_ids = [m.peer_id for m in group.members]
+        flats = [flatten_tensors(t) for t in tensors]
+        slices = _part_slices(flats[0].size, 3)
+        honest_avg = sum(flats[i] for i in honest) / len(honest)
+        for i in honest:
+            assert reports[i]["overweight_senders"] == [pids[bad_i]]
+            assert ledgers[i].score(pids[bad_i]) == pytest.approx(2.0)
+            my_part = member_ids.index(pids[i])
+            lo, hi = slices[my_part]
+            got = flatten_tensors(results[i][1])
+            np.testing.assert_array_equal(got[lo:hi], honest_avg[lo:hi])
+
+    def test_disabled_matches_enabled_honest_byte_identical(self):
+        """The transparency pin (chaos-layer standard): the full
+        matchmaking + allreduce stack with deterministic identities and
+        INTEGER tensors, run once with screening+clamp off (the
+        pre-change path, bit-exact by construction — screen=None takes
+        the untouched streaming branch) and once with the whole defense
+        enabled on an honest roster — byte-identical averages."""
+        rng = np.random.RandomState(17)
+        tensors = [[rng.randint(-8, 9, size=512).astype(np.float32)]
+                   for _ in range(4)]
+
+        def round_once(defended):
+            nodes = _det_swarm(4, base=91)
+            try:
+                return _round(
+                    nodes, "tp", tensors,
+                    screen=GradientScreen() if defended else None,
+                    max_peer_weight=100.0 if defended else None)
+            finally:
+                for n in nodes:
+                    n.shutdown()
+
+        plain = round_once(defended=False)
+        defended = round_once(defended=True)
+        for p, d in zip(plain, defended):
+            np.testing.assert_array_equal(p[1][0], d[1][0])
+
+    def test_under_delivered_round_withholds_parts(self):
+        """A 5-member roster clears the screen quorum, but only 3
+        members actually participate (churn / a roster split while
+        offenders are penalized at different peers). The screen cannot
+        certify a 3-delivery set it promised to screen — averaging it
+        unscreened is the window a colluding minority needs (the
+        byzantine soak caught a transition epoch exploiting exactly
+        this) — so every part is WITHHELD: each participant's result
+        is bit-identical to its own local tensors."""
+        nodes = _det_swarm(5, base=31)
+        live = [0, 1, 2]  # members 3 and 4 announce, then go silent
+        rng = np.random.RandomState(9)
+        tensors = [[rng.randint(-8, 9, size=200).astype(np.float32)]
+                   for _ in range(5)]
+        reports = [dict() for _ in range(5)]
+
+        def peer(i):
+            g = make_group(nodes[i], "ud", epoch=0, weight=1.0,
+                           matchmaking_time=3.0, min_group_size=5)
+            assert g is not None and g.size == 5
+            if i not in live:
+                return g, None  # announced, never participates
+            return g, run_allreduce(
+                nodes[i], g, "ud", 0, tensors[i], weight=1.0,
+                allreduce_timeout=8.0, sender_timeout=1.5,
+                codec=compression.NONE, report=reports[i],
+                screen=GradientScreen())
+
+        try:
+            results = _run_threads([lambda i=i: peer(i)
+                                    for i in range(5)])
+        finally:
+            for n in nodes:
+                n.shutdown()
+        for i in live:
+            assert not reports[i]["complete"]
+            assert reports[i]["screened_senders"] == []  # no verdicts
+            # every part kept local values: nothing unscreened landed
+            np.testing.assert_array_equal(results[i][1][0],
+                                          tensors[i][0])
+
+    def test_two_peer_unattributability_preserved(self):
+        """A 2-peer swarm must never screen: either peer calling the
+        other an outlier is a veto (the same rule that keeps 2-peer
+        timeout bans strike-less). The attacker's data lands — the
+        documented small-swarm gap — but NO strikes are recorded."""
+        nodes = _det_swarm(2, base=71)
+        dhts = list(nodes)
+        dhts[1] = ChaosDHT(nodes[1], FaultPlan(
+            seed=3, byzantine=(ByzantineOp(kind="sign_flip"),)))
+        tensors = [[np.full(64, 4.0, np.float32)] for _ in range(2)]
+        reports = [dict() for _ in range(2)]
+        ledgers = [PeerHealthLedger() for _ in range(2)]
+        try:
+            results = _round(dhts, "2p", tensors,
+                             screen=GradientScreen(),
+                             reports=reports, ledgers=ledgers)
+        finally:
+            for n in nodes:
+                n.shutdown()
+        assert reports[0]["screened_senders"] == []
+        assert ledgers[0].snapshot() == {}
+        # the flip DID land: (4 + -4) / 2 = 0 — screening is honest
+        # about what it cannot decide at this size
+        np.testing.assert_array_equal(results[0][1][0],
+                                      np.zeros(64, np.float32))
+
+
+class TestProgressOverclaim:
+    def test_absurd_claim_clamped_and_struck_once(self):
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(2, base=51)
+        led = PeerHealthLedger()
+        try:
+            tracker = ProgressTracker(nodes[0], "po", target_batch_size=64,
+                                      ledger=led,
+                                      min_refresh_period=0.0)
+            liar = ProgressTracker(nodes[1], "po", target_batch_size=64)
+            liar.report_local_progress(0, 10 ** 9, force=True)
+            time.sleep(0.4)  # let the record replicate
+            deadline = time.monotonic() + 10
+            gp = tracker.global_progress(force_refresh=True)
+            while gp.reporting_peers < 1 and time.monotonic() < deadline:
+                time.sleep(0.1)
+                gp = tracker.global_progress(force_refresh=True)
+            assert gp.reporting_peers == 1
+            # per-peer share capped at the whole swarm target: the
+            # epoch clock cannot be stolen by one absurd signed claim
+            assert gp.samples_accumulated <= 64
+            assert led.score(nodes[1].peer_id) == pytest.approx(1.0)
+            # sub-second polling must not turn one bad record into a
+            # strike flood: dedup per (peer, claimed epoch)
+            tracker.global_progress(force_refresh=True)
+            tracker.global_progress(force_refresh=True)
+            assert led.score(nodes[1].peer_id) == pytest.approx(1.0)
+            # a FULL dedup set (an epoch-churning flooder) stops
+            # striking — clamping alone bounds the damage — instead of
+            # re-enabling the per-poll strike flood
+            tracker._overclaim_struck = {
+                ("x", i) for i in range(4096)}
+            liar.report_local_progress(1, 10 ** 9, force=True)
+            time.sleep(0.4)
+            before = led.score(nodes[1].peer_id)
+            gp = tracker.global_progress(force_refresh=True)
+            assert gp.samples_accumulated <= 64  # still clamped
+            assert led.score(nodes[1].peer_id) == pytest.approx(before)
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_honest_overshoot_not_struck(self):
+        """Accumulating far past target while a slow round is in
+        flight is NORMAL (samples grow for the round's whole
+        wall-clock; 12x a small target observed in the 2-peer CPU
+        drive): capped in the sum, but never a strike."""
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(2, base=41)
+        led = PeerHealthLedger()
+        try:
+            tracker = ProgressTracker(nodes[0], "ho", target_batch_size=64,
+                                      ledger=led, min_refresh_period=0.0)
+            honest = ProgressTracker(nodes[1], "ho", target_batch_size=64)
+            honest.report_local_progress(0, 800, force=True)  # 12.5x cap
+            time.sleep(0.4)
+            deadline = time.monotonic() + 10
+            gp = tracker.global_progress(force_refresh=True)
+            while gp.reporting_peers < 1 and time.monotonic() < deadline:
+                time.sleep(0.1)
+                gp = tracker.global_progress(force_refresh=True)
+            assert gp.samples_accumulated <= 64
+            assert led.snapshot() == {}
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+
+# -- the byzantine soak gate ----------------------------------------------
+
+class TestByzantineSoak:
+    def test_schedule_is_seed_deterministic(self):
+        from scripts.churn_soak import build_byzantine_schedule
+        a = build_byzantine_schedule(seed=4, n_peers=5, epochs=3)
+        b = build_byzantine_schedule(seed=4, n_peers=5, epochs=3)
+        c = build_byzantine_schedule(seed=5, n_peers=5, epochs=3)
+        assert a == b and a != c
+        kinds = sorted(x["kind"] for x in a["attacks"])
+        assert kinds == ["scale", "sign_flip"]
+        assert len({x["peer"] for x in a["attacks"]}) == 2
+
+    def test_fast_soak(self, tmp_path):
+        """Tier-1 byzantine gate: 5 peers, 1 sign-flip + 1 scale
+        attacker, control pass + attack pass over one schedule. The
+        script's own oracles assert zero control strikes, bit-exact
+        honest convergence under attack, and every attacker struck in
+        every honest ledger within <= 2 epochs with gossiped receipt
+        corroboration."""
+        from scripts.churn_soak import main
+        out = tmp_path / "BYZANTINE_SOAK.json"
+        rc = main(["--byzantine", "--peers", "5", "--epochs", "3",
+                   "--seed", "7", "--matchmaking-time", "1.2",
+                   "--allreduce-timeout", "5", "--deadline", "150",
+                   "--out", str(out)])
+        assert rc == 0, f"byzantine soak reported a violation (see {out})"
+        import json
+        report = json.loads(out.read_text())
+        assert report["pass"] is True and report["violations"] == []
+        assert all(not r["first_strike"] for r in report["control"])
+        honest = [r for r in report["attack"] if not r["attacker"]]
+        assert len(honest) == 3
+        assert len({r["fingerprint"] for r in honest}) == 1
+
+    @pytest.mark.slow
+    def test_full_soak(self, tmp_path):
+        """The full-size byzantine soak (defaults-sized windows) —
+        slow-marked; `scripts/churn_soak.py --byzantine` is the same
+        gate from the command line."""
+        from scripts.churn_soak import main
+        out = tmp_path / "BYZANTINE_SOAK.json"
+        rc = main(["--byzantine", "--peers", "5", "--epochs", "6",
+                   "--seed", "11", "--deadline", "420",
+                   "--out", str(out)])
+        assert rc == 0

@@ -454,6 +454,59 @@ def cancel_slots(state, slots):
     return state.pos
 """,
     ),
+    # Byzantine-gossip shapes (swarm/health.py StrikeGossip): the
+    # worker publishes receipts from a background thread and could
+    # plausibly fan folds out through a pool — pin the hazardous
+    # variant of each shape so the real worker can never regress into
+    # them unnoticed.
+    (
+        "unchecked-pool-future",
+        "dalle_tpu/swarm/fake_gossip.py",
+        """
+import concurrent.futures
+def publish_receipts(dht, receipts, key):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(dht.store, key, sub, body, exp)
+                for sub, body, exp in receipts]
+        concurrent.futures.wait(futs)   # a failed store (and its
+        # receipt) vanishes without a trace
+""",
+        """
+import concurrent.futures
+def publish_receipts(dht, receipts, key):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(dht.store, key, sub, body, exp)
+                for sub, body, exp in receipts]
+        return sum(1 for f in futs if f.result())   # read every store
+""",
+    ),
+    (
+        "thread-daemon-join",
+        "dalle_tpu/swarm/fake_gossip_worker.py",
+        """
+import threading
+class Gossip(threading.Thread):
+    def __init__(self, dht, ledger):
+        super().__init__()           # non-daemon, and stop() below
+        self.dht = dht               # never joins: interpreter exit
+        self._stop = threading.Event()   # blocks on a live publish
+    def stop(self):
+        self._stop.set()
+""",
+        """
+import threading
+class Gossip(threading.Thread):
+    def __init__(self, dht, ledger):
+        super().__init__(daemon=True, name="strike-gossip")
+        self.dht = dht
+        self._stop = threading.Event()
+    def stop(self, join_timeout=10.0):
+        self._stop.set()
+        if join_timeout is not None and self.is_alive() \\
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
+""",
+    ),
     (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
