@@ -354,6 +354,41 @@ class CollabConfig:
     # alone can never convict — health.py). Off = ledger stays local.
     gossip_strikes: bool = True
     strike_gossip_period: float = 5.0
+    # Verified aggregation (swarm/audit.py; CHAOS.md "Defense in
+    # depth" row 7): each round a deterministic challenge derived from
+    # the shared round id selects parts whose owner must serve a
+    # signed audit transcript (the sender-signed inputs it averaged,
+    # its drop-set, the accumulation order); any member replays the
+    # weighted mean + the screen decisions and bit-compares against
+    # the part it gathered. A mismatch is an owner-audit-fail strike
+    # that gossips via the signed-receipt plane. audit_frac is the
+    # per-part challenge probability per round: a challenged part
+    # costs its owner the transcript (≈ the part's scatter traffic
+    # re-served from its mailbox) and each auditor a fetch + full
+    # re-verify/replay, so the default SAMPLES — every owner is
+    # audited in expectation every ~1/frac rounds, which convicts a
+    # persistent cheat within a few epochs at a quarter of the
+    # bandwidth/CPU tax (the soaks and gates run frac=1.0 for
+    # deterministic conviction-latency oracles). audit_ttl bounds how
+    # long a transcript stays fetchable in the owner's mailbox. Off =
+    # zero retention, rounds byte-identical to the pre-audit protocol.
+    audit_gather: bool = True
+    audit_frac: float = 0.25
+    audit_ttl: float = 120.0
+    # Plausible-lead bound on progress-record EPOCH claims (the epoch
+    # twin of the sample cap): a peer's claimed epoch may lead this
+    # node's local epoch by at most this margin in the aggregate —
+    # clamped always, struck (progress-overclaim) only beyond 100x
+    # the bound, because honest peers legitimately run several epochs
+    # ahead of a slow or partitioned node. 0 disables.
+    progress_max_epoch_lead: int = 2
+    # Absolute per-sender L2 norm ceiling in the gradient screen,
+    # active at ANY sender count — it narrows the <4-sender gap where
+    # leave-one-out screening must skip. Below the screen quorum the
+    # drop is unstruck (2-peer unattributability preserved). 0
+    # disables; size it well above the honest gradient envelope (the
+    # bound is model- and scale-specific, hence no finite default).
+    screen_abs_norm_ceiling: float = 0.0
     # Deterministic fault injection (swarm/chaos.py, CHAOS.md): a
     # FaultPlan as inline JSON ('{...}') or a path to a JSON file. The
     # plan wraps this peer's DHT transport with seeded message

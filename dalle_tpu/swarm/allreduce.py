@@ -176,7 +176,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   codec_backend: str = compression.HOST_BACKEND,
                   ledger=None,
                   screen=None,
-                  max_peer_weight: Optional[float] = None
+                  max_peer_weight: Optional[float] = None,
+                  audit=None
                   ) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
@@ -249,6 +250,21 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     signing — attacks are injected above the signature so the wire
     carries validly-signed wrong data, which is exactly what the screen
     exists to catch.
+
+    ``audit`` (optional :class:`~dalle_tpu.swarm.audit.RoundAudit`)
+    arms the verified-aggregation layer for this round: the
+    deterministic challenge (derived from ``prefix``/``epoch`` — every
+    member computes the same set) names audited parts; a challenged
+    part OWNER retains the signed frames it applied, its drop-set
+    (with the offending frame as evidence for provable reasons) and
+    the accumulation order, then signs and posts the transcript into
+    its mailbox before serving the part; every member retains the
+    gathered bytes of audited parts plus which owners transport-acked
+    its own scatter, so the post-round audit (audit.audit_round) can
+    replay and bit-compare. Retention copies bytes and never touches
+    the accumulation — ``audit=None`` rounds are byte-identical to the
+    pre-audit protocol, and audit-ON honest rounds produce identical
+    averages (pinned by test).
 
     ``codec_backend="device"`` runs the u8/f16 wire codec as jitted
     device programs (swarm/device_codec.py): ``tensors`` may be jax
@@ -345,6 +361,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     owner_index = {m.peer_id: k for k, m in enumerate(owners)}
     my_part = owner_index.get(me.peer_id)  # None in client mode
     slices = _part_slices(flat.size, len(owners))
+    # verified aggregation (swarm/audit.py): the deterministic
+    # challenge is known at round start, so retention costs nothing on
+    # unchallenged parts; retain_mine arms the owner-side transcript
+    # hooks for this peer's own part only
+    if audit is not None:
+        audit.begin(group, owners, my_part,
+                    [hi_ - lo_ for lo_, hi_ in slices], chunk_elems,
+                    codec, adaptive_threshold, max_peer_weight, screen)
+    audited_parts = audit.audited if audit is not None else frozenset()
+    retain_mine = audit is not None and audit.audits_mine
     t0 = time.monotonic()
     phases["flatten_s"] = round(t0 - t_flat, 3)
     deadline = t0 + allreduce_timeout
@@ -469,14 +495,58 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             if screen_active:
                 acc = None  # summed after the screen verdict
                 total_w = 0.0
+            elif (screen is not None and weight > 0
+                    and screen.over_ceiling(mine)):
+                # the absolute ceiling binds the OWNER's own
+                # contribution too, at any sender count — otherwise a
+                # hostile owner below the screen quorum could
+                # self-sign an arbitrarily huge "own contribution"
+                # and serve the poisoned part with a transcript the
+                # replay would certify. Below the quorum the
+                # self-drop is unstruck like any ceiling drop.
+                acc = np.zeros(n_mine, np.float32)
+                total_w = 0.0
+                ban_peer(me.peer_id, "screen-outlier", strike=False)
+                if report is not None:
+                    report["complete"] = False
+                logger.warning(
+                    "allreduce: own contribution over the absolute "
+                    "norm ceiling (%g) — withheld from this part",
+                    screen.policy.abs_norm_ceiling)
+                if retain_mine:
+                    audit.note_init("zeros")
+                    audit.note_drop(group.my_index, "screen-outlier")
             else:
                 acc = mine * weight
                 total_w = weight
+                if retain_mine:
+                    # streaming accumulation initializes from this
+                    # owner's own contribution (weight may be 0)
+                    audit.note_init("self")
+            # hostile-owner chaos seam (swarm/chaos.py omit_sender):
+            # an active op names one delivered sender whose whole
+            # contribution this owner silently discards — no ban, no
+            # transcript entry. The sender-side omission audit is what
+            # catches exactly this.
+            omit_pick = getattr(dht, "omit_sender_target", None)
+            omit_target = None
+            if omit_pick is not None and expected:
+                omit_target = omit_pick(epoch, sorted(
+                    group.members[i].peer_id for i in expected))
             # a sender's contribution applies ATOMICALLY once all its
             # chunks arrived (partial senders are dropped wholesale, the
             # same elasticity semantics as the unchunked protocol)
             bufs: Dict[int, np.ndarray] = {}
             got: Dict[int, set] = {}
+            # the weight APPLIED for a sender is its chunk-0 frame's
+            # claim, deterministically — never "whichever frame
+            # completed the set" (arrival order). Every chunk's claim
+            # still faces the clamp below, but only chunk 0 governs:
+            # a sender shipping inconsistent in-clamp weights across
+            # its chunks gains nothing and — crucially — cannot make
+            # an honest owner's audit transcript unreplayable (the
+            # replay re-derives the same chunk-0 weight)
+            wts: Dict[int, float] = {}
             my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
             my_ctx = _sign_ctx(prefix, epoch, "scatter", me.peer_id)
 
@@ -485,16 +555,21 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # wire read of chunk i+1 overlaps the decode of chunk i
                 # (device backend: the decompress dispatches to the
                 # accelerator from this same pool — the drain structure
-                # is backend-independent)
+                # is backend-independent). The decrypted signed frame
+                # rides along for the audit transcript's retention.
                 raw = maybe_decrypt(gkey, raw_enc)
                 if raw is None:
                     return None
-                return _parse(raw, group, my_chunks, my_ctx, codec_mod)
+                return raw, _parse(raw, group, my_chunks, my_ctx,
+                                   codec_mod)
 
             banned_reduce = 0  # corrupt-banned senders (no data applied)
 
-            def apply_reduce(parsed) -> bool:
+            def apply_reduce(item) -> bool:
                 nonlocal acc, total_w, banned_reduce
+                if item is None:
+                    return False
+                raw, parsed = item
                 if parsed is None:
                     return False
                 status, sender, w, ci, data = parsed
@@ -512,9 +587,15 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     expected.discard(sender)
                     bufs.pop(sender, None)
                     got.pop(sender, None)
+                    wts.pop(sender, None)
                     banned_reduce += 1
                     ban_peer(group.members[sender].peer_id,
                              "corrupt-chunk")
+                    if retain_mine:
+                        # the bad frame IS the proof: auditors replay
+                        # the parse and confirm the verdict
+                        audit.note_drop(sender, "corrupt-chunk",
+                                        evidence=raw)
                     if report is not None:
                         report["complete"] = False
                     logger.warning(
@@ -538,9 +619,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     expected.discard(sender)
                     bufs.pop(sender, None)
                     got.pop(sender, None)
+                    wts.pop(sender, None)
                     banned_reduce += 1
                     ban_peer(group.members[sender].peer_id,
                              "weight-overclaim")
+                    if retain_mine:
+                        audit.note_drop(sender, "weight-overclaim",
+                                        evidence=raw)
                     if report is not None:
                         report["complete"] = False
                     logger.warning(
@@ -557,14 +642,50 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 clo, chi = my_chunks[ci]
                 bufs[sender][clo:chi] = data
                 got[sender].add(ci)
+                if ci == 0:
+                    wts[sender] = w
+                if retain_mine:
+                    audit.note_frame(sender, ci, raw)
                 if len(got[sender]) == len(my_chunks):
-                    if screen_active:
+                    w = wts.pop(sender)  # chunk-0 claim governs
+                    pid = group.members[sender].peer_id
+                    if omit_target is not None and pid == omit_target:
+                        # chaos omit_sender: discard the delivered
+                        # contribution wholesale, leave no trace (the
+                        # attack the omission audit convicts)
+                        bufs.pop(sender)
+                    elif screen_active:
                         # buffer for the post-drain screen; weight and
                         # accumulation are deferred to the verdict
                         complete[sender] = (w, bufs.pop(sender))
                     else:
-                        acc += bufs.pop(sender) * w
-                        total_w += w
+                        seg = bufs.pop(sender)
+                        if screen is not None \
+                                and screen.over_ceiling(seg):
+                            # absolute-norm ceiling, active at ANY
+                            # sender count (the <4-sender narrowing):
+                            # below the screen quorum the delivered
+                            # segment is dropped but NOT struck — the
+                            # 2-peer unattributability rule
+                            ban_peer(pid, "screen-outlier",
+                                     strike=False)
+                            if retain_mine:
+                                audit.note_drop(sender,
+                                                "screen-outlier")
+                            if report is not None:
+                                report["complete"] = False
+                            logger.warning(
+                                "allreduce: dropped sender %s — "
+                                "segment norm over the absolute "
+                                "ceiling (%g); below the screen "
+                                "quorum the drop is unstruck",
+                                pid[:16],
+                                screen.policy.abs_norm_ceiling)
+                        else:
+                            acc += seg * w
+                            total_w += w
+                            if retain_mine:
+                                audit.note_applied(sender)
                     got.pop(sender)
                     expected.discard(sender)
                 return True
@@ -618,6 +739,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # patience: the classic dead/slow-peer ban
                 ban_peer(group.members[s].peer_id, "reduce-timeout",
                          strike=blame_remote)
+                if retain_mine:
+                    # a claimed timeout is the one unprovable drop —
+                    # recorded reason-only, earns nobody a strike at
+                    # replay (silence semantics)
+                    audit.note_drop(s, "reduce-timeout")
             if expected and report is not None:
                 report["complete"] = False
             if screen_active:
@@ -630,6 +756,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 verdict = screen.screen(complete)
                 for k in sorted(verdict.dropped):
                     ban_peer(group.members[k].peer_id, "screen-outlier")
+                    if retain_mine:
+                        audit.note_drop(k, "screen-outlier")
                     if report is not None:
                         report["complete"] = False
                     logger.warning(
@@ -640,6 +768,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         verdict.dropped[k],
                         " [own contribution]"
                         if k == group.my_index else "")
+                if retain_mine and verdict.skipped:
+                    audit.note_withheld()
                 if verdict.skipped:
                     # the ROSTER promised a screenable quorum
                     # (screen_active) but actual deliveries fell below
@@ -666,9 +796,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 elif weight > 0 and group.my_index not in verdict.dropped:
                     acc = mine * weight
                     total_w = weight
+                    if retain_mine:
+                        audit.note_init("self")
                 else:
                     acc = np.zeros(n_mine, np.float32)
                     total_w = 0.0
+                    if retain_mine:
+                        audit.note_init("zeros")
                 if not verdict.skipped:
                     for k in sorted(complete):
                         if k == group.my_index or k in verdict.dropped:
@@ -676,6 +810,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         w_k, seg = complete[k]
                         acc += seg * w_k
                         total_w += w_k
+                        if retain_mine:
+                            audit.note_applied(k)
             if report is not None:
                 # contributors whose full data reached this part (self
                 # included when weight > 0) — an assistant uses this to
@@ -700,6 +836,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # contribution was screened out — own included — takes
                 # this same withhold path.)
                 averaged_mine = None
+            if retain_mine and averaged_mine is not None:
+                # self-sign this owner's own contribution (exact codec)
+                # so the transcript's inputs fully explain the average
+                audit.note_self(dht.identity, my_ctx, group.group_hash,
+                                group.my_index, weight, mine, my_chunks)
             phases["reduce_s"] = round(time.monotonic() - t_built, 3)
 
         t_wait = time.monotonic()
@@ -713,20 +854,67 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         # rides the future result, so the retry skips the codec.
         retries = [f.result()[:3] for f in futures
                    if not f.cancelled() and not f.result()[3]]
+        failed_tags = {t for _a, t, _b in retries}
         if retries and time.monotonic() < deadline:
             retry_futs = [pool.submit(send_raw, *s) for s in retries]
             concurrent.futures.wait(retry_futs)
             # consume every retry outcome: an exception in send_raw (or
             # a still-failing send) must leave a trace, not vanish in an
             # unread Future (graftlint unchecked-pool-future)
-            still_failed = sum(1 for f in retry_futs
-                               if f.done() and not f.result())
+            failed_tags = set()
+            still_failed = 0
+            for f, s in zip(retry_futs, retries):
+                if not f.done() or not f.result():
+                    still_failed += 1
+                    failed_tags.add(s[1])
             if still_failed:
                 logger.warning(
                     "allreduce: %d/%d scatter chunk(s) undeliverable "
                     "after retry (receivers will ban this sender's "
                     "contribution)", still_failed, len(retry_futs))
+        if audit is not None and weight > 0:
+            # sender-side bookkeeping for the omission audit: which
+            # audited parts this peer's WHOLE contribution was
+            # transport-acked into (any chunk's send still failing
+            # after retry disqualifies the part — the owner may
+            # legitimately never have seen us)
+            for k, owner in scatter_to:
+                if k == my_part or k not in audited_parts:
+                    continue
+                if _tag(prefix, epoch, "scatter",
+                        owner.peer_id) not in failed_tags:
+                    audit.note_scatter_ok(k)
         phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
+
+    # serve the audit transcript BEFORE the part: any member that
+    # completes the gather can immediately fetch the honest record the
+    # owner signed (the post is mailbox-local, no wire round-trips)
+    if retain_mine and averaged_mine is not None:
+        t_post = time.monotonic()
+        try:
+            if not audit.post_transcript(dht):
+                # a False post (native mailbox rc != 0, chaos fault)
+                # is the same outcome as the raise below: members
+                # that gathered this part will strike audit-timeout —
+                # the owner deserves a local diagnostic either way
+                logger.warning(
+                    "allreduce: audit transcript post rejected by the "
+                    "mailbox — part %d's challenge will go unserved",
+                    my_part)
+        except Exception:  # noqa: BLE001 - an unserved transcript only
+            # costs THIS owner audit-timeout strikes; the round must
+            # not die for it
+            logger.warning("allreduce: audit transcript post failed",
+                           exc_info=True)
+        phases["audit_post_s"] = round(time.monotonic() - t_post, 3)
+    # hostile-owner chaos seam (swarm/chaos.py wrong_gather_part): an
+    # active op rewrites the part THIS owner is about to serve — after
+    # the honest average and after the transcript, which is exactly
+    # the attack shape the replay audit convicts
+    tamper_part = getattr(dht, "tamper_gather_part", None)
+    if (tamper_part is not None and my_part is not None
+            and averaged_mine is not None):
+        averaged_mine = tamper_part(epoch, my_part, averaged_mine)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
     # an assistant's return value is meaningless (it collects nothing and
@@ -893,6 +1081,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 pending[part].discard(ci)
                 if not pending[part]:
                     del pending[part]
+                    if audit is not None and part in audited_parts:
+                        # retain the exact bytes this member will live
+                        # with — the replay's comparison target
+                        alo, ahi = slices[part]
+                        audit.note_gathered(part, out[alo:ahi])
                 return True
 
             decoding: List[concurrent.futures.Future] = []
@@ -996,6 +1189,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         pending[k].discard(pci)
                         last_progress = time.monotonic()
                     if not pending.get(k):
+                        if (k in pending and audit is not None
+                                and k in audited_parts):
+                            alo, ahi = slices[k]
+                            audit.note_gathered(k, out[alo:ahi])
                         pending.pop(k, None)
                 if pending:
                     time.sleep(0.1)

@@ -1,0 +1,829 @@
+"""Verified aggregation: audit part owners by replaying their rounds.
+
+Every defense below this layer — signatures, strict parsing, the frame
+weight clamp, the content screen, the health ledger (CHAOS.md "Defense
+in depth") — runs on a part owner's INPUTS. The owner's OUTPUT, the
+averaged part it serves in the gather phase, has exactly one
+authoritative source and no cross-sender view to screen against: a
+hostile owner that averages honestly-signed inputs into a wrong part
+passes everything. This module is the BTARD-style answer (validator
+recomputation of aggregator outputs, Gorbunov et al. arXiv 2106.11257)
+adapted to the butterfly protocol:
+
+- **Challenge.** Each reduce round, a deterministic challenge derived
+  from the shared round id — ``sha256(prefix, epoch, part)`` against
+  ``AuditPolicy.frac`` — selects which parts are audited. Every member
+  computes the same set with no coordinator, and the challenged owner
+  KNOWS it is challenged at round start, so retention costs nothing on
+  unchallenged rounds.
+- **Transcript.** The challenged owner serves an audit transcript: the
+  signed scatter frames it averaged (its own contribution included,
+  self-signed with the exact codec), its drop-set with reasons (and
+  the offending frame as evidence for every provable reason), and the
+  accumulation order. The transcript is itself Ed25519-signed by the
+  owner under a (run, epoch, part)-bound context and published in the
+  owner's mailbox, chunked, AEAD-wrapped under the round's group key
+  like every other data-plane message.
+- **Replay.** Any member holding the gathered part re-derives it:
+  verify every frame signature, re-run the weight clamp and the
+  (deterministic, f64-statistics) :class:`~dalle_tpu.swarm.screening
+  .GradientScreen` decisions, re-accumulate the weighted mean in the
+  transcript's order with the same f32 operation sequence, re-apply
+  the wire codec round-trip, and BIT-COMPARE against the part it
+  gathered.
+
+Why the owner cannot cheat:
+
+- **Fabrication is impossible** — every input frame is sender-signed;
+  the only frames the owner can mint are its OWN, and a fabricated
+  self-contribution crafted to "explain" a wrong output is exactly
+  what the replayed screen catches (an outlying self-segment the
+  transcript claims was kept fails the screen replay; below the
+  screen quorum the absolute-norm ceiling bounds the same move).
+- **Omission is attributable to its victim** — a sender whose
+  delivered (transport-acked) frames appear neither in the applied
+  set nor in the drop-set strikes the owner (``owner-audit-omit``,
+  local: only the victim can know it delivered). A *claimed* timeout
+  is the one unprovable drop and earns nobody a strike — the same
+  silence rule the ban paths follow.
+- **A wrong part is a conviction** — replay mismatch is an
+  ``owner-audit-fail`` strike that gossips through the r13 signed
+  receipt plane (health.StrikeGossip), so a wrong-part owner is
+  down-ranked swarm-wide within ~2 epochs. Receipts alone still never
+  convict (bounded influence); every member that RECEIVED the wrong
+  part corroborates locally, and an owner that equivocates (serves
+  different bytes to different members) convicts at every member
+  whose bytes disagree with the one transcript it signed.
+- **Refusing the audit does not evade it** — an unserved challenge is
+  an ``audit-timeout`` strike (local, timeout-weighted: silence is
+  never gossiped) at every member that gathered the part, so a
+  stonewalling owner converges to the same down-ranking, just without
+  the gossip speed-up.
+
+Audit-off rounds are byte-identical to pre-audit rounds (the retention
+hooks are inert when ``audit`` is None), and audit-ON honest rounds
+produce byte-identical averages — retention copies bytes, it never
+touches the accumulation (pinned by test and by the hostile-owner
+soak's control pass).
+
+Determinism boundary: the replay's f32 re-accumulation and wire-codec
+round-trip are elementwise and bit-stable on any host. The SCREEN
+replay's f64 norm/cosine statistics reduce through numpy/BLAS, whose
+summation order is build-dependent — on a mixed-build fleet an input
+within an ulp of a screen threshold could split honest verdicts (the
+same hazard that made host orthogonalization the PowerSGD default).
+Thresholds sit far outside the honest envelope and receipts alone
+never convict, which bounds the damage; CHAOS.md "Known gaps" carries
+the full analysis and the fixed-order-statistics future fix.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import hashlib
+import logging
+import struct
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: replay mismatch — the owner served a part its own signed transcript
+#: cannot explain. Attributable (the transcript is owner-signed over
+#: sender-signed inputs), so it gossips as a receipt.
+AUDIT_FAIL_REASON = "owner-audit-fail"
+#: a delivered sender's frames are missing from the transcript
+#: entirely (not applied, not dropped). Attributable only to the
+#: victim — third parties cannot verify the delivery — so it stays a
+#: LOCAL strike.
+AUDIT_OMIT_REASON = "owner-audit-omit"
+#: challenged owner never served a transcript. Unattributable silence
+#: (mailbox loss looks identical), timeout-weighted, never gossiped.
+AUDIT_TIMEOUT_REASON = "audit-timeout"
+
+#: wire framing of one posted transcript chunk: (chunk_idx, n_chunks)
+_TCHDR = struct.Struct(">II")
+
+
+def _audit_ctx(prefix: str, epoch: int, part: int) -> bytes:
+    """Signature context of a transcript: bound to run, epoch and part
+    so a transcript cannot be replayed across rounds or parts."""
+    return f"{prefix}:audit-transcript:{epoch}:{part}".encode()
+
+
+def _audit_tag(prefix: str, epoch: int, part: int, chunk: int) -> int:
+    digest = hashlib.sha256(
+        f"{prefix}:audit:{epoch}:{part}:{chunk}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def challenged_parts(prefix: str, epoch: int, n_parts: int,
+                     frac: float) -> Set[int]:
+    """The deterministic challenge: which parts are audited this round.
+
+    A pure function of the shared round id — every member derives the
+    identical set with no coordinator, and no member (owner included)
+    can influence it: the inputs are fixed before the round exists.
+    ``frac`` is the per-part audit probability; >= 1 audits every
+    part, <= 0 none.
+    """
+    if frac <= 0.0 or n_parts <= 0:
+        return set()
+    if frac >= 1.0:
+        return set(range(n_parts))
+    out: Set[int] = set()
+    for k in range(n_parts):
+        digest = hashlib.sha256(
+            f"{prefix}:audit-challenge:{epoch}:{k}".encode()).digest()
+        if int.from_bytes(digest[:8], "big") / float(1 << 64) < frac:
+            out.add(k)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditPolicy:
+    """Knobs of the audit layer (CollabConfig.audit_* wiring).
+
+    ``frac`` is the per-part challenge probability per round (1.0 =
+    every part every round — the soak setting; production swarms can
+    sample). ``ttl`` bounds how long a transcript stays fetchable in
+    the owner's mailbox; ``fetch_timeout``/``fetch_retries`` bound one
+    auditor's patience per chunk. ``chunk_bytes`` splits large
+    transcripts under the native 64 MiB frame cap.
+    """
+
+    frac: float = 1.0
+    ttl: float = 120.0
+    fetch_timeout: float = 3.0
+    fetch_retries: int = 3
+    chunk_bytes: int = 8 << 20
+
+    def __post_init__(self):
+        if not 0.0 <= self.frac <= 1.0:
+            raise ValueError(f"frac must be in [0, 1], got {self.frac!r}")
+        if self.ttl <= 0 or self.fetch_timeout <= 0:
+            raise ValueError("ttl and fetch_timeout must be > 0")
+        if self.fetch_retries < 1:
+            raise ValueError("fetch_retries must be >= 1")
+        if self.chunk_bytes < 1024:
+            raise ValueError("chunk_bytes must be >= 1024")
+
+
+class RoundAudit:
+    """Per-round retention + transcript container.
+
+    Created by the round's caller and handed to ``run_allreduce``,
+    which fills it through the ``note_*`` hooks; after the round the
+    caller (AuditWorker, or the soak's synchronous loop) runs
+    :func:`audit_round` over it. All mutation happens on the round's
+    receive thread; reads happen strictly after the round returns —
+    the hand-off to the worker is the synchronization point.
+    """
+
+    def __init__(self, prefix: str, epoch: int,
+                 policy: AuditPolicy = AuditPolicy()):
+        self.prefix = prefix
+        self.epoch = epoch
+        self.policy = policy
+        self.begun = False
+        # filled by begin() from inside run_allreduce
+        self.group = None
+        self.owners: List = []
+        self.my_part: Optional[int] = None
+        self.part_sizes: List[int] = []
+        self.chunk_elems = 0
+        self.codec: Optional[int] = None
+        self.adaptive_threshold = 0
+        self.max_peer_weight: Optional[float] = None
+        self.screen = None
+        self.audited: Set[int] = set()
+        # owner-side retention (my challenged part)
+        self.frames: Dict[int, Dict[int, bytes]] = {}
+        self.evidence: Dict[int, bytes] = {}
+        self.drops: Dict[int, str] = {}
+        self.order: List[int] = []
+        self.init: str = "zeros"
+        self.self_frames: List[bytes] = []
+        self.withheld = False
+        self.posted = False
+        # collector-side retention
+        self.gathered: Dict[int, np.ndarray] = {}
+        self.scatter_ok: Set[int] = set()
+
+    # -- hooks called by run_allreduce ---------------------------------
+
+    def begin(self, group, owners, my_part: Optional[int],
+              part_sizes: Sequence[int], chunk_elems: int,
+              codec: Optional[int], adaptive_threshold: int,
+              max_peer_weight: Optional[float], screen=None) -> None:
+        """Called by ``run_allreduce`` with the ROUND'S context —
+        codec, clamp, screen. The replay must judge the owner by the
+        rules the round actually ran under, so the audit reads these
+        back from here rather than having callers re-plumb them (a
+        drifted clamp/screen would falsely convict honest owners)."""
+        self.group = group
+        self.owners = list(owners)
+        self.my_part = my_part
+        self.part_sizes = list(part_sizes)
+        self.chunk_elems = chunk_elems
+        self.codec = codec
+        self.adaptive_threshold = adaptive_threshold
+        self.max_peer_weight = max_peer_weight
+        self.screen = screen
+        self.audited = challenged_parts(self.prefix, self.epoch,
+                                        len(self.owners), self.policy.frac)
+        self.begun = True
+
+    @property
+    def audits_mine(self) -> bool:
+        """Whether this peer's own part is challenged this round (the
+        owner must retain and serve)."""
+        return (self.begun and self.my_part is not None
+                and self.my_part in self.audited)
+
+    def note_init(self, kind: str) -> None:
+        assert kind in ("self", "zeros")
+        self.init = kind
+
+    def note_frame(self, sender: int, ci: int, raw: bytes) -> None:
+        self.frames.setdefault(sender, {})[ci] = raw
+
+    def note_applied(self, sender: int) -> None:
+        self.order.append(sender)
+
+    def note_drop(self, sender: int, reason: str,
+                  evidence: Optional[bytes] = None) -> None:
+        self.drops[sender] = reason
+        if evidence is not None:
+            self.evidence[sender] = evidence
+
+    def note_self(self, identity, ctx: bytes, group_hash: bytes,
+                  my_index: int, weight: float, mine: np.ndarray,
+                  chunks: Sequence[Tuple[int, int]]) -> None:
+        """Self-sign this owner's own contribution with the EXACT codec
+        (NONE): the local accumulate uses the raw f32 values, so the
+        transcript's self-evidence must round-trip them bit-exactly
+        regardless of the wire codec other senders used."""
+        from dalle_tpu.swarm import compression
+        from dalle_tpu.swarm.allreduce import _make_frame
+        self.self_frames = []
+        for ci, (clo, chi) in enumerate(chunks):
+            payload = compression.compress(mine[clo:chi], compression.NONE)
+            self.self_frames.append(_make_frame(
+                identity, ctx, group_hash, my_index, weight, chi - clo,
+                compression.NONE, payload, chunk=ci, n_chunks=len(chunks)))
+
+    def note_withheld(self) -> None:
+        self.withheld = True
+
+    def note_gathered(self, part: int, values: np.ndarray) -> None:
+        self.gathered[part] = np.array(values, np.float32, copy=True)
+
+    def note_scatter_ok(self, part: int) -> None:
+        self.scatter_ok.add(part)
+
+    # -- transcript (owner side) ---------------------------------------
+
+    def build_transcript(self, identity) -> bytes:
+        """The signed transcript blob: msgpack payload under the
+        (run, epoch, part)-bound signature context. Frames ship only
+        for senders the replay needs (applied, screen-dropped, self);
+        provable drops carry their offending frame as evidence;
+        timeout drops ship reason-only (unprovable both ways)."""
+        import msgpack
+
+        from dalle_tpu.swarm.identity import signed_frame
+        need_frames = set(self.order)
+        for s, reason in self.drops.items():
+            if reason == "screen-outlier":
+                need_frames.add(s)
+        frames = {str(s): [self.frames[s][ci]
+                           for ci in sorted(self.frames[s])]
+                  for s in sorted(need_frames) if s in self.frames}
+        my_index = self.group.my_index
+        if self.self_frames:
+            frames[str(my_index)] = list(self.self_frames)
+        payload = msgpack.packb({
+            "v": 1,
+            "epoch": int(self.epoch),
+            "part": int(self.my_part),
+            "init": self.init,
+            "order": [int(s) for s in self.order],
+            "drops": {str(s): r for s, r in self.drops.items()},
+            "evidence": {str(s): raw for s, raw in self.evidence.items()
+                         if self.drops.get(s) in ("corrupt-chunk",
+                                                  "weight-overclaim")},
+            "frames": frames,
+        }, use_bin_type=True)
+        return signed_frame(
+            identity, _audit_ctx(self.prefix, self.epoch, self.my_part),
+            b"", payload)
+
+    def post_transcript(self, dht) -> bool:
+        """Publish the signed transcript into this owner's mailbox,
+        chunked under ``chunk_bytes`` (native frame cap) and
+        AEAD-wrapped under the round's group key like every data-plane
+        message. Local-only work — no wire round-trips."""
+        from dalle_tpu.swarm.crypto import maybe_encrypt
+        blob = self.build_transcript(dht.identity)
+        step = self.policy.chunk_bytes
+        pieces = [blob[o:o + step] for o in range(0, len(blob), step)] \
+            or [b""]
+        exp = time.time() + self.policy.ttl
+        ok = True
+        for ci, piece in enumerate(pieces):
+            body = _TCHDR.pack(ci, len(pieces)) + piece
+            wire = maybe_encrypt(self.group.group_key, body)
+            ok = dht.post(_audit_tag(self.prefix, self.epoch,
+                                     self.my_part, ci), wire, exp) and ok
+        self.posted = ok
+        return ok
+
+
+# -- fetch + open (auditor side) -------------------------------------------
+
+def fetch_transcript(dht, addr: str, prefix: str, epoch: int, part: int,
+                     policy: AuditPolicy, group_key=None
+                     ) -> Optional[bytes]:
+    """Pull one owner's transcript chunks from its mailbox and
+    reassemble the signed blob; None when the owner never served it
+    (within the policy's patience)."""
+    from dalle_tpu.swarm.crypto import maybe_decrypt
+
+    def one(ci: int) -> Optional[bytes]:
+        for attempt in range(policy.fetch_retries):
+            raw = dht.fetch(addr, _audit_tag(prefix, epoch, part, ci),
+                            timeout=policy.fetch_timeout)
+            body = maybe_decrypt(group_key, raw)
+            if body is not None and len(body) >= _TCHDR.size:
+                return body
+            if attempt + 1 < policy.fetch_retries:
+                time.sleep(0.1 * (attempt + 1))
+        return None
+
+    first = one(0)
+    if first is None:
+        return None
+    ci0, n_chunks = _TCHDR.unpack_from(first)
+    if ci0 != 0 or n_chunks < 1:
+        return None
+    pieces = [first[_TCHDR.size:]]
+    for ci in range(1, n_chunks):
+        body = one(ci)
+        if body is None:
+            return None
+        gci, gn = _TCHDR.unpack_from(body)
+        if gci != ci or gn != n_chunks:
+            return None
+        pieces.append(body[_TCHDR.size:])
+    return b"".join(pieces)
+
+
+def open_transcript(blob: bytes, prefix: str, epoch: int, part: int,
+                    owner_pid: str) -> Optional[dict]:
+    """Verify the owner's signature and STRICT-parse the payload;
+    None on any failure (an unverifiable transcript is treated as
+    unserved — silence semantics, never blame on unsigned bytes)."""
+    import msgpack
+
+    from dalle_tpu.swarm.identity import open_frame
+    opened = open_frame(bytes(blob), _audit_ctx(prefix, epoch, part), 0,
+                        expected_pid=owner_pid)
+    if opened is None:
+        return None
+    _head, payload, _signer = opened
+    try:
+        obj = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+        if set(obj) != {"v", "epoch", "part", "init", "order", "drops",
+                        "evidence", "frames"}:
+            return None
+        if (int(obj["v"]) != 1 or int(obj["epoch"]) != epoch
+                or int(obj["part"]) != part
+                or obj["init"] not in ("self", "zeros")):
+            return None
+        return {
+            "init": str(obj["init"]),
+            "order": [int(s) for s in obj["order"]],
+            "drops": {int(s): str(r) for s, r in obj["drops"].items()},
+            "evidence": {int(s): bytes(raw)
+                         for s, raw in obj["evidence"].items()},
+            "frames": {int(s): [bytes(f) for f in fl]
+                       for s, fl in obj["frames"].items()},
+        }
+    # the transcript plane is attacker-writable (any peer can stuff a
+    # mailbox); unparseable content is exactly "unserved"
+    # graftlint: disable=silent-except
+    except Exception:  # noqa: BLE001 - any parse failure = no transcript
+        return None
+
+
+# -- replay (the heart of the audit) ---------------------------------------
+
+@dataclasses.dataclass
+class ReplayResult:
+    """Outcome of replaying one transcript. ``ok`` False means the
+    transcript cannot explain ANY honest round (the owner lied) —
+    ``why`` says how. ``values`` is the replayed post-codec part
+    (present iff ok); ``screen_drops`` is the replayed drop-set, the
+    determinism surface the tests pin."""
+
+    ok: bool
+    why: str = ""
+    values: Optional[np.ndarray] = None
+    screen_drops: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+
+def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
+                      part: int, part_elems: int, chunk_elems: int,
+                      codec: Optional[int], adaptive_threshold: int,
+                      screen=None, max_peer_weight: Optional[float] = None
+                      ) -> ReplayResult:
+    """Re-derive the averaged part from the transcript's signed inputs.
+
+    Mirrors the owner path of ``run_allreduce`` operation for
+    operation: frame verification via the same ``_parse``, the same
+    weight clamp, the same screen decision (f64 statistics — bit-equal
+    on every honest replayer), the same f32 accumulate in the
+    transcript's recorded order, the same wire-codec round-trip. Any
+    internal inconsistency — an unevidenced provable drop, a kept
+    over-ceiling sender, a screen verdict the replay disagrees with —
+    fails the replay outright: an honest owner's transcript never
+    contains one.
+    """
+    from dalle_tpu.swarm import compression
+    from dalle_tpu.swarm.allreduce import (_chunk_slices, _parse,
+                                           _sign_ctx)
+    # the part -> member mapping: owners are the addressable members in
+    # roster order, exactly as run_allreduce builds them
+    owners = [m for m in group.members if m.addr]
+    if not 0 <= part < len(owners):
+        return ReplayResult(False, "no-such-part")
+    owner_pid = owners[part].peer_id
+    owner_index = next(i for i, m in enumerate(group.members)
+                       if m.peer_id == owner_pid)
+    chunks = _chunk_slices(part_elems, chunk_elems)
+    ctx = _sign_ctx(prefix, epoch, "scatter", owner_pid)
+
+    order = tr["order"]
+    drops = tr["drops"]
+    if set(order) & set(drops):
+        return ReplayResult(False, "sender-both-applied-and-dropped")
+    if len(set(order)) != len(order):
+        return ReplayResult(False, "duplicate-sender-in-order")
+    if owner_index in order:
+        return ReplayResult(False, "owner-in-order")
+
+    # 1. parse + verify every shipped frame set
+    parsed: Dict[int, Tuple[float, np.ndarray]] = {}
+    for sender, raws in tr["frames"].items():
+        if not (0 <= sender < group.size):
+            return ReplayResult(False, "unknown-sender")
+        seg = np.zeros(part_elems, np.float32)
+        seen: Set[int] = set()
+        w_claimed: Optional[float] = None
+        bad = False
+        for raw in raws:
+            p = _parse(raw, group, chunks, ctx)
+            if p is None:
+                return ReplayResult(False, "unverifiable-frame")
+            status, psender, w, ci, data = p
+            if psender != sender:
+                return ReplayResult(False, "misfiled-frame")
+            if status == "bad":
+                bad = True
+                continue
+            if ci == 0:
+                # the chunk-0 claim governs, mirroring apply_reduce —
+                # a sender shipping inconsistent in-clamp weights
+                # across its chunks must not be able to make an
+                # honest owner's transcript unreplayable
+                w_claimed = w
+            if ci in seen:
+                return ReplayResult(False, "duplicate-chunk")
+            clo, chi = chunks[ci]
+            seg[clo:chi] = data
+            seen.add(ci)
+        if bad:
+            # a sender shipped as evidence of corruption: must be
+            # dropped as such, never applied
+            if drops.get(sender) != "corrupt-chunk":
+                return ReplayResult(False, "bad-frame-not-dropped")
+            continue
+        if len(seen) == len(chunks) and w_claimed is not None:
+            parsed[sender] = (w_claimed, seg)
+        elif sender in order:
+            return ReplayResult(False, "applied-sender-incomplete")
+
+    # 2. drop-set consistency: provable reasons need verifying evidence
+    for sender, reason in drops.items():
+        if reason == "corrupt-chunk":
+            ev = tr["evidence"].get(sender)
+            p = _parse(ev, group, chunks, ctx) if ev is not None else None
+            if p is None or p[0] != "bad" or p[1] != sender:
+                return ReplayResult(False, "unevidenced-corrupt-drop")
+        elif reason == "weight-overclaim":
+            ev = tr["evidence"].get(sender)
+            p = _parse(ev, group, chunks, ctx) if ev is not None else None
+            if (p is None or p[0] != "ok" or p[1] != sender
+                    or max_peer_weight is None
+                    or 0.0 <= p[2] <= max_peer_weight):
+                return ReplayResult(False, "unevidenced-overclaim-drop")
+        elif reason == "screen-outlier":
+            if sender != owner_index and sender not in parsed:
+                return ReplayResult(False, "screen-drop-missing-frames")
+        # timeout reasons: unprovable either way, accepted as claimed
+
+    # 3. applied senders must obey the weight clamp the owner claims to
+    # enforce (an over-claimed weight the owner kept is a lie)
+    for sender in order:
+        if sender not in parsed:
+            return ReplayResult(False, "applied-sender-missing-frames")
+        w = parsed[sender][0]
+        if max_peer_weight is not None and not (0.0 <= w
+                                                <= max_peer_weight):
+            return ReplayResult(False, "kept-overclaimed-weight")
+
+    # 4. the owner's own contribution
+    own = parsed.get(owner_index)
+    if tr["init"] == "self" and own is None:
+        return ReplayResult(False, "init-self-without-self-frames")
+    own_w = own[0] if own is not None else 0.0
+
+    # 5. screen replay: same activation rule as run_allreduce — the
+    # WEIGHTED ROSTER decides whether screening was required
+    n_expected0 = sum(1 for m in group.members
+                      if m.peer_id != group.members[owner_index].peer_id
+                      and m.weight > 0)
+    n_weighted = n_expected0 + (1 if own_w > 0 else 0)
+    screen_active = (screen is not None
+                     and n_weighted >= screen.policy.min_senders)
+    claimed_screen = {s for s, r in drops.items() if r == "screen-outlier"}
+    replay_drops: Dict[int, str] = {}
+    if screen_active:
+        complete = {s: parsed[s] for s in order}
+        for s in claimed_screen:
+            if s in parsed:
+                complete[s] = parsed[s]
+        if own is not None and own_w > 0:
+            complete[owner_index] = own
+        verdict = screen.screen(complete)
+        replay_drops = dict(verdict.dropped)
+        replay_drops.update(verdict.dropped_unstruck)
+        if verdict.skipped:
+            # deliveries below the screen quorum are WITHHELD, never
+            # served: a transcript for such a round is itself the lie
+            return ReplayResult(False, "under-delivered-part-served",
+                                screen_drops=replay_drops)
+        if set(replay_drops) != claimed_screen:
+            return ReplayResult(False, "screen-replay-mismatch",
+                                screen_drops=replay_drops)
+        expect_init = ("self" if own_w > 0
+                       and owner_index not in replay_drops else "zeros")
+        if tr["init"] != expect_init:
+            return ReplayResult(False, "wrong-init",
+                                screen_drops=replay_drops)
+        expect_order = [s for s in sorted(complete)
+                        if s != owner_index and s not in replay_drops]
+        if order != expect_order:
+            return ReplayResult(False, "wrong-screened-order",
+                                screen_drops=replay_drops)
+    else:
+        # streaming rules: only the absolute-norm ceiling applies (the
+        # <4-sender narrowing), and a kept over-ceiling sender — the
+        # OWNER'S OWN contribution included — is a lie
+        ceiling = (screen.policy.abs_norm_ceiling
+                   if screen is not None else 0.0)
+        if ceiling > 0:
+            for s in order:
+                if screen.over_ceiling(parsed[s][1]):
+                    return ReplayResult(False, "kept-over-ceiling-sender")
+            if (tr["init"] == "self" and own_w > 0
+                    and screen.over_ceiling(own[1])):
+                # a below-quorum owner cannot mint itself a huge
+                # "own contribution" to explain a poisoned part
+                return ReplayResult(False, "kept-over-ceiling-sender")
+            for s in claimed_screen:
+                if s in parsed and not screen.over_ceiling(parsed[s][1]):
+                    return ReplayResult(False, "ceiling-drop-not-over")
+            replay_drops = {s: "abs-norm" for s in claimed_screen}
+        elif claimed_screen:
+            return ReplayResult(False, "screen-drop-without-screen")
+        expect_init = ("zeros" if owner_index in claimed_screen
+                       else "self")
+        if tr["init"] != expect_init:
+            # the streaming path initializes from the owner's own
+            # contribution (weight may be 0) unless the owner
+            # ceiling-dropped ITSELF
+            return ReplayResult(False, "wrong-init")
+
+    # 6. re-accumulate: identical f32 operation sequence as the owner
+    if tr["init"] == "self":
+        acc = own[1] * own_w
+        total_w = own_w
+    else:
+        acc = np.zeros(part_elems, np.float32)
+        total_w = 0.0
+    for s in order:
+        w, seg = parsed[s]
+        acc += seg * w
+        total_w += w
+    if total_w <= 0:
+        # the owner should have WITHHELD this part (dead-owner path);
+        # serving bytes for it cannot be honest
+        return ReplayResult(False, "zero-weight-part-served",
+                            screen_drops=replay_drops)
+    averaged = acc / total_w
+
+    # 7. wire-codec round-trip, chunk by chunk, exactly as the gather
+    # phase applies its own broadcast bytes locally
+    out = np.empty(part_elems, np.float32)
+    for clo, chi in chunks:
+        nelem = chi - clo
+        c = (codec if codec is not None
+             else compression.adaptive_codec(nelem, adaptive_threshold))
+        wire = compression.compress(averaged[clo:chi], c)
+        out[clo:chi] = compression.decompress(wire, c, nelem)
+    return ReplayResult(True, values=out, screen_drops=replay_drops)
+
+
+# -- the audit pass (auditor side) -----------------------------------------
+
+def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
+    """Audit every challenged part this peer fully gathered: fetch the
+    owner's transcript, replay it, bit-compare, and strike. Also runs
+    the sender-side omission check for parts this peer's own
+    contribution was transport-acked into. Returns an observability
+    report; strikes land in ``ledger`` (gossipable reasons queue
+    receipts there automatically).
+
+    The replay judges owners by the ROUND'S recorded context
+    (``ra.screen``/``ra.max_peer_weight``/codec — captured by
+    ``begin()``), never by caller-supplied values: a clamp or screen
+    that drifted between the round and a deferred audit would
+    otherwise falsely convict honest owners.
+
+    ``jobs`` > 1 fans the per-part audits out over a thread pool —
+    replay is a pure function of (transcript, group, round context),
+    so parallel audits are bit-equal to serial ones (pinned by test).
+    """
+    report = {"epoch": ra.epoch, "audited": [], "ok": [], "failed": [],
+              "omitted": [], "unserved": []}
+    if not ra.begun:
+        return report
+    my_index = ra.group.my_index
+    todo = [p for p in sorted(ra.audited)
+            if p != ra.my_part and p in ra.gathered]
+
+    def audit_one(p: int) -> Tuple[int, str, str, Dict[int, str]]:
+        owner = ra.owners[p]
+        blob = fetch_transcript(dht, owner.addr, ra.prefix, ra.epoch, p,
+                                ra.policy, group_key=ra.group.group_key)
+        tr = (open_transcript(blob, ra.prefix, ra.epoch, p,
+                              owner.peer_id)
+              if blob is not None else None)
+        if tr is None:
+            return p, "unserved", "", {}
+        res = replay_transcript(
+            tr, group=ra.group, prefix=ra.prefix, epoch=ra.epoch,
+            part=p, part_elems=ra.part_sizes[p],
+            chunk_elems=ra.chunk_elems, codec=ra.codec,
+            adaptive_threshold=ra.adaptive_threshold, screen=ra.screen,
+            max_peer_weight=ra.max_peer_weight)
+        if not res.ok:
+            return p, "failed", res.why, res.screen_drops
+        if res.values.tobytes() != ra.gathered[p].tobytes():
+            return p, "failed", "replayed-bytes-mismatch", res.screen_drops
+        # sender-side omission check: my delivery must be accounted for
+        if (p in ra.scatter_ok and my_index not in tr["frames"]
+                and my_index not in tr["drops"]):
+            return p, "omitted", "", res.screen_drops
+        return p, "ok", "", res.screen_drops
+
+    if jobs > 1 and len(todo) > 1:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(jobs, len(todo))) as pool:
+            futs = [pool.submit(audit_one, p) for p in todo]
+            # every future is read: a failed audit must surface, not
+            # vanish in an unread Future
+            outcomes = [f.result() for f in futs]
+    else:
+        outcomes = [audit_one(p) for p in todo]
+
+    for p, status, why, screen_drops in outcomes:
+        owner_pid = ra.owners[p].peer_id
+        entry = {"part": p, "owner": owner_pid, "why": why,
+                 "screen_drops": {int(k): v
+                                  for k, v in screen_drops.items()}}
+        report["audited"].append(p)
+        if status == "unserved":
+            # silence: local, timeout-weighted, never gossiped
+            if ledger is not None:
+                ledger.strike(owner_pid, AUDIT_TIMEOUT_REASON)
+            report["unserved"].append(entry)
+            logger.warning(
+                "audit: part %d owner %s never served its challenged "
+                "transcript (epoch %d) — audit-timeout strike",
+                p, owner_pid[:16], ra.epoch)
+        elif status == "failed":
+            if ledger is not None:
+                ledger.strike(owner_pid, AUDIT_FAIL_REASON)
+            report["failed"].append(entry)
+            logger.warning(
+                "audit: part %d owner %s FAILED replay (%s, epoch %d) — "
+                "owner-audit-fail strike (receipt gossiped)",
+                p, owner_pid[:16], why, ra.epoch)
+        elif status == "omitted":
+            if ledger is not None:
+                ledger.strike(owner_pid, AUDIT_OMIT_REASON)
+            report["omitted"].append(entry)
+            logger.warning(
+                "audit: part %d owner %s omitted this peer's DELIVERED "
+                "contribution from its transcript (epoch %d) — "
+                "owner-audit-omit strike", p, owner_pid[:16], ra.epoch)
+        else:
+            report["ok"].append(entry)
+    return report
+
+
+class AuditWorker(threading.Thread):
+    """Background auditor: drains completed rounds' :class:`RoundAudit`
+    objects and runs :func:`audit_round` over each, off the training
+    thread. Lifecycle mirrors StrikeGossip: daemon worker, ``stop()``
+    signals AND bounded-joins (an in-flight fetch on a torn-down DHT
+    is a use-after-free), ``step()`` drains synchronously for tests
+    and the soak.
+    """
+
+    #: pending-round bound: auditing is best-effort observability — a
+    #: backlogged worker drops the OLDEST round (its transcripts are
+    #: expiring anyway) rather than growing without bound
+    MAX_PENDING = 8
+
+    def __init__(self, dht, ledger, *, period: float = 0.5,
+                 jobs: int = 1):
+        super().__init__(daemon=True, name="audit-worker")
+        self.dht = dht
+        self.ledger = ledger
+        self.period = period
+        self.jobs = jobs
+        self._stop_event = threading.Event()
+        self._lock = threading.Lock()
+        self._pending: deque = deque()
+        self.audited = 0            # observability counters
+        self.failures = 0
+        self.omissions = 0
+        self.unserved = 0
+        self.last_report: Optional[dict] = None
+
+    def submit(self, ra: RoundAudit) -> None:
+        if ra is None or not ra.begun:
+            return
+        with self._lock:
+            if len(self._pending) >= self.MAX_PENDING:
+                dropped = self._pending.popleft()
+                logger.warning(
+                    "audit worker backlogged: dropping epoch %d audit",
+                    dropped.epoch)
+            self._pending.append(ra)
+
+    def step(self) -> int:
+        """Drain and audit every pending round synchronously; returns
+        the number of rounds audited."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return n
+                ra = self._pending.popleft()
+            rep = audit_round(self.dht, ra, self.ledger,
+                              jobs=self.jobs)
+            with self._lock:
+                self.audited += len(rep["audited"])
+                self.failures += len(rep["failed"])
+                self.omissions += len(rep["omitted"])
+                self.unserved += len(rep["unserved"])
+                self.last_report = rep
+            n += 1
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - auditing must not die
+                logger.warning("audit round failed", exc_info=True)
+            self._stop_event.wait(max(0.05, self.period))
+
+    def stop(self, join_timeout: Optional[float] = 10.0) -> None:
+        """Signal AND (bounded) join before the owner tears the DHT
+        down — an in-flight transcript fetch on a destroyed native
+        node is a use-after-free. ``join_timeout=None`` skips the
+        join (signal-only)."""
+        self._stop_event.set()
+        if join_timeout is not None and self.is_alive() \
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)

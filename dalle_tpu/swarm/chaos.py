@@ -62,12 +62,21 @@ FAULT_OPS = ("send", "recv", "fetch", "store", "get", "post")
 MAX_INJECTED_SLEEP_S = 5.0
 
 #: byzantine attack kinds a plan may inject. Unlike every transport
-#: fault above, these fire ABOVE the signature: the peer's own
-#: contribution is rewritten before it is flattened and signed, so the
-#: wire carries validly-signed wrong data — the attack class the
+#: fault above, these fire ABOVE the signature. SENDER kinds rewrite
+#: the peer's own contribution before it is flattened and signed, so
+#: the wire carries validly-signed wrong data — the attack class the
 #: content screen (swarm/screening.py) exists to catch, invisible to
-#: signature checks and strict parsing by construction.
-BYZANTINE_KINDS = ("sign_flip", "scale", "garbage", "weight_inflate")
+#: signature checks and strict parsing by construction. OWNER kinds
+#: fire at the part-owner seam instead: the peer screens and averages
+#: honestly, then serves a WRONG gather part (``wrong_gather_part``)
+#: or silently discards one delivered sender's contribution
+#: (``omit_sender``) — the attack class the aggregation AUDIT
+#: (swarm/audit.py) exists to catch, invisible to every input-side
+#: defense by construction.
+SENDER_BYZANTINE_KINDS = ("sign_flip", "scale", "garbage",
+                          "weight_inflate")
+OWNER_BYZANTINE_KINDS = ("wrong_gather_part", "omit_sender")
+BYZANTINE_KINDS = SENDER_BYZANTINE_KINDS + OWNER_BYZANTINE_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,9 +171,17 @@ class ByzantineOp:
       attacker's REAL identity like any honest contribution;
     - ``weight_inflate`` — claim ``factor`` as the frame weight on the
       wire (the classic "my batch was 1e9 samples"); the data itself
-      stays honest, so only the weight clamp can catch it.
+      stays honest, so only the weight clamp can catch it;
+    - ``wrong_gather_part`` — OWNER seam: screen and average honestly,
+      then serve ``averaged + factor`` as the gather part (every
+      input-side defense stays quiet; only the replay audit sees it);
+    - ``omit_sender`` — OWNER seam: silently discard the delivered
+      contribution of the lowest-peer-id sender, leaving no drop-set
+      trace (``factor`` unused; the sender-side omission audit is the
+      only defense with standing to catch it).
 
-    The first active op wins (FaultRule precedence semantics).
+    The first active op of the relevant seam wins (FaultRule
+    precedence semantics, per seam).
     """
 
     kind: str
@@ -186,6 +203,10 @@ class ByzantineOp:
         if self.kind == "scale" and self.factor == 0:
             raise ValueError("scale factor 0 is a zero contribution, "
                              "not an attack; use garbage instead")
+        if self.kind == "wrong_gather_part" and self.factor == 0:
+            raise ValueError("wrong_gather_part factor 0 serves the "
+                             "HONEST part (factor is the additive "
+                             "perturbation); use a nonzero factor")
         if self.start_epoch < 0 or (self.end_epoch is not None
                                     and self.end_epoch < self.start_epoch):
             raise ValueError(
@@ -356,23 +377,29 @@ class ChaosDHT:
             return True
         return False
 
-    def byzantine_op(self, epoch: int) -> Optional[ByzantineOp]:
-        """The first byzantine clause active at ``epoch``, or None."""
+    def byzantine_op(self, epoch: int,
+                     kinds: Tuple[str, ...] = BYZANTINE_KINDS
+                     ) -> Optional[ByzantineOp]:
+        """The first byzantine clause of one of ``kinds`` active at
+        ``epoch``, or None. The sender seam and the owner seam filter
+        to their own kinds, so one plan can carry both attack
+        classes."""
         for op in self.plan.byzantine:
-            if op.active(epoch):
+            if op.kind in kinds and op.active(epoch):
                 return op
         return None
 
     def tamper_contribution(self, epoch: int, tensors, weight: float):
-        """The byzantine injection seam, called by ``run_allreduce``
-        BEFORE flatten and signing: returns (tensors, frame_weight) —
-        possibly rewritten — so the wire carries this peer's
-        valid-but-wrong contribution under its real identity. The
-        garbage draw is deterministic in (plan.seed, epoch), keeping
-        soak runs seed-reproducible. A plan with no byzantine clauses
-        (or none active this epoch) returns the inputs untouched, so
-        an inert wrapper stays bit-transparent."""
-        op = self.byzantine_op(epoch)
+        """The SENDER byzantine injection seam, called by
+        ``run_allreduce`` BEFORE flatten and signing: returns
+        (tensors, frame_weight) — possibly rewritten — so the wire
+        carries this peer's valid-but-wrong contribution under its
+        real identity. The garbage draw is deterministic in
+        (plan.seed, epoch), keeping soak runs seed-reproducible. A
+        plan with no byzantine clauses (or none active this epoch)
+        returns the inputs untouched, so an inert wrapper stays
+        bit-transparent."""
+        op = self.byzantine_op(epoch, SENDER_BYZANTINE_KINDS)
         if op is None:
             return tensors, weight
         import numpy as np
@@ -394,6 +421,37 @@ class ChaosDHT:
             int.from_bytes(digest[:4], "big"))
         return [rng.standard_normal(np.shape(t)).astype(np.float32)
                 * np.float32(abs(op.factor)) for t in tensors], weight
+
+    def tamper_gather_part(self, epoch: int, part: int, values):
+        """The OWNER byzantine seam, called by ``run_allreduce`` after
+        the honest average (and after the audit transcript is
+        recorded): an active ``wrong_gather_part`` op perturbs the
+        part this owner is about to serve by ``+factor`` per element —
+        a plausible, finite, validly-signed wrong part that no
+        input-side defense can see. Inert plans return ``values``
+        untouched (bit-transparency)."""
+        op = self.byzantine_op(epoch, ("wrong_gather_part",))
+        if op is None:
+            return values
+        import numpy as np
+        self._count("byz_wrong_gather_part")
+        logger.warning("chaos: wrong_gather_part active at epoch %d "
+                       "(part %d, +%r)", epoch, part, op.factor)
+        return np.asarray(values, np.float32) + np.float32(op.factor)
+
+    def omit_sender_target(self, epoch: int, candidate_pids):
+        """The OWNER omission seam: an active ``omit_sender`` op names
+        the lowest-peer-id candidate (deterministic given the roster)
+        whose delivered contribution this owner silently discards —
+        no ban, no transcript entry. None when inert."""
+        op = self.byzantine_op(epoch, ("omit_sender",))
+        if op is None or not candidate_pids:
+            return None
+        victim = min(candidate_pids)
+        self._count("byz_omit_sender")
+        logger.warning("chaos: omit_sender active at epoch %d "
+                       "(victim %s)", epoch, victim[:16])
+        return victim
 
     # -- deterministic decisions -------------------------------------------
 

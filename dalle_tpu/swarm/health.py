@@ -70,6 +70,14 @@ STRIKE_WEIGHTS = {
     "screen-outlier": 2.0,      # validly signed, content-outlying data
     "weight-overclaim": 2.0,    # validly signed absurd frame weight
     "progress-overclaim": 1.0,  # absurd signed progress claim
+    "owner-audit-fail": 2.0,    # served a part its own signed
+                                # transcript cannot explain (replay
+                                # mismatch — swarm/audit.py)
+    "owner-audit-omit": 2.0,    # omitted this node's DELIVERED frames
+                                # from its transcript (only the victim
+                                # has standing: never gossiped)
+    "audit-timeout": 1.0,       # challenged owner never served a
+                                # transcript (silence: never gossiped)
     "reduce-timeout": 1.0,      # never delivered its contribution
     "gather-timeout": 1.0,      # owned a part and never served it
     "confirm-timeout": 0.5,     # announced leader, never confirmed
@@ -82,7 +90,7 @@ STRIKE_WEIGHTS = {
 #: deliberately absent (see module docstring).
 GOSSIP_REASONS = frozenset({
     "corrupt-chunk", "screen-outlier", "weight-overclaim",
-    "progress-overclaim"})
+    "progress-overclaim", "owner-audit-fail"})
 
 #: receipts, events and seen-sets are bounded everywhere: gossip is an
 #: attacker-writable plane and must not become a memory amplifier

@@ -155,7 +155,9 @@ class CollaborativeOptimizer:
             self.ledger = PeerHealthLedger()
             self.tracker = ProgressTracker(
                 dht, cfg.run_id, cfg.target_batch_size,
-                client_mode=client_mode, ledger=self.ledger)
+                client_mode=client_mode, ledger=self.ledger,
+                max_epoch_lead=getattr(cfg, "progress_max_epoch_lead",
+                                       2))
             if getattr(cfg, "screen_gradients", False):
                 from dalle_tpu.swarm.screening import (GradientScreen,
                                                        ScreenPolicy)
@@ -163,7 +165,9 @@ class CollaborativeOptimizer:
                     min_senders=cfg.screen_min_senders,
                     max_drop_frac=cfg.screen_max_drop_frac,
                     norm_tolerance=cfg.screen_norm_tolerance,
-                    cosine_floor=cfg.screen_cosine_floor))
+                    cosine_floor=cfg.screen_cosine_floor,
+                    abs_norm_ceiling=getattr(
+                        cfg, "screen_abs_norm_ceiling", 0.0)))
             else:
                 self._screen = None
             mpw = getattr(cfg, "max_peer_weight", None)
@@ -175,11 +179,27 @@ class CollaborativeOptimizer:
                     dht, self.ledger, cfg.run_id,
                     period=cfg.strike_gossip_period)
                 self._gossip.start()
+            # Verified aggregation (swarm/audit.py): the worker drains
+            # completed rounds' RoundAudit retention off the training
+            # thread — fetches challenged owners' transcripts, replays
+            # the averages, bit-compares, and strikes (a replay
+            # mismatch gossips through the receipt plane above).
+            # Reaped by shutdown() before the DHT goes down.
+            self._auditor = None
+            self._audit_policy = None
+            if getattr(cfg, "audit_gather", False):
+                from dalle_tpu.swarm.audit import AuditPolicy, AuditWorker
+                self._audit_policy = AuditPolicy(
+                    frac=cfg.audit_frac, ttl=cfg.audit_ttl)
+                self._auditor = AuditWorker(dht, self.ledger)
+                self._auditor.start()
         else:
             self.ledger = None
             self.tracker = _FollowerTracker()
             self._screen = None
             self._max_peer_weight = None
+            self._auditor = None
+            self._audit_policy = None
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
         # Wire-codec execution backend (swarm/device_codec.py): "device"
@@ -367,6 +387,18 @@ class CollaborativeOptimizer:
         return (self.cfg.delay_optimizer_step and self.role.swarm_enabled
                 and process_count() == 1)
 
+    def _new_round_audit(self, epoch: int):
+        """A fresh per-round audit container for the main gradient
+        all-reduce, or None when auditing is off. PowerSGD factor
+        rounds and state averaging run unaudited for now: their
+        prefixes differ per phase and their value is bounded by the
+        audited gradient path (documented in CHAOS.md)."""
+        if self._auditor is None:
+            return None
+        from dalle_tpu.swarm.audit import RoundAudit
+        return RoundAudit(f"{self.cfg.run_id}_grads", epoch,
+                          self._audit_policy)
+
     def _launch_round(self) -> None:
         """Hand the gradient accumulator to a background wire thread and
         start a fresh buffer; the epoch advances when the round's result
@@ -429,6 +461,7 @@ class CollaborativeOptimizer:
                                        for g in pending.leaves]
                     pending.timings["grad_pull_s"] = round(
                         time.monotonic() - t_pull, 4)
+                    ra = self._new_round_audit(pending.epoch)
                     averaged = run_allreduce(
                         self.dht, group, f"{self.cfg.run_id}_grads",
                         pending.epoch, grads_local, weight=pending.weight,
@@ -436,7 +469,10 @@ class CollaborativeOptimizer:
                         adaptive_threshold=self.cfg.size_adaptive_threshold,
                         codec_backend=self._codec_backend,
                         ledger=self.ledger, screen=self._screen,
-                        max_peer_weight=self._max_peer_weight)
+                        max_peer_weight=self._max_peer_weight,
+                        audit=ra)
+                    if ra is not None:
+                        self._auditor.submit(ra)
                 pending.result = averaged
                 pending.timings["allreduce_s"] = round(
                     time.monotonic() - t_match, 4)
@@ -599,6 +635,7 @@ class CollaborativeOptimizer:
                                              sharded),
                     epoch=self.local_epoch)
             else:
+                ra = self._new_round_audit(self.local_epoch)
                 averaged = run_allreduce(
                     self.dht, group, f"{self.cfg.run_id}_grads",
                     self.local_epoch, grads_local, weight=weight,
@@ -606,7 +643,10 @@ class CollaborativeOptimizer:
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
                     codec_backend=self._codec_backend, ledger=self.ledger,
                     screen=self._screen,
-                    max_peer_weight=self._max_peer_weight)
+                    max_peer_weight=self._max_peer_weight,
+                    audit=ra)
+                if ra is not None:
+                    self._auditor.submit(ra)
         else:
             # alone this epoch: with a deferred pull the grads never left
             # the device — they flow straight into the jitted apply
@@ -938,6 +978,11 @@ class CollaborativeOptimizer:
             # node is a use-after-free (dht.shutdown ordering contract)
             self._gossip.stop()
             self._gossip = None
+        if self._auditor is not None:
+            # same ordering contract: an in-flight transcript fetch on
+            # a destroyed native node is a use-after-free
+            self._auditor.stop()
+            self._auditor = None
 
     def __enter__(self) -> "CollaborativeOptimizer":
         return self

@@ -98,7 +98,8 @@ class ProgressTracker:
                  client_mode: bool = False,
                  ledger=None,
                  max_peer_samples: Optional[int] = None,
-                 overclaim_factor: float = 100.0):
+                 overclaim_factor: float = 100.0,
+                 max_epoch_lead: int = 2):
         self.dht = dht
         self.key = f"{run_id}_progress"
         self.target_batch_size = target_batch_size
@@ -133,6 +134,29 @@ class ProgressTracker:
                                  if max_peer_samples is None
                                  else int(max_peer_samples))
         self.overclaim_factor = overclaim_factor
+        # Plausible-lead bound on epoch claims (the epoch twin of the
+        # sample cap): the aggregate epoch is max-over-peers, so ONE
+        # signed record claiming epoch 10^9 would otherwise drag every
+        # honest clock (and the resync target) arbitrarily far. The
+        # CLAMP is the defense: a claim may lead this node's local
+        # epoch by at most ``max_epoch_lead`` in the aggregate — an
+        # honestly-ahead swarm still pulls us forward (the clamp
+        # window slides as we catch up, and a state download adopts
+        # the server's true epoch regardless). A clamped record also
+        # contributes ZERO samples: its samples belong to a round this
+        # node cannot place, and merging far-future buckets into the
+        # clamped epoch would both overstate progress and hand a liar
+        # ready_to_update. The STRIKE mirrors the samples 100x rule —
+        # only a lead beyond ``overclaim_factor x max_epoch_lead`` is
+        # even a candidate — AND additionally requires an in-bound
+        # corroborating reporter (some OTHER peer whose claim is
+        # within the bound): if every other reporter is also far
+        # ahead, the anomalous clock is OURS (a restart, a resumed
+        # checkpoint, a long partition), and striking the whole
+        # honest swarm — receipts gossiped — would be this node
+        # self-isolating. Honest overshoot is pinned by the
+        # slow-round honest-overshoot test. 0 disables the bound.
+        self.max_epoch_lead = int(max_epoch_lead)
         self._overclaim_struck: set = set()
         self.performance_ema = PerformanceEMA()
         self.local_epoch = 0
@@ -187,6 +211,7 @@ class ProgressTracker:
 
         entries = self.dht.get(self.key) or {}
         by_peer = {}
+        records = []
         # liveness = record TTL: dead peers' entries expire out of the DHT
         for subkey, item in entries.items():
             rec = item.value
@@ -213,6 +238,49 @@ class ProgressTracker:
                 continue
             if prog.samples_accumulated < 0:
                 continue  # nonsense claim: not part of our clock
+            records.append((bound, prog))
+        # reporters whose epoch claim is inside the plausible-lead
+        # window — the strike's corroboration cohort (see __init__)
+        in_bound = {b for b, p in records
+                    if p.epoch - self.local_epoch <= self.max_epoch_lead}
+        for bound, prog in records:
+            lead = prog.epoch - self.local_epoch
+            if self.max_epoch_lead > 0 and lead > self.max_epoch_lead:
+                corroborated = any(b != bound and b != self.dht.peer_id
+                                   for b in in_bound)
+                if (bound != self.dht.peer_id
+                        and self.ledger is not None
+                        and corroborated
+                        and lead > self.overclaim_factor
+                        * self.max_epoch_lead
+                        and ("lead", bound, prog.epoch)
+                        not in self._overclaim_struck
+                        and len(self._overclaim_struck) < 4096):
+                    # strike only the unambiguous fabrication: beyond
+                    # 100x the bound (the samples rule's epoch twin)
+                    # AND outlying against an in-bound cohort — when
+                    # every other reporter is also far ahead, the
+                    # stale clock is OURS (restart/partition), and
+                    # with no third reporter it is one clock's word
+                    # against another's (the 2-peer unattributability
+                    # rule). Honest overshoot is clamped, never
+                    # struck.
+                    self._overclaim_struck.add(
+                        ("lead", bound, prog.epoch))
+                    self.ledger.strike(bound, "progress-overclaim")
+                    logger.warning(
+                        "progress: peer %s claims epoch %d (local %d, "
+                        "max plausible lead %d) — clamped and struck",
+                        bound[:16], prog.epoch, self.local_epoch,
+                        self.max_epoch_lead)
+                # clamp the clock pull AND zero the samples: they
+                # belong to a round this node cannot place, and
+                # merging far-future buckets into the clamped epoch
+                # would overstate progress (or hand a fabricated
+                # claim ready_to_update)
+                prog = dataclasses.replace(
+                    prog, epoch=self.local_epoch + self.max_epoch_lead,
+                    samples_accumulated=0)
             cap = self.max_peer_samples
             if cap > 0 and prog.samples_accumulated > cap:
                 if (bound != self.dht.peer_id

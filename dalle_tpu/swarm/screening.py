@@ -97,6 +97,17 @@ class ScreenPolicy:
     #: strongly anti-correlated — -0.5 is far outside the honest
     #: envelope while a sign flip sits at exactly -1
     cosine_floor: float = -0.5
+    #: ABSOLUTE per-sender L2 norm ceiling, active at ANY sender count
+    #: (unlike the relative checks above it needs no leave-one-out
+    #: consensus) — it narrows the <4-sender gap where LOO screening
+    #: must skip. 0 disables. Below ``min_senders`` the drop carries
+    #: NO strike (2-peer unattributability: with two peers either
+    #: could be the liar about what "too big" means — the clamp is the
+    #: defense, the strike needs a quorum). Deployments size it well
+    #: above the honest gradient envelope (e.g. 10-100x the expected
+    #: accumulated-gradient norm); there is deliberately no finite
+    #: default — an absolute bound is model- and scale-specific.
+    abs_norm_ceiling: float = 0.0
 
     def __post_init__(self):
         if self.min_senders < 3:
@@ -114,6 +125,10 @@ class ScreenPolicy:
         if not -1.0 <= self.cosine_floor <= 1.0:
             raise ValueError(
                 f"cosine_floor must be in [-1, 1], got {self.cosine_floor}")
+        if self.abs_norm_ceiling < 0:
+            raise ValueError(
+                f"abs_norm_ceiling must be >= 0 (0 disables), "
+                f"got {self.abs_norm_ceiling}")
 
 
 @dataclasses.dataclass
@@ -131,6 +146,11 @@ class ScreenVerdict:
     dropped: Dict[int, str] = dataclasses.field(default_factory=dict)
     skipped: bool = False
     stats: Dict[int, Tuple[float, float]] = dataclasses.field(
+        default_factory=dict)
+    #: drops that must NOT feed the ledger: absolute-ceiling drops
+    #: made below the ``min_senders`` quorum (drop the data, withhold
+    #: the strike — the small-swarm unattributability rule)
+    dropped_unstruck: Dict[int, str] = dataclasses.field(
         default_factory=dict)
 
 
@@ -152,6 +172,21 @@ class GradientScreen:
     @staticmethod
     def _finite(seg: np.ndarray) -> bool:
         return bool(np.isfinite(seg).all())
+
+    @staticmethod
+    def _abs_norm(seg: np.ndarray) -> float:
+        """f64 L2 norm — the determinism surface the audit replay
+        recomputes bit-equal."""
+        return float(np.linalg.norm(
+            np.asarray(seg).astype(np.float64)))
+
+    def over_ceiling(self, seg: np.ndarray) -> bool:
+        """Whether a segment violates the absolute-norm ceiling; the
+        streaming (below-quorum) allreduce path calls this per
+        completed sender, and the audit replay re-applies the same
+        predicate. False whenever the ceiling is disabled."""
+        c = self.policy.abs_norm_ceiling
+        return c > 0 and self._abs_norm(seg) > c
 
     @staticmethod
     def _measure(contribs: Dict[int, Tuple[float, np.ndarray]],
@@ -207,11 +242,25 @@ class GradientScreen:
                 verdict.dropped[k] = "nonfinite"
             else:
                 survivors.append(k)
-        if len(survivors) + len(verdict.dropped) < pol.min_senders:
+        # the absolute ceiling runs at ANY sender count (it needs no
+        # leave-one-out consensus); whether the drop STRIKES depends
+        # on the quorum below
+        over: Dict[int, str] = {}
+        if pol.abs_norm_ceiling > 0:
+            for k in list(survivors):
+                nrm = self._abs_norm(contribs[k][1])
+                if nrm > pol.abs_norm_ceiling:
+                    over[k] = f"abs-norm {nrm:.4g}"
+                    survivors.remove(k)
+        if (len(survivors) + len(verdict.dropped)
+                + len(over)) < pol.min_senders:
             # small swarm: outlier screening is one peer's word against
-            # another's — only the unambiguous non-finite check applies
+            # another's — only the unambiguous non-finite check applies,
+            # and ceiling drops are made WITHOUT a strike
             verdict.skipped = True
+            verdict.dropped_unstruck.update(over)
             return verdict
+        verdict.dropped.update(over)
         # the drop budget covers OUTLIER drops; the minimum survivor
         # count keeps a majority alive by construction
         budget = int(pol.max_drop_frac * len(survivors))

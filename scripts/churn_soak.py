@@ -62,12 +62,40 @@ strike receipts (swarm/health.py). Two passes share one schedule:
 Results land in BYZANTINE_SOAK.json. The fast tier-1 variant and the
 slow-marked full soak live in tests/test_screening.py.
 
+**Hostile-owner mode** (``--hostile-owner``, CHAOS.md "Verified
+aggregation"): the same harness pointed at the aggregation's OUTPUT
+trust model. Every peer arms the full defense stack PLUS the audit
+layer (swarm/audit.py, frac=1.0: every part challenged every round,
+audited synchronously each epoch so conviction latency is measured in
+epochs). THREE passes share one seeded schedule:
+
+- a **control** pass (attacks stripped, audits ON) — the
+  false-positive oracle: ZERO strikes of any kind (audit strikes
+  included) and bit-exact convergence to the analytic reference, i.e.
+  audit-enabled honest rounds are byte-identical to the r13 rounds;
+- the **attack** pass — one ``wrong_gather_part`` owner (screens and
+  averages honestly, serves a wrong part) and one ``omit_sender``
+  owner (silently discards the lowest-peer-id sender's delivered
+  contribution). Oracles: every honest peer's replay audit convicts
+  the wrong-part owner within <= 2 epochs of the attack starting,
+  with the ``owner-audit-fail`` strike in its ledger AND gossiped
+  remote receipts corroborating; the omitted victim's ledger gains
+  the ``owner-audit-omit`` strike within <= 2 epochs; both attack
+  seams actually fired (injected counters);
+- a **transparency** pass (attacks stripped, audits OFF) — the
+  audits-disabled pin: rounds behave byte-identically to the
+  pre-audit protocol (bit-exact analytic convergence, zero strikes).
+
+Results land in HOSTILE_OWNER_SOAK.json. The fast tier-1 variant and
+the slow-marked full soak live in tests/test_audit.py.
+
 Usage::
 
     python scripts/churn_soak.py                  # full soak, defaults
     python scripts/churn_soak.py --peers 3 --epochs 4 --kills 1 \
         --joins 1 --matchmaking-time 1.2 --allreduce-timeout 5
     python scripts/churn_soak.py --byzantine      # byzantine gate
+    python scripts/churn_soak.py --hostile-owner  # aggregation audit gate
 """
 
 from __future__ import annotations
@@ -90,6 +118,8 @@ import numpy as np  # noqa: E402
 from dalle_tpu.swarm import DHT, Identity  # noqa: E402
 from dalle_tpu.swarm import compression  # noqa: E402
 from dalle_tpu.swarm.allreduce import run_allreduce  # noqa: E402
+from dalle_tpu.swarm.audit import (AuditPolicy, RoundAudit,  # noqa: E402
+                                   audit_round)
 from dalle_tpu.swarm.chaos import (Blackout, ByzantineOp,  # noqa: E402
                                    ChaosDHT, FaultPlan)
 from dalle_tpu.swarm.health import (PeerHealthLedger,  # noqa: E402
@@ -114,6 +144,22 @@ def grads_for_epoch(epoch: int, n: int = STATE_ELEMS) -> np.ndarray:
     == g in IEEE f32 when k*g is exact) — the convergence oracle."""
     rng = np.random.RandomState(1000 + epoch)
     return rng.randint(-8, 9, size=n).astype(np.float32)
+
+
+def settle_threads(threads_before: set,
+                   budget_s: float = 5.0) -> List[str]:
+    """Wait (bounded) for every thread born during the soak to die;
+    returns the names still alive — the thread-hygiene oracle every
+    gate shares."""
+    settle = time.monotonic() + budget_s
+    leaked: List[str] = []
+    while time.monotonic() < settle:
+        leaked = [t.name for t in threading.enumerate()
+                  if t not in threads_before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.2)
+    return leaked
 
 
 def build_schedule(seed: int, n_peers: int, epochs: int, kills: int,
@@ -155,7 +201,8 @@ class SoakPeer:
                  state: Optional[np.ndarray] = None, epoch: int = 0,
                  screen: Optional[GradientScreen] = None,
                  max_peer_weight: Optional[float] = None,
-                 gossip: bool = False):
+                 gossip: bool = False,
+                 audit_policy: Optional[AuditPolicy] = None):
         self.name = name
         self.node = node
         self.dht = ChaosDHT(node, plan) if plan.enabled else node
@@ -184,6 +231,13 @@ class SoakPeer:
         # the byzantine soak's "struck within <= 2 epochs" oracle
         self.first_strike: Dict[str, int] = {}
         self.first_remote: Dict[str, int] = {}
+        # hostile-owner mode: the verified-aggregation layer, run
+        # SYNCHRONOUSLY after each round so conviction latency is
+        # deterministic relative to the epoch clock the oracles use
+        self.audit_policy = audit_policy
+        # offender pid -> first epoch each audit verdict class fired
+        self.audit_events: Dict[str, Dict[str, int]] = {
+            "fail": {}, "omit": {}, "unserved": {}}
         self.died = False
         self.errors: List[str] = []
         self.server = StateServer(self.dht, prefix, self._provide,
@@ -214,6 +268,9 @@ class SoakPeer:
                     return
                 grads = grads_for_epoch(self.epoch)
                 averaged = grads
+                ra = (RoundAudit(self.prefix, self.epoch,
+                                 self.audit_policy)
+                      if self.audit_policy is not None else None)
                 try:
                     g = make_group(self.dht, self.prefix,
                                    epoch=self.epoch, weight=1.0,
@@ -227,13 +284,26 @@ class SoakPeer:
                             sender_timeout=min(2.0, self.at / 3),
                             codec=compression.NONE, ledger=self.ledger,
                             screen=self.screen,
-                            max_peer_weight=self.max_peer_weight)
+                            max_peer_weight=self.max_peer_weight,
+                            audit=ra)
                         averaged = out[0]
                 except Exception as e:  # noqa: BLE001 - degraded epoch
                     # a failed round is an ALONE-equivalent epoch (the
                     # optimizer's elasticity contract), never a wedge
                     self.errors.append(f"epoch {self.epoch}: {e!r}")
                     averaged = grads
+                if ra is not None and ra.begun:
+                    try:
+                        rep = audit_round(self.dht, ra, self.ledger)
+                        for cls, key in (("failed", "fail"),
+                                         ("omitted", "omit"),
+                                         ("unserved", "unserved")):
+                            for entry in rep[cls]:
+                                self.audit_events[key].setdefault(
+                                    entry["owner"], self.epoch)
+                    except Exception as e:  # noqa: BLE001 - degraded
+                        self.errors.append(
+                            f"audit at epoch {self.epoch}: {e!r}")
                 self.ledger.advance_epoch(self.epoch)
                 if self.gossip is not None:
                     try:
@@ -279,6 +349,8 @@ class SoakPeer:
                     "strikes": self.ledger.snapshot(),
                     "first_strike": dict(self.first_strike),
                     "first_remote": dict(self.first_remote),
+                    "audit_events": {k: dict(v) for k, v
+                                     in self.audit_events.items()},
                     "peer_id": self.node.peer_id,
                     "injected": dict(getattr(self.dht, "injected", {}))}
 
@@ -417,14 +489,7 @@ def run_soak(args) -> dict:
             "or partial data reached a state accumulator")
 
     # -- thread hygiene ---------------------------------------------------
-    settle = time.monotonic() + 5.0
-    leaked: List[str] = []
-    while time.monotonic() < settle:
-        leaked = [t.name for t in threading.enumerate()
-                  if t not in threads_before and t.is_alive()]
-        if not leaked:
-            break
-        time.sleep(0.2)
+    leaked = settle_threads(threads_before)
     if leaked:
         violations.append(f"leaked threads: {leaked}")
 
@@ -558,14 +623,7 @@ def run_byzantine(args) -> dict:
                     f"(first: {remote})")
 
     # -- thread hygiene ----------------------------------------------------
-    settle = time.monotonic() + 5.0
-    leaked: List[str] = []
-    while time.monotonic() < settle:
-        leaked = [t.name for t in threading.enumerate()
-                  if t not in threads_before and t.is_alive()]
-        if not leaked:
-            break
-        time.sleep(0.2)
+    leaked = settle_threads(threads_before)
     if leaked:
         violations.append(f"leaked threads: {leaked}")
 
@@ -577,6 +635,189 @@ def run_byzantine(args) -> dict:
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "control": control, "attack": attack,
+            "violations": violations, "pass": not violations}
+
+
+def build_hostile_schedule(seed: int, n_peers: int, epochs: int) -> dict:
+    """Seeded hostile-owner assignment: one ``wrong_gather_part`` and
+    one ``omit_sender`` attacker, distinct peers, active from epoch 0.
+    Deterministic in the seed, recorded in the report."""
+    rng = random.Random(seed ^ 0xA0D17)
+    wrong, omit = rng.sample(range(n_peers), 2)
+    return {"seed": seed, "epochs": epochs,
+            "attacks": [
+                {"peer": wrong, "kind": "wrong_gather_part",
+                 "factor": 10.0, "start_epoch": 0},
+                {"peer": omit, "kind": "omit_sender", "factor": 1.0,
+                 "start_epoch": 0}]}
+
+
+def _hostile_pass(args, schedule: dict, attacks_on: bool,
+                  audits_on: bool, violations: List[str],
+                  tag: str) -> List[Dict]:
+    """One full swarm run of the hostile-owner schedule. Every peer
+    arms screen + clamp + gossip; ``audits_on`` additionally arms the
+    verified-aggregation layer (frac=1.0 — every part challenged every
+    round). Liveness violations land in ``violations``."""
+    prefix = f"ho{args.seed}{tag}"
+    by_peer = {}
+    if attacks_on:
+        for a in schedule["attacks"]:
+            by_peer.setdefault(a["peer"], []).append(ByzantineOp(
+                kind=a["kind"], factor=a["factor"],
+                start_epoch=a["start_epoch"]))
+    policy = AuditPolicy(frac=1.0, ttl=max(60.0, 4 * args.deadline
+                                           / max(1, args.epochs)),
+                         fetch_timeout=2.0, fetch_retries=3) \
+        if audits_on else None
+    deadline = time.monotonic() + args.deadline
+    nodes: List[DHT] = []
+    for i in range(args.peers):
+        boots = [nodes[0].visible_address] if nodes else []
+        nodes.append(DHT(initial_peers=boots,
+                         identity=Identity.generate(), rpc_timeout=2.0))
+    peers = [
+        SoakPeer(f"peer{i}", node,
+                 FaultPlan(seed=args.seed,
+                           byzantine=tuple(by_peer.get(i, ()))),
+                 prefix, target_epochs=args.epochs, deadline=deadline,
+                 matchmaking_time=args.matchmaking_time,
+                 allreduce_timeout=args.allreduce_timeout,
+                 screen=GradientScreen(ScreenPolicy()),
+                 max_peer_weight=100.0, gossip=True,
+                 audit_policy=policy)
+        for i, node in enumerate(nodes)]
+    for p in peers:
+        p.start()
+    while time.monotonic() < deadline:
+        if all(not p.thread.is_alive() for p in peers):
+            break
+        time.sleep(0.2)
+    for p in peers:
+        p.finish()
+    results = []
+    attacker_idx = {a["peer"] for a in schedule["attacks"]} \
+        if attacks_on else set()
+    for i, p in enumerate(peers):
+        r = p.result(killed=False)
+        r["attacker"] = i in attacker_idx
+        r["attack_kind"] = next(
+            (a["kind"] for a in schedule["attacks"] if a["peer"] == i),
+            None) if attacks_on else None
+        results.append(r)
+        if r["final_epoch"] < args.epochs:
+            violations.append(
+                f"[{tag}] {r['name']} wedged: epoch "
+                f"{r['final_epoch']}/{args.epochs} at the deadline")
+    return results
+
+
+def run_hostile(args) -> dict:
+    """The hostile-owner gate: control pass (audits ON, attacks off —
+    the false-positive AND bit-exactness oracle), attack pass (one
+    wrong_gather_part + one omit_sender owner), and a transparency
+    pass (audits OFF, attacks off — the pre-audit byte-identity pin),
+    all over one seeded schedule. See the module docstring for the
+    oracles."""
+    schedule = build_hostile_schedule(args.seed, args.peers, args.epochs)
+    t0 = time.monotonic()
+    threads_before = set(threading.enumerate())
+    violations: List[str] = []
+    want = fingerprint(sum((grads_for_epoch(e) for e in range(args.epochs)),
+                           np.zeros(STATE_ELEMS, np.float32)))
+
+    control = _hostile_pass(args, schedule, attacks_on=False,
+                            audits_on=True, violations=violations,
+                            tag="ctl")
+    # -- control oracles: zero strikes (audit false positives included),
+    # audit-enabled honest rounds bit-exact to the r13 reference -------
+    for r in control:
+        if r["first_strike"]:
+            violations.append(
+                f"[ctl] {r['name']} recorded strikes on an honest "
+                f"swarm (false positives): {r['first_strike']}")
+        if any(r["audit_events"][k] for k in r["audit_events"]):
+            violations.append(
+                f"[ctl] {r['name']} recorded audit verdicts on an "
+                f"honest swarm: {r['audit_events']}")
+        if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
+            violations.append(
+                f"[ctl] {r['name']} fingerprint {r['fingerprint']} != "
+                f"analytic {want} — audits changed the bytes")
+
+    attack = _hostile_pass(args, schedule, attacks_on=True,
+                           audits_on=True, violations=violations,
+                           tag="atk")
+    # -- attack oracles ----------------------------------------------------
+    by_kind = {r["attack_kind"]: r for r in attack if r["attacker"]}
+    wrong_pid = by_kind["wrong_gather_part"]["peer_id"]
+    omit_pid = by_kind["omit_sender"]["peer_id"]
+    attack_start = max(a["start_epoch"] for a in schedule["attacks"])
+    if not by_kind["wrong_gather_part"]["injected"] \
+            .get("byz_wrong_gather_part"):
+        violations.append("[atk] wrong_gather_part never fired")
+    if not by_kind["omit_sender"]["injected"].get("byz_omit_sender"):
+        violations.append("[atk] omit_sender never fired")
+    for r in attack:
+        if r["attacker"]:
+            continue
+        # every honest member's replay audit convicts the wrong-part
+        # owner, locally AND with gossiped-receipt corroboration
+        seen = r["audit_events"]["fail"].get(wrong_pid)
+        if seen is None or seen > attack_start + 2:
+            violations.append(
+                f"[atk] {r['name']} replay audit never failed the "
+                f"wrong-part owner within 2 epochs (first: {seen})")
+        struck = r["first_strike"].get(wrong_pid)
+        if struck is None or struck > attack_start + 2:
+            violations.append(
+                f"[atk] {r['name']} never struck the wrong-part owner "
+                f"within 2 epochs (first: {struck})")
+        remote = r["first_remote"].get(wrong_pid)
+        if remote is None or remote > attack_start + 2:
+            violations.append(
+                f"[atk] {r['name']} has no gossiped receipt against "
+                f"the wrong-part owner within 2 epochs (first: {remote})")
+    # the omitted victim (deterministically the lowest-peer-id sender
+    # into the omitting owner's part) convicts through the omission
+    # audit — only the victim has standing, so the oracle names it
+    victim_pid = min(r["peer_id"] for r in attack
+                     if r["peer_id"] != omit_pid)
+    victim = next(r for r in attack if r["peer_id"] == victim_pid)
+    omitted = victim["audit_events"]["omit"].get(omit_pid)
+    if omitted is None or omitted > attack_start + 2:
+        violations.append(
+            f"[atk] omitted victim {victim['name']} never convicted "
+            f"the omitting owner within 2 epochs (first: {omitted})")
+
+    transparency = _hostile_pass(args, schedule, attacks_on=False,
+                                 audits_on=False,
+                                 violations=violations, tag="off")
+    # -- transparency oracles: audits disabled == pre-audit protocol ------
+    for r in transparency:
+        if r["first_strike"]:
+            violations.append(
+                f"[off] {r['name']} recorded strikes with audits "
+                f"disabled: {r['first_strike']}")
+        if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
+            violations.append(
+                f"[off] {r['name']} fingerprint {r['fingerprint']} != "
+                f"analytic {want}")
+
+    # -- thread hygiene ----------------------------------------------------
+    leaked = settle_threads(threads_before)
+    if leaked:
+        violations.append(f"leaked threads: {leaked}")
+
+    return {"mode": "hostile-owner", "seed": args.seed,
+            "params": {"peers": args.peers, "epochs": args.epochs,
+                       "matchmaking_time": args.matchmaking_time,
+                       "allreduce_timeout": args.allreduce_timeout,
+                       "deadline": args.deadline},
+            "schedule": schedule,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "control": control, "attack": attack,
+            "transparency": transparency,
             "violations": violations, "pass": not violations}
 
 
@@ -602,14 +843,27 @@ def main(argv=None) -> int:
                              "attack pass (1 sign-flip + 1 scale "
                              "attacker) over one seeded schedule, full "
                              "defense stack on every peer")
+    parser.add_argument("--hostile-owner", action="store_true",
+                        help="run the aggregation-audit gate: control "
+                             "(audits on, zero strikes, bit-exact) + "
+                             "attack (1 wrong_gather_part + 1 "
+                             "omit_sender owner, convicted <= 2 "
+                             "epochs w/ gossiped receipts) + "
+                             "transparency (audits off, pre-audit "
+                             "byte identity) over one schedule")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args(argv)
+    if args.hostile_owner and args.byzantine:
+        parser.error("--byzantine and --hostile-owner are exclusive")
     if args.out is None:
         args.out = os.path.join(
-            _REPO, "BYZANTINE_SOAK.json" if args.byzantine
+            _REPO, "HOSTILE_OWNER_SOAK.json" if args.hostile_owner
+            else "BYZANTINE_SOAK.json" if args.byzantine
             else "CHURN_SOAK.json")
 
-    if args.byzantine:
+    if args.hostile_owner:
+        report = run_hostile(args)
+    elif args.byzantine:
         report = run_byzantine(args)
     else:
         report = run_soak(args)
@@ -617,7 +871,21 @@ def main(argv=None) -> int:
         json.dump(report, fh, indent=1)
         fh.write("\n")
     ok = report["pass"]
-    if args.byzantine:
+    if args.hostile_owner:
+        print(f"hostile-owner soak: {'PASS' if ok else 'FAIL'} in "
+              f"{report['elapsed_s']}s — {args.peers} peers x 3 passes, "
+              f"attacks="
+              f"{[a['kind'] for a in report['schedule']['attacks']]}")
+        for tag in ("control", "attack", "transparency"):
+            for r in report[tag]:
+                audits = {k: len(v) for k, v in r["audit_events"].items()
+                          if v}
+                print(f"  [{tag[:3]}] {r['name']:>8}: epoch "
+                      f"{r['final_epoch']} fp={r['fingerprint']} "
+                      f"attacker={r.get('attacker', False)} "
+                      f"audit_events={audits} "
+                      f"first_strike={r['first_strike']}")
+    elif args.byzantine:
         print(f"byzantine soak: {'PASS' if ok else 'FAIL'} in "
               f"{report['elapsed_s']}s — {args.peers} peers x 2 passes, "
               f"attacks={[a['kind'] for a in report['schedule']['attacks']]}")

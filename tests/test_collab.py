@@ -122,12 +122,20 @@ class TestProgress:
         trackers = [ProgressTracker(n, "run2", target_batch_size=1000,
                                     min_refresh_period=0.0)
                     for n in swarm3]
-        trackers[0].report_local_progress(3, 5, force=True)
-        trackers[1].report_local_progress(2, 5, force=True)
+        trackers[0].report_local_progress(2, 5, force=True)
+        trackers[1].report_local_progress(1, 5, force=True)
         g = trackers[2].global_progress(force_refresh=True)
-        assert g.epoch == 3
+        # max over peers, WITHIN the plausible-lead bound: claims may
+        # lead the local epoch by at most max_epoch_lead (default 2) —
+        # the epoch clock cannot be stolen by one absurd signed claim
+        # (tests/test_screening.py TestProgressLeadBound pins the
+        # clamp-vs-strike split)
+        assert g.epoch == 2
         # samples counted only for peers at the max epoch
         assert g.samples_accumulated == 5
+        trackers[0].report_local_progress(9, 5, force=True)
+        g = trackers[2].global_progress(force_refresh=True)
+        assert g.epoch == 2  # lead 9 > 2: clamped in the aggregate
 
 
 class TestMatchmaking:

@@ -692,6 +692,187 @@ class TestScreeningIntegration:
                                       np.zeros(64, np.float32))
 
 
+class TestAbsNormCeiling:
+    """The absolute per-sender norm ceiling: active at ANY sender
+    count (narrowing the <4-sender gap where LOO screening must
+    skip), struck only at quorum."""
+
+    def test_validation_and_disabled_default(self):
+        with pytest.raises(ValueError):
+            ScreenPolicy(abs_norm_ceiling=-1.0)
+        assert ScreenPolicy().abs_norm_ceiling == 0.0
+        s = GradientScreen(ScreenPolicy())
+        assert not s.over_ceiling(np.full(64, 1e9, np.float32))
+
+    def test_quorum_roster_ceiling_drop_is_struck(self):
+        s = GradientScreen(ScreenPolicy(abs_norm_ceiling=100.0))
+        rng = np.random.RandomState(0)
+        contribs = {k: (1.0, rng.randn(64).astype(np.float32))
+                    for k in range(4)}
+        contribs[2] = (1.0, np.full(64, 50.0, np.float32))  # norm 400
+        v = s.screen(contribs)
+        assert not v.skipped
+        assert list(v.dropped) == [2]
+        assert v.dropped[2].startswith("abs-norm")
+        assert v.dropped_unstruck == {}
+
+    def test_below_quorum_drop_is_unstruck(self):
+        s = GradientScreen(ScreenPolicy(abs_norm_ceiling=100.0))
+        rng = np.random.RandomState(1)
+        contribs = {0: (1.0, rng.randn(64).astype(np.float32)),
+                    1: (1.0, np.full(64, 50.0, np.float32))}
+        v = s.screen(contribs)
+        assert v.skipped
+        assert v.dropped == {}
+        assert list(v.dropped_unstruck) == [1]
+
+    def test_two_peer_round_drops_without_strike(self):
+        """Integration: a 2-peer socket round where one sender's
+        segment is over the ceiling — the contribution is dropped
+        (clamp IS the defense) but nobody is struck (2-peer
+        unattributability preserved)."""
+        nodes = _det_swarm(2, base=87)
+        pids = [n.peer_id for n in nodes]
+        base = np.arange(300, dtype=np.float32) % 7 - 3
+        tensors = [[base.copy()], [np.full(300, 1000.0, np.float32)]]
+        reports = [dict() for _ in range(2)]
+        ledgers = [PeerHealthLedger() for _ in range(2)]
+        screen = GradientScreen(ScreenPolicy(abs_norm_ceiling=500.0))
+        try:
+            results = _round(nodes, "ce", tensors, screen=screen,
+                             reports=reports, ledgers=ledgers)
+        finally:
+            for n in nodes:
+                n.shutdown()
+        member_ids = [m.peer_id for m in results[0][0].members]
+        flats = [flatten_tensors(t) for t in tensors]
+        slices = _part_slices(flats[0].size, 2)
+        # peer 0's part averages over peer 0 alone (peer 1 dropped);
+        # no strike anywhere
+        assert pids[1] in reports[0]["screened_senders"]
+        assert not reports[0]["complete"]
+        assert ledgers[0].snapshot() == {} and ledgers[1].snapshot() == {}
+        p0_part = member_ids.index(pids[0])
+        lo, hi = slices[p0_part]
+        got = flatten_tensors(results[0][1])
+        np.testing.assert_array_equal(got[lo:hi], flats[0][lo:hi])
+
+
+class TestProgressLeadBound:
+    """The plausible-lead bound on progress-record epoch claims: the
+    clamp is the defense (always), the strike fires only beyond 100x
+    the bound — honest overshoot under slow local rounds is clamped,
+    never struck."""
+
+    def _converged(self, tracker, want_peers=1, timeout=10):
+        deadline = time.monotonic() + timeout
+        gp = tracker.global_progress(force_refresh=True)
+        while gp.reporting_peers < want_peers \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+            gp = tracker.global_progress(force_refresh=True)
+        assert gp.reporting_peers >= want_peers
+        return gp
+
+    def test_absurd_epoch_claim_clamped_and_struck_once(self):
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(3, base=93)
+        led = PeerHealthLedger()
+        try:
+            tracker = ProgressTracker(nodes[0], "pl", target_batch_size=64,
+                                      ledger=led, min_refresh_period=0.0,
+                                      max_epoch_lead=2)
+            # an in-bound honest reporter: the strike's corroboration
+            # cohort (an outlying clock vs an in-bound peer is a
+            # fabrication; all-peers-ahead would mean OUR clock is
+            # stale — see test below)
+            honest = ProgressTracker(nodes[1], "pl", target_batch_size=64)
+            honest.report_local_progress(0, 5, force=True)
+            liar = ProgressTracker(nodes[2], "pl", target_batch_size=64)
+            liar.report_local_progress(10 ** 6, 40, force=True)
+            time.sleep(0.4)
+            gp = self._converged(tracker, want_peers=2)
+            # the aggregate epoch (and with it the resync target) is
+            # bounded to local + max_epoch_lead, and the clamped
+            # record's samples never merge into a bucket this node
+            # can't place
+            assert gp.epoch <= 2
+            assert gp.samples_accumulated <= 5
+            assert led.score(nodes[2].peer_id) == pytest.approx(1.0)
+            # dedup per (peer, claimed epoch): polling is not a flood
+            tracker.global_progress(force_refresh=True)
+            assert led.score(nodes[2].peer_id) == pytest.approx(1.0)
+            assert led.score(nodes[1].peer_id) == 0.0
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_stale_local_clock_never_strikes_the_swarm(self):
+        """A restarted/partitioned node whose whole cohort is far
+        ahead must conclude its OWN clock is stale — clamp (the
+        resync trigger still fires), but never strike, and never
+        gossip receipts against an honest swarm."""
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(3, base=89)
+        led = PeerHealthLedger()
+        try:
+            tracker = ProgressTracker(nodes[0], "sc", target_batch_size=64,
+                                      ledger=led, min_refresh_period=0.0,
+                                      max_epoch_lead=2)
+            for i in (1, 2):  # the swarm is honestly at epoch 500
+                ProgressTracker(nodes[i], "sc", target_batch_size=64) \
+                    .report_local_progress(500, 5, force=True)
+            time.sleep(0.4)
+            gp = self._converged(tracker, want_peers=2)
+            assert gp.epoch == 2          # clamped: resync still fires
+            assert led.snapshot() == {}   # nobody struck
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_slow_round_honest_overshoot_clamped_never_struck(self):
+        """The pinned satellite case: a peer legitimately several
+        epochs ahead of a slow/partitioned local node is clamped in
+        the aggregate but NEVER struck — only orders-of-magnitude
+        fabrications are unambiguous."""
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(2, base=97)
+        led = PeerHealthLedger()
+        try:
+            tracker = ProgressTracker(nodes[0], "os", target_batch_size=64,
+                                      ledger=led, min_refresh_period=0.0,
+                                      max_epoch_lead=2)
+            ahead = ProgressTracker(nodes[1], "os", target_batch_size=64)
+            ahead.report_local_progress(7, 10, force=True)  # lead 7 > 2
+            time.sleep(0.4)
+            gp = self._converged(tracker)
+            assert gp.epoch == 2          # clamped to local + lead
+            assert led.snapshot() == {}   # ...but an honest peer
+            # the clamp window slides as the local node catches up
+            tracker.local_epoch = 6
+            gp = tracker.global_progress(force_refresh=True)
+            assert gp.epoch == 7          # now inside the bound
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_disabled_bound_keeps_raw_epochs(self):
+        from dalle_tpu.swarm.progress import ProgressTracker
+        nodes = _det_swarm(2, base=99)
+        try:
+            tracker = ProgressTracker(nodes[0], "nl", target_batch_size=64,
+                                      min_refresh_period=0.0,
+                                      max_epoch_lead=0)
+            peer = ProgressTracker(nodes[1], "nl", target_batch_size=64)
+            peer.report_local_progress(50, 1, force=True)
+            time.sleep(0.4)
+            gp = self._converged(tracker)
+            assert gp.epoch == 50
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+
 class TestProgressOverclaim:
     def test_absurd_claim_clamped_and_struck_once(self):
         from dalle_tpu.swarm.progress import ProgressTracker

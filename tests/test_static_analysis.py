@@ -507,6 +507,57 @@ class Gossip(threading.Thread):
             self.join(timeout=join_timeout)
 """,
     ),
+    # Aggregation-audit shapes (swarm/audit.py): the worker fans
+    # per-part replays out through a pool and runs fetches from a
+    # background thread against the native DHT — pin the hazardous
+    # variant of each shape so the real worker can never regress into
+    # them unnoticed.
+    (
+        "unchecked-pool-future",
+        "dalle_tpu/swarm/fake_audit.py",
+        """
+import concurrent.futures
+def audit_parts(dht, parts, replay):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(replay, dht, p) for p in parts]
+        concurrent.futures.wait(futs)   # a FAILED replay (the whole
+        # point of the audit) vanishes in an unread Future
+""",
+        """
+import concurrent.futures
+def audit_parts(dht, parts, replay):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(replay, dht, p) for p in parts]
+        return [f.result() for f in futs]   # every verdict surfaced
+""",
+    ),
+    (
+        "thread-daemon-join",
+        "dalle_tpu/swarm/fake_audit_worker.py",
+        """
+import threading
+class Auditor(threading.Thread):
+    def __init__(self, dht, ledger):
+        super().__init__()           # non-daemon, and stop() below
+        self.dht = dht               # never joins: an in-flight fetch
+        self._stop = threading.Event()   # races the DHT teardown
+    def stop(self):
+        self._stop.set()
+""",
+        """
+import threading
+class Auditor(threading.Thread):
+    def __init__(self, dht, ledger):
+        super().__init__(daemon=True, name="audit-worker")
+        self.dht = dht
+        self._stop = threading.Event()
+    def stop(self, join_timeout=10.0):
+        self._stop.set()
+        if join_timeout is not None and self.is_alive() \\
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
+""",
+    ),
     (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
