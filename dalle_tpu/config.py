@@ -297,14 +297,36 @@ class CollabConfig:
     # note before combining it with the tuned micro/accum point.
     grad_compression: str = "size_adaptive"
     state_compression: str = "size_adaptive"
-    # Where the u8/f16 wire codec EXECUTES (never what it emits — wire
-    # bytes are backend-identical, mixed groups interoperate): "device"
-    # runs quantize/dequantize as jitted programs on the accelerator
-    # (swarm/device_codec.py — VERDICT r5 weak #1: 20.1 s + 13.8 s of
-    # host numpy codec per N=4 flagship epoch while the TPU idled) and
-    # hands gradients to the wire without the host f32 pull; "host" is
-    # the numpy path; "auto" picks device on TPU peers, host elsewhere.
+    # Where the u8/u4/f16 wire codec EXECUTES (never what it emits —
+    # wire bytes are backend-identical, mixed groups interoperate):
+    # "device" runs quantize/dequantize as jitted programs on the
+    # accelerator (swarm/device_codec.py — VERDICT r5 weak #1: 20.1 s +
+    # 13.8 s of host numpy codec per N=4 flagship epoch while the TPU
+    # idled) and hands gradients to the wire without the host f32 pull;
+    # "host" is the numpy path; "auto" picks device on TPU peers, host
+    # elsewhere.
     wire_codec_backend: str = "auto"
+    # --- In-collective quantization (r15; EQuARX arxiv 2506.17615,
+    # DynamiQ arxiv 2602.08923). wire_bits_reduce / wire_bits_gather PIN
+    # the wire codec of the butterfly's two legs for the whole run —
+    # 8 -> blockwise u8, 4 -> blockwise u4 (half the sync bytes again)
+    # — instead of the per-part SizeAdaptive dispatch. A pinned leg
+    # also REJECTS frames naming any other codec (codec flapping is
+    # authenticated garbage: error-feedback residual scales are only
+    # meaningful against one stable quantizer). None keeps the legacy
+    # grad_compression dispatch for that leg, byte-identical to r14.
+    wire_bits_reduce: "int | None" = None
+    wire_bits_gather: "int | None" = None
+    # Error-feedback residuals through the collective: each sender
+    # carries the previous round's quantization error into this round's
+    # scatter encode (device-resident, donated under the device codec
+    # backend), and each part owner carries its own residual into the
+    # gather re-quantize (the DynamiQ second aggregation-hop stage; the
+    # carry-in is suspended on audit-challenged parts so the r14 replay
+    # stays bit-exact — swarm/error_feedback.py). Requires BOTH
+    # wire_bits knobs pinned; False + 8-bit leaves every round
+    # byte-identical to the r14 protocol.
+    ef_residuals: bool = False
     powersgd_rank: int = 4
     # Run PowerSGD's Gram-Schmidt on the host (bit-stable IEEE f32 loop
     # order) instead of on device. Cross-peer basis agreement needs every

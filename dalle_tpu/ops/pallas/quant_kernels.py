@@ -128,31 +128,68 @@ def wire_quantize_u8_pallas(x: jax.Array, interpret: bool = False):
     format's block geometry — the device encode half of
     swarm/device_codec.py, as a VPU kernel. The tail block is zero-padded
     exactly like the host codec, so its scale and codes match."""
+    return _wire_quantize_pallas(x, WIRE_QBLOCK, 127.0,
+                                 interpret=interpret)
+
+
+# -- linear (wire) u4 quantizer ------------------------------------------
+
+WIRE_QBLOCK4 = 1024  # the u4 wire block (compression._QBLOCK4) = 8 lanes
+
+
+def _wire_quant4_kernel(x_ref, d_ref, codes_ref, scale_ref):
+    """The u4 twin of ``_wire_quant_kernel``: per 1024-elem block,
+    scale = absmax/7, code = clip(rint(x/scale), -8, 7) + 8 — same IEEE
+    op order as the host/XLA u4 paths (byte parity), same runtime-scalar
+    divisor rule. Emits UNPACKED codes in [0, 15]; nibble packing is a
+    pure byte shuffle the caller does in XLA (identical either way)."""
+    x = x_ref[:]                               # (rows, WIRE_QBLOCK4) f32
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / d_ref[0]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.rint(x / safe), -8.0, 7.0) + 8.0
+    codes_ref[:] = q.astype(jnp.uint8)
+    scale_ref[:] = scale
+
+
+def wire_quantize_u4_pallas(x: jax.Array, interpret: bool = False):
+    """(unpacked codes uint8 (n,) in [0, 15], scales f32
+    (ceil(n/1024),)) — the device encode half of the u4 wire codec as a
+    VPU kernel; swarm/device_codec.py packs the nibble pairs."""
+    return _wire_quantize_pallas(x, WIRE_QBLOCK4, 7.0,
+                                 interpret=interpret)
+
+
+def _wire_quantize_pallas(x: jax.Array, block: int, divisor: float,
+                          interpret: bool = False):
+    """Shared launch shape of the two wire quantizers: block the flat
+    vector, pad rows to a tile multiple (padded rows are all-zero:
+    scale 0, zero code, sliced off), run the per-width kernel selected
+    by ``block``."""
+    kernel = (_wire_quant_kernel if block == WIRE_QBLOCK
+              else _wire_quant4_kernel)
     flat = x.reshape(-1).astype(jnp.float32)
     n = flat.shape[0]
-    n_blocks = -(-n // WIRE_QBLOCK)
-    # pad rows up to a tile multiple (padded rows are all-zero: scale 0,
-    # codes 128, sliced off below)
+    n_blocks = -(-n // block)
     rows = -(-n_blocks // ROWS_PER_TILE) * ROWS_PER_TILE
-    blocks = jnp.zeros((rows, WIRE_QBLOCK), jnp.float32).at[:n_blocks].set(
-        jnp.pad(flat, (0, n_blocks * WIRE_QBLOCK - n)).reshape(
-            n_blocks, WIRE_QBLOCK))
+    blocks = jnp.zeros((rows, block), jnp.float32).at[:n_blocks].set(
+        jnp.pad(flat, (0, n_blocks * block - n)).reshape(n_blocks, block))
     codes, scales = pl.pallas_call(
-        _wire_quant_kernel,
+        kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((rows, WIRE_QBLOCK), jnp.uint8),
+            jax.ShapeDtypeStruct((rows, block), jnp.uint8),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ),
         grid=(rows // ROWS_PER_TILE,),
         in_specs=[
-            pl.BlockSpec((ROWS_PER_TILE, WIRE_QBLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_TILE, block), lambda i: (i, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(
-            pl.BlockSpec((ROWS_PER_TILE, WIRE_QBLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_TILE, block), lambda i: (i, 0)),
             pl.BlockSpec((ROWS_PER_TILE, 1), lambda i: (i, 0)),
         ),
         interpret=interpret,
-    )(blocks, jnp.full((1,), 127.0, jnp.float32))
+    )(blocks, jnp.full((1,), divisor, jnp.float32))
     return (codes[:n_blocks].reshape(-1)[:n],
             scales[:n_blocks].reshape(-1))

@@ -31,6 +31,9 @@ def __getattr__(name):
     if name == "StrikeGossip":
         from dalle_tpu.swarm.health import StrikeGossip
         return StrikeGossip
+    if name == "ErrorFeedback":
+        from dalle_tpu.swarm.error_feedback import ErrorFeedback
+        return ErrorFeedback
     raise AttributeError(name)
 
 
@@ -39,4 +42,5 @@ __all__ = [
     "SignatureValidator", "ValueWithExpiration", "get_dht_time", "key_hash",
     "owner_public_key", "strip_owner", "CollaborativeOptimizer",
     "ProgressTracker", "GradientScreen", "ScreenPolicy", "StrikeGossip",
+    "ErrorFeedback",
 ]

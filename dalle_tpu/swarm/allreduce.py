@@ -177,7 +177,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   ledger=None,
                   screen=None,
                   max_peer_weight: Optional[float] = None,
-                  audit=None
+                  audit=None,
+                  gather_codec: Optional[int] = None,
+                  ef_scatter=None,
+                  ef_gather=None,
+                  pin_codec: bool = False
                   ) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
@@ -266,15 +270,54 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     pre-audit protocol, and audit-ON honest rounds produce identical
     averages (pinned by test).
 
-    ``codec_backend="device"`` runs the u8/f16 wire codec as jitted
+    ``codec_backend="device"`` runs the u8/u4/f16 wire codec as jitted
     device programs (swarm/device_codec.py): ``tensors`` may be jax
     device arrays (flattened on device, no per-leaf host pull), each
     scatter/gather part is quantized in ONE device call with only the
-    packed u8/scale buffers crossing to the host, and receive-side
+    packed code/scale buffers crossing to the host, and receive-side
     decodes dispatch to the device from the same decode pools — the
     pipelined drain structure is identical to the host backend, and so
     are the wire bytes (byte-compatible codecs, mixed-backend groups are
-    fine).
+    fine). With the device backend, an unscreened part owner also runs
+    the FUSED accumulate: each completed sender's validated wire
+    payloads feed a jitted donated accumulate (codes+scales in, the f32
+    part accumulator in/out, bit-equal to the host multiply-then-add —
+    device_codec.fused_accumulate), so the reduce hot path never
+    touches host f32 numpy; screening keeps the host-segment path (its
+    statistics need the decoded segments on the host).
+
+    ``gather_codec`` (optional) selects a DIFFERENT codec for the
+    gather leg than the scatter leg (None = same dispatch as
+    ``codec``) — the two-stage compression split of CollabConfig
+    .wire_bits_reduce/wire_bits_gather. ``pin_codec`` (set by the
+    wire_bits knobs, and implied by either EF leg or an explicit
+    ``gather_codec``) additionally ENFORCES the round's codecs:
+    receivers reject validly-signed frames naming any other codec as
+    authenticated garbage ("codec flapping" — error-feedback residual
+    scales are only meaningful against one stable quantizer), banning
+    the sender exactly like bad geometry. Enforcement must be
+    config-homogeneous across the run (the audit replay re-applies
+    the recorded pin), so no peer-LOCAL condition ever implies it:
+    unpinned rounds keep the r14 accept-what-the-header-names
+    semantics byte-for-byte — a round may legitimately mix per-caller
+    codecs (an averaging assistant serves its part with ITS config's
+    codec, whatever the trainers pass), and the fused device path
+    below falls back to host decode for such senders rather than
+    banning them.
+
+    ``ef_scatter`` / ``ef_gather`` (optional
+    :class:`~dalle_tpu.swarm.error_feedback.ErrorFeedback`) arm the
+    two error-feedback legs: the sender adds its persistent residual
+    to the flattened gradients before the per-part encode and stores
+    the new quantization error after the scatter (device-resident,
+    donated, under the device backend); the part owner compensates its
+    averaged part with its own residual before the gather re-quantize
+    (the DynamiQ second stage). Both require a pinned u8/u4 codec on
+    their leg and block-aligned ``chunk_elems``. The gather carry-in
+    is SUSPENDED on audit-challenged parts so the r14 replay recomputes
+    the served (quantized) part bit-exactly — see swarm/error_feedback
+    .py's determinism contract; the fresh error is still stored. With
+    both EF legs None, rounds are byte-identical to the r14 protocol.
     """
     from dalle_tpu.swarm.crypto import maybe_decrypt, maybe_encrypt
     gkey = group.group_key
@@ -344,18 +387,54 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     if group.size <= 1 or not owners or total_elems == 0:
         # degenerate round: nothing crosses the wire — skip the flatten
         # (in device mode that would be a jitted concat plus a full
-        # payload device-to-host copy, for nothing)
+        # payload device-to-host copy, for nothing). EF residuals stay
+        # untouched: nothing was quantized.
         return [np.array(t, np.float32, copy=True) for t in tensors]
+    quant_codecs = (compression.UNIFORM8BIT, compression.UNIFORM4BIT)
+    if ef_scatter is not None and (
+            codec not in quant_codecs
+            or chunk_elems % compression.codec_block(codec)):
+        raise ValueError(
+            "ef_scatter needs a pinned u8/u4 scatter codec and "
+            "block-aligned chunk_elems (residual scales are only "
+            "meaningful against one stable quantizer)")
+    eff_gather = gather_codec if gather_codec is not None else codec
+    if ef_gather is not None and (
+            eff_gather not in quant_codecs
+            or chunk_elems % compression.codec_block(eff_gather)):
+        raise ValueError(
+            "ef_gather needs a pinned u8/u4 gather codec and "
+            "block-aligned chunk_elems")
+    # Pinned-codec enforcement (None = the r14 accept-what-the-header-
+    # names acceptance): on a pinned leg, receivers reject frames
+    # naming any other codec as authenticated garbage — codec flapping
+    # breaks EF residual scales and has no honest cause when the run
+    # pins the codec. Enforcement is strictly OPT-IN (pin_codec / EF /
+    # an explicit gather_codec) and must be config-homogeneous across
+    # the run: the audit replay re-applies the recorded pin, so a
+    # peer-LOCAL condition (like the device backend's fused path) must
+    # never imply it — the fused path instead falls back to per-sender
+    # host decode for frames in any other codec.
+    enforce = (pin_codec or gather_codec is not None
+               or ef_scatter is not None or ef_gather is not None)
+    pin_scatter = codec if enforce else None
+    pin_gather = eff_gather if enforce else None
     t_flat = time.monotonic()
     if use_device:
         # flatten on device; the one host copy below feeds the reduce
         # accumulate and the gather fallback template (it must be
-        # writable — device pulls surface as read-only views)
+        # writable — device pulls surface as read-only views). The EF
+        # compensate runs on device BEFORE that copy: host and device
+        # views of the compensated vector are the same bytes.
         flat_dev = device_codec.flatten_device(tensors)
+        if ef_scatter is not None and weight > 0:
+            flat_dev = ef_scatter.compensate(flat_dev)
         flat = np.array(flat_dev, np.float32)
     else:
         flat_dev = None
         flat = flatten_tensors(tensors)
+        if ef_scatter is not None and weight > 0:
+            flat = ef_scatter.compensate(flat)
 
     me = group.members[group.my_index]
     owner_index = {m.peer_id: k for k, m in enumerate(owners)}
@@ -368,7 +447,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     if audit is not None:
         audit.begin(group, owners, my_part,
                     [hi_ - lo_ for lo_, hi_ in slices], chunk_elems,
-                    codec, adaptive_threshold, max_peer_weight, screen)
+                    codec, adaptive_threshold, max_peer_weight, screen,
+                    gather_codec=gather_codec, pinned=pin_scatter)
     audited_parts = audit.audited if audit is not None else frozenset()
     retain_mine = audit is not None and audit.audits_mine
     t0 = time.monotonic()
@@ -393,6 +473,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             return compression.adaptive_codec(n, adaptive_threshold)
         return codec
 
+    def gather_part_codec(n: int) -> int:
+        if gather_codec is not None:
+            return gather_codec
+        return part_codec(n)
+
+    fused_capable = (use_device and screen is None
+                     and codec in quant_codecs
+                     and chunk_elems % compression.codec_block(codec)
+                     == 0)
+
     def send_raw(addr: str, tag: int, wire_body: bytes) -> bool:
         remaining = max(0.1, deadline - time.monotonic())
         return dht.send(addr, tag, wire_body, timeout=remaining)
@@ -404,19 +494,29 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     # shared lazily by its chunk producers (the first pool task to need
     # it pays the dispatch, so part encodes overlap the wire exactly like
     # per-chunk host encodes do). Only valid when chunk boundaries land
-    # on the u8 codec's 256-element blocks — CHUNK_ELEMS does; a caller
-    # with an unaligned chunk_elems falls back to per-chunk device
-    # encodes, which produce the same bytes at more dispatches.
-    part_aligned = chunk_elems % compression._QBLOCK == 0
+    # on the codec's quant blocks — CHUNK_ELEMS is a multiple of both
+    # the u8 and u4 blocks; a caller with an unaligned chunk_elems falls
+    # back to per-chunk device encodes, which produce the same bytes at
+    # more dispatches.
+    def _enc_codec_for(pinned: Optional[int]) -> int:
+        # the whole-part encode codec for a leg: its pin when that is a
+        # block codec, else u8 (what SizeAdaptive picks at part scale)
+        return pinned if pinned in (compression.UNIFORM8BIT,
+                                    compression.UNIFORM4BIT) \
+            else compression.UNIFORM8BIT
 
-    def lazy_part_enc(src, lo: int, hi: int):
+    def _part_aligned(enc_codec: int) -> bool:
+        return chunk_elems % compression.codec_block(enc_codec) == 0
+
+    def lazy_part_enc(src, lo: int, hi: int, enc_codec: int):
         holder: dict = {}
         lock = _threading.Lock()
 
         def get():
             with lock:
                 if "enc" not in holder:
-                    holder["enc"] = device_codec.encode_part(src, lo, hi)
+                    holder["enc"] = device_codec.encode_part(
+                        src, lo, hi, enc_codec)
                 return holder["enc"]
         return get
 
@@ -429,12 +529,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     # reduce phase immediately instead of after serializing every encode
     # (VERDICT r4 weak #7: encode-serial rounds spent half their wall on
     # the codec). chunk_idx places each frame; order is irrelevant.
+    scatter_enc_codec = _enc_codec_for(codec)
+
     def produce_scatter(addr: str, tag: int, ctx: bytes, lo: int, clo: int,
                         chi: int, ci: int, n_chunks: int, enc_get
                         ) -> Tuple[str, int, bytes, bool]:
         nelem = chi - clo
         c = part_codec(nelem)
-        if enc_get is not None and c == compression.UNIFORM8BIT:
+        if enc_get is not None and c == scatter_enc_codec:
             payload = device_codec.part_payload(enc_get(), clo, chi)
         else:
             src = flat_dev if use_device else flat
@@ -451,6 +553,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 max_workers=_pool_workers(4)) as dec_pool:
         futures = []
         scatter_to = list(enumerate(owners)) if weight > 0 else []
+        scatter_encs: Dict[int, object] = {}  # part -> lazy EncodedPart
         for k, owner in scatter_to:
             if k == my_part:
                 continue
@@ -458,8 +561,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             chunks = _chunk_slices(hi - lo, chunk_elems)
             ctx = _sign_ctx(prefix, epoch, "scatter", owner.peer_id)
             tag = _tag(prefix, epoch, "scatter", owner.peer_id)
-            enc_get = (lazy_part_enc(flat_dev, lo, hi)
-                       if use_device and part_aligned else None)
+            enc_get = (lazy_part_enc(flat_dev, lo, hi, scatter_enc_codec)
+                       if use_device and _part_aligned(scatter_enc_codec)
+                       else None)
+            scatter_encs[k] = enc_get
             for ci, (clo, chi) in enumerate(chunks):
                 futures.append(pool.submit(
                     produce_scatter, owner.addr, tag, ctx,
@@ -487,6 +592,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             n_weighted = n_expected0 + (1 if weight > 0 else 0)
             screen_active = (screen is not None
                              and n_weighted >= screen.policy.min_senders)
+            # Fused device accumulation: with no screen configured (its
+            # statistics need host segments) and a pinned block codec,
+            # each completed sender's validated wire payloads feed a
+            # jitted donated decode+weighted-add — the accumulator stays
+            # on device and host f32 numpy leaves the reduce hot path.
+            # (fused_capable implies pin_scatter == codec: the payloads
+            # are interpreted under the round's one codec.)
+            fused = fused_capable
             # screened mode BUFFERS fully-delivered contributions (one
             # part-sized array per live sender) and accumulates after
             # the verdict, in sender order — same f32 multiply-add
@@ -517,7 +630,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     audit.note_init("zeros")
                     audit.note_drop(group.my_index, "screen-outlier")
             else:
-                acc = mine * weight
+                # fused path: seed the DEVICE accumulator with the same
+                # f32 multiply the host path runs (bit-equal)
+                acc = (device_codec.accumulator_init(flat_dev, lo, hi,
+                                                     weight)
+                       if fused else mine * weight)
                 total_w = weight
                 if retain_mine:
                     # streaming accumulation initializes from this
@@ -561,7 +678,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 if raw is None:
                     return None
                 return raw, _parse(raw, group, my_chunks, my_ctx,
-                                   codec_mod)
+                                   codec_mod, pinned=pin_scatter,
+                                   defer_codec=codec if fused else None)
 
             banned_reduce = 0  # corrupt-banned senders (no data applied)
 
@@ -635,12 +753,19 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         w, max_peer_weight)
                     return True
                 if sender not in bufs:
-                    bufs[sender] = np.zeros(n_mine, np.float32)
+                    bufs[sender] = {} if fused \
+                        else np.zeros(n_mine, np.float32)
                     got[sender] = set()
                 if ci in got[sender]:
                     return False  # duplicate chunk
-                clo, chi = my_chunks[ci]
-                bufs[sender][clo:chi] = data
+                if fused:
+                    # validated wire payload (round-codec frames), or a
+                    # decoded host chunk (any OTHER codec an unpinned
+                    # round still accepts — the r14 mixed-codec interop)
+                    bufs[sender][ci] = data
+                else:
+                    clo, chi = my_chunks[ci]
+                    bufs[sender][clo:chi] = data
                 got[sender].add(ci)
                 if ci == 0:
                     wts[sender] = w
@@ -658,6 +783,38 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         # buffer for the post-drain screen; weight and
                         # accumulation are deferred to the verdict
                         complete[sender] = (w, bufs.pop(sender))
+                    elif fused:
+                        # jitted donated accumulate per sender: wire
+                        # codes+scales in, f32 accumulator in/out —
+                        # bit-equal to the host multiply-then-add
+                        payloads = bufs.pop(sender)
+                        chunks_b = [payloads[i]
+                                    for i in range(len(my_chunks))]
+                        if all(isinstance(p, (bytes, bytearray))
+                               for p in chunks_b):
+                            acc = device_codec.fused_accumulate(
+                                acc, chunks_b, codec, n_mine, w)
+                        else:
+                            # a sender in some OTHER codec (unpinned
+                            # rounds accept it, r14 semantics): decode
+                            # on the host and add the host-multiplied
+                            # contribution to the device accumulator —
+                            # the add is the same IEEE f32 op either
+                            # way, so parity with the host path holds
+                            seg = np.zeros(n_mine, np.float32)
+                            for ci2, (clo2, chi2) in \
+                                    enumerate(my_chunks):
+                                p = chunks_b[ci2]
+                                seg[clo2:chi2] = (
+                                    codec_mod.decompress(
+                                        bytes(p), codec, chi2 - clo2)
+                                    if isinstance(p, (bytes, bytearray))
+                                    else p)
+                            acc = device_codec.add_contrib(
+                                acc, seg * np.float32(w))
+                        total_w += w
+                        if retain_mine:
+                            audit.note_applied(sender)
                     else:
                         seg = bufs.pop(sender)
                         if screen is not None \
@@ -823,6 +980,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                                              - banned_reduce
                                              + (1 if weight > 0 else 0))
             if total_w > 0:
+                if fused:
+                    # the round's ONE reduce-side host pull: the
+                    # finished accumulator (the trust seams — screen
+                    # ceilings, audit, tamper — and the gather encode
+                    # consume host values); the divide stays the same
+                    # host f32 op as the unfused path
+                    acc = np.asarray(acc)
                 averaged_mine = acc / total_w
             else:
                 # an assistant that received NO contributions must not
@@ -886,6 +1050,43 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     audit.note_scatter_ok(k)
         phases["scatter_wait_s"] = round(time.monotonic() - t_wait, 3)
 
+        if ef_scatter is not None and weight > 0:
+            # Store this round's quantization error: compensated minus
+            # what each part OWNER decoded. The own part is applied raw
+            # f32 (its pending error was delivered in full — residual
+            # clears); sent parts subtract the dequantize of the exact
+            # wire bytes. Device path: the whole update is one donated
+            # jitted subtract over the already-encoded parts — the
+            # compensated vector must not be read afterwards, so the
+            # device flat is dropped here.
+            t_ef = time.monotonic()
+            if use_device and all(g is not None
+                                  for k_, g in scatter_encs.items()
+                                  if k_ != my_part):
+                segs = []
+                for k in range(len(owners)):
+                    lo_, hi_ = slices[k]
+                    if k == my_part or scatter_encs.get(k) is None:
+                        segs.append(flat_dev[lo_:hi_])
+                    else:
+                        segs.append(scatter_encs[k]().decoded_dev())
+                ef_scatter.store(flat_dev, segs)
+                flat_dev = None  # donated into the residual update
+            else:
+                # host backend: re-derive each sent part's decode with
+                # the same block-aligned codec (one extra round-trip per
+                # part — the device backend is the EF production home)
+                decoded = flat.copy()
+                for k, _owner in scatter_to:
+                    if k == my_part:
+                        continue
+                    lo_, hi_ = slices[k]
+                    buf = compression.compress(flat[lo_:hi_], codec)
+                    decoded[lo_:hi_] = compression.decompress(
+                        buf, codec, hi_ - lo_)
+                ef_scatter.store(flat, [decoded])
+            phases["ef_scatter_s"] = round(time.monotonic() - t_ef, 3)
+
     # serve the audit transcript BEFORE the part: any member that
     # completes the gather can immediately fetch the honest record the
     # owner signed (the post is mailbox-local, no wire round-trips)
@@ -907,6 +1108,19 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             logger.warning("allreduce: audit transcript post failed",
                            exc_info=True)
         phases["audit_post_s"] = round(time.monotonic() - t_post, 3)
+    # EF second stage (DynamiQ): the owner carries its own residual into
+    # the gather re-quantize — SUSPENDED on audit-challenged parts, so
+    # the replay's codec round-trip of the replayed average stays
+    # bit-exact without any private residual entering a transcript (a
+    # buffer a hostile owner could fabricate to "explain" a wrong part;
+    # the deterministic challenge means owner and auditors agree on the
+    # suspension at round start). The fresh error is still stored below.
+    ef_gather_active = (ef_gather is not None and my_part is not None
+                        and averaged_mine is not None and weight > 0)
+    if ef_gather_active and my_part not in audited_parts:
+        glo, ghi = slices[my_part]
+        averaged_mine = ef_gather.compensate_slice(
+            averaged_mine, glo, ghi, flat.size)
     # hostile-owner chaos seam (swarm/chaos.py wrong_gather_part): an
     # active op rewrites the part THIS owner is about to serve — after
     # the honest average and after the transcript, which is exactly
@@ -951,9 +1165,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             # device backend: the averaged part is quantized in one
             # device call shared by its chunk producers, and the local
             # apply reads the device dequantize of the same buffers
+            gather_enc_codec = _enc_codec_for(eff_gather)
             gather_enc_get = (lazy_part_enc(averaged_mine, 0,
-                                            averaged_mine.size)
-                              if use_device and part_aligned else None)
+                                            averaged_mine.size,
+                                            gather_enc_codec)
+                              if use_device
+                              and _part_aligned(gather_enc_codec)
+                              else None)
 
             def produce_gather(ci: int, clo: int, chi: int) -> None:
                 # compress + local-apply + sign + encrypt on a codec
@@ -961,12 +1179,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # codec of chunk i+1 overlaps the wire of chunk i AND the
                 # receive thread starts collecting other parts at once
                 nelem = chi - clo
-                c = part_codec(nelem)
+                c = gather_part_codec(nelem)
                 # apply the same lossy wire bytes locally so all members
                 # end the round with byte-identical values for this part
                 # (chunks write disjoint slices of out: thread-safe)
                 if gather_enc_get is not None \
-                        and c == compression.UNIFORM8BIT:
+                        and c == gather_enc_codec:
                     enc = gather_enc_get()
                     wire = device_codec.part_payload(enc, clo, chi)
                     out[lo + clo:lo + chi] = device_codec.part_decode(
@@ -1043,15 +1261,19 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 if part is None or part not in pending:
                     return None
                 parsed = _parse(raw, group, part_chunks[part], gather_ctx,
-                                codec_mod)
+                                codec_mod, pinned=pin_gather)
                 if parsed is None:
                     return None
-                return part, parsed
+                # the codec this chunk ACTUALLY arrived in (the wire
+                # header, post-signature-verify): the audit replays the
+                # gather re-encode with the codecs this member applied,
+                # so mixed-codec (unpinned) owners replay faithfully
+                return part, parsed, _HDR.unpack_from(raw)[6]
 
             def apply_gather(res) -> bool:
                 if res is None:
                     return False
-                part, (status, sender, _w, ci, data) = res
+                part, (status, sender, _w, ci, data), gcodec = res
                 if part not in pending:
                     return False  # completed part
                 if status == "bad":
@@ -1079,6 +1301,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 pclo, pchi = part_chunks[part][ci]
                 out[plo + pclo:plo + pchi] = data
                 pending[part].discard(ci)
+                if audit is not None and part in audited_parts:
+                    audit.note_gather_codec(part, ci, gcodec)
                 if not pending[part]:
                     del pending[part]
                     if audit is not None and part in audited_parts:
@@ -1160,7 +1384,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         if raw is None:
                             continue
                         parsed = _parse(raw, group, part_chunks[k],
-                                        gather_ctx, codec_mod)
+                                        gather_ctx, codec_mod,
+                                        pinned=pin_gather)
                         if parsed is None:
                             continue
                         status, psender, _, pci, data = parsed
@@ -1187,6 +1412,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         clo, chi = part_chunks[k][pci]
                         out[lo + clo:lo + chi] = data
                         pending[k].discard(pci)
+                        if audit is not None and k in audited_parts:
+                            audit.note_gather_codec(
+                                k, pci, _HDR.unpack_from(raw)[6])
                         last_progress = time.monotonic()
                     if not pending.get(k):
                         if (k in pending and audit is not None
@@ -1210,6 +1438,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         concurrent.futures.wait(produce_futs)
         for f in produce_futs:
             f.result()  # surface codec bugs instead of dropping the part
+        if ef_gather_active:
+            # the served values are now fully applied locally in ``out``
+            # (the exact wire bytes' dequantize): record this round's
+            # gather quantization error against the compensated (or, on
+            # a challenged part, raw) average actually encoded
+            glo, ghi = slices[my_part]
+            ef_gather.store_slice(averaged_mine, out[glo:ghi],
+                                  glo, ghi, flat.size)
         concurrent.futures.wait(futures)
         # same application-layer retry as scatter: gather chunks are
         # de-duplicated by (part, chunk_idx) at every receiver
@@ -1250,7 +1486,8 @@ def _peek(raw: bytes, group: AveragingGroup
 
 def _parse(raw: bytes, group: AveragingGroup,
            chunks: List[Tuple[int, int]], ctx: bytes,
-           codec_mod=compression
+           codec_mod=compression, pinned: Optional[int] = None,
+           defer_codec: Optional[int] = None
            ) -> Optional[Tuple[str, int, float, int,
                                Optional[np.ndarray]]]:
     """-> ("ok", sender, weight, chunk_idx, decoded chunk),
@@ -1261,6 +1498,20 @@ def _parse(raw: bytes, group: AveragingGroup,
     element count must both agree — a frame chunked differently is
     malformed). ``codec_mod`` is the decompress backend (compression or
     device_codec — identical wire semantics).
+
+    ``pinned`` (a codec id) rejects validly-signed frames naming ANY
+    other codec as "bad" — codec flapping: on a pinned-codec run
+    (the wire_bits knobs' ``pin_codec`` opt-in) a frame in a
+    different codec has no honest cause, and error-feedback residual
+    scales are only meaningful against one stable quantizer. ``None``
+    keeps the r14 accept-what-the-header-names semantics.
+    ``defer_codec`` (the fused reduce path): frames IN that codec
+    skip the decode and return their STRUCTURALLY VALIDATED wire
+    payload bytes as ``data`` (u8/u4 only — every byte is a valid
+    code, so the length/header checks are exactly as strict as the
+    decompress try); frames in any OTHER codec fall through to the
+    normal decode, so an unpinned fused round still interoperates
+    with mixed-codec senders (r14 semantics).
 
     ``"bad"`` is an AUTHENTICATED verdict: it fires only when the
     frame's signature verifies under the claimed sender's key yet the
@@ -1292,10 +1543,17 @@ def _parse(raw: bytes, group: AveragingGroup,
     _, _, _, n, ci, nc, codec = _HDR.unpack_from(raw)
     if nc != len(chunks) or not (0 <= ci < nc):
         return "bad", sender, 0.0, -1, None
+    if pinned is not None and codec != pinned:
+        # codec flapping under a pinned run: authenticated garbage
+        return "bad", sender, 0.0, -1, None
     clo, chi = chunks[ci]
     if n != chi - clo:
         return "bad", sender, 0.0, -1, None
     body = raw[_PREFIX_LEN:]
+    if defer_codec is not None and codec == defer_codec:
+        if not compression.quant_payload_valid(body, codec, n):
+            return "bad", sender, 0.0, -1, None
+        return "ok", sender, float(w), ci, body
     try:
         data = codec_mod.decompress(body, codec, n)
     except (ValueError, struct.error):

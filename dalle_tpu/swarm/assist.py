@@ -64,7 +64,9 @@ def grad_flat_elements(model_cfg: ModelConfig) -> int:
 
 def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
                      template: np.ndarray, authorizer=None,
-                     codec: Optional[int] = None) -> str:
+                     codec: Optional[int] = None,
+                     gather_codec: Optional[int] = None,
+                     pin_codec: bool = False) -> str:
     """Join epoch ``epoch``'s gradient matchmaking as a weight-0 member
     and, if a real group forms, serve as a part owner for its all-reduce.
 
@@ -74,10 +76,13 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
     size disagrees with the trainers', i.e. a model-config mismatch), or
     ``"idle"`` (no group with contributors formed).
 
-    ``codec`` must match the trainers' wire codec choice (None = the
-    size-adaptive default the optimizer uses) — each owner compresses the
-    part it gathers, so an assistant with a different codec would gather
-    its part at different fidelity than trainer-owned parts."""
+    ``codec``/``gather_codec``/``pin_codec`` must match the trainers'
+    wire codec choice (None = the size-adaptive default; the r15
+    wire_bits knobs map exactly as the optimizer maps them) — each
+    owner compresses the part it gathers, so an assistant with a
+    different codec would gather its part at different fidelity than
+    trainer-owned parts, and on a PINNED run the trainers would ban a
+    wrong-codec assistant's part outright as codec flapping."""
     group = make_group(
         dht, f"{cfg.run_id}_grads", epoch, weight=0.0,
         matchmaking_time=cfg.matchmaking_time, min_group_size=2,
@@ -92,7 +97,8 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
     from dalle_tpu.swarm.device_codec import resolve_backend
     run_allreduce(dht, group, f"{cfg.run_id}_grads", epoch, [template],
                   weight=0.0, allreduce_timeout=cfg.allreduce_timeout,
-                  codec=codec,
+                  codec=codec, gather_codec=gather_codec,
+                  pin_codec=pin_codec,
                   adaptive_threshold=cfg.size_adaptive_threshold,
                   report=report,
                   codec_backend=resolve_backend(
@@ -147,9 +153,18 @@ class AveragingAssistant(threading.Thread):
     def run(self) -> None:  # pragma: no cover - exercised via tests' join
         # the trainers' wire codec: each owner compresses the part it
         # gathers, so the assistant's part must ride the SAME codec or
-        # 1/N of every gradient step silently changes fidelity
+        # 1/N of every gradient step silently changes fidelity — and on
+        # an r15 wire_bits run the trainers PIN the codec, so a
+        # mismatched assistant would be banned as codec flapping. Map
+        # the knobs exactly as CollaborativeOptimizer maps them.
+        from dalle_tpu.swarm.compression import codec_for_bits
         from dalle_tpu.swarm.optimizer import _CODECS
-        codec = _CODECS[self.cfg.grad_compression]
+        wb_r = getattr(self.cfg, "wire_bits_reduce", None)
+        wb_g = getattr(self.cfg, "wire_bits_gather", None)
+        codec = (codec_for_bits(wb_r) if wb_r is not None
+                 else _CODECS[self.cfg.grad_compression])
+        gather_codec = codec_for_bits(wb_g)
+        pin = wb_r is not None or wb_g is not None
         template = np.zeros(self._n_elements, np.float32)
         tracker = ProgressTracker(self.dht, self.cfg.run_id,
                                   self.cfg.target_batch_size)
@@ -182,7 +197,9 @@ class AveragingAssistant(threading.Thread):
                     continue
                 outcome = assist_one_round(self.dht, self.cfg,
                                            progress.epoch, template,
-                                           self.authorizer, codec=codec)
+                                           self.authorizer, codec=codec,
+                                           gather_codec=gather_codec,
+                                           pin_codec=pin)
                 if outcome == "assisted":
                     self.rounds_assisted += 1
                     last_handled = progress.epoch

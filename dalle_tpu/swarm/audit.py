@@ -68,13 +68,19 @@ soak's control pass).
 
 Determinism boundary: the replay's f32 re-accumulation and wire-codec
 round-trip are elementwise and bit-stable on any host. The SCREEN
-replay's f64 norm/cosine statistics reduce through numpy/BLAS, whose
-summation order is build-dependent — on a mixed-build fleet an input
-within an ulp of a screen threshold could split honest verdicts (the
-same hazard that made host orthogonalization the PowerSGD default).
-Thresholds sit far outside the honest envelope and receipts alone
-never convict, which bounds the damage; CHAOS.md "Known gaps" carries
-the full analysis and the fixed-order-statistics future fix.
+replay's norm/dot statistics run a FIXED-ORDER summation since r15
+(screening._fixed_order_sum: row-wise elementwise adds in an order
+the code spells out, combined with an exactly-rounded math.fsum — a
+pure function of the input bytes on any numpy build; previously f64
+numpy/BLAS reductions whose SIMD order could split honest verdicts
+on ulp-boundary inputs — the CHAOS.md "Known gaps" entry this
+closed).
+Quantized rounds add one more surface: the gather re-quantize is
+replayed with the round's pinned gather codec, and the owner's gather
+error-feedback carry is SUSPENDED on challenged parts (the
+deterministic challenge is known round-wide at round start), so the
+served part is a pure function of the transcript's signed inputs —
+see swarm/error_feedback.py's determinism contract.
 """
 
 from __future__ import annotations
@@ -198,6 +204,8 @@ class RoundAudit:
         self.part_sizes: List[int] = []
         self.chunk_elems = 0
         self.codec: Optional[int] = None
+        self.gather_codec: Optional[int] = None
+        self.pinned: Optional[int] = None
         self.adaptive_threshold = 0
         self.max_peer_weight: Optional[float] = None
         self.screen = None
@@ -213,6 +221,14 @@ class RoundAudit:
         self.posted = False
         # collector-side retention
         self.gathered: Dict[int, np.ndarray] = {}
+        #: part -> {chunk_idx: codec} the gathered chunks ACTUALLY
+        #: arrived in (wire-header ground truth): the replay re-encodes
+        #: with these, so an unpinned mixed-codec owner — who is free
+        #: to serve its part in ITS config's codec, r14 semantics —
+        #: replays faithfully instead of being convicted for a codec
+        #: choice. Under a pinned run the parse already guarantees
+        #: these equal the pin.
+        self.gather_codecs: Dict[int, Dict[int, int]] = {}
         self.scatter_ok: Set[int] = set()
 
     # -- hooks called by run_allreduce ---------------------------------
@@ -220,18 +236,26 @@ class RoundAudit:
     def begin(self, group, owners, my_part: Optional[int],
               part_sizes: Sequence[int], chunk_elems: int,
               codec: Optional[int], adaptive_threshold: int,
-              max_peer_weight: Optional[float], screen=None) -> None:
+              max_peer_weight: Optional[float], screen=None,
+              gather_codec: Optional[int] = None,
+              pinned: Optional[int] = None) -> None:
         """Called by ``run_allreduce`` with the ROUND'S context —
-        codec, clamp, screen. The replay must judge the owner by the
-        rules the round actually ran under, so the audit reads these
-        back from here rather than having callers re-plumb them (a
-        drifted clamp/screen would falsely convict honest owners)."""
+        codec (scatter AND gather legs — the r15 two-stage split),
+        the scatter-leg ENFORCEMENT pin (``pinned``: None on rounds
+        that accept mixed codecs, r14 semantics — the replay must
+        apply exactly the acceptance rule the round ran under, or
+        honest owners of mixed-codec rounds get convicted), clamp,
+        screen. The audit reads these back from here rather than
+        having callers re-plumb them (a drifted clamp/screen would
+        falsely convict honest owners)."""
         self.group = group
         self.owners = list(owners)
         self.my_part = my_part
         self.part_sizes = list(part_sizes)
         self.chunk_elems = chunk_elems
         self.codec = codec
+        self.gather_codec = gather_codec
+        self.pinned = pinned
         self.adaptive_threshold = adaptive_threshold
         self.max_peer_weight = max_peer_weight
         self.screen = screen
@@ -283,6 +307,9 @@ class RoundAudit:
 
     def note_gathered(self, part: int, values: np.ndarray) -> None:
         self.gathered[part] = np.array(values, np.float32, copy=True)
+
+    def note_gather_codec(self, part: int, ci: int, codec: int) -> None:
+        self.gather_codecs.setdefault(part, {})[ci] = codec
 
     def note_scatter_ok(self, part: int) -> None:
         self.scatter_ok.add(part)
@@ -441,7 +468,10 @@ class ReplayResult:
 def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
                       part: int, part_elems: int, chunk_elems: int,
                       codec: Optional[int], adaptive_threshold: int,
-                      screen=None, max_peer_weight: Optional[float] = None
+                      screen=None, max_peer_weight: Optional[float] = None,
+                      gather_codec: Optional[int] = None,
+                      pinned: Optional[int] = None,
+                      observed_codecs: Optional[Dict[int, int]] = None
                       ) -> ReplayResult:
     """Re-derive the averaged part from the transcript's signed inputs.
 
@@ -478,7 +508,15 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
     if owner_index in order:
         return ReplayResult(False, "owner-in-order")
 
-    # 1. parse + verify every shipped frame set
+    # 1. parse + verify every shipped frame set. Scatter frames face
+    # exactly the acceptance rule the round ran under: the ENFORCED
+    # pin when the run pinned its codec (a codec-flapping frame the
+    # owner evidence-banned must replay as "bad"), the r14 accept-any
+    # rule otherwise (mixed-codec rounds are honest — convicting an
+    # owner for applying a legitimately-coded frame would be a false
+    # positive). The owner's SELF frames are always exempt — the
+    # transcript protocol signs them with the exact NONE codec
+    # whatever the wire pin is.
     parsed: Dict[int, Tuple[float, np.ndarray]] = {}
     for sender, raws in tr["frames"].items():
         if not (0 <= sender < group.size):
@@ -488,7 +526,8 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
         w_claimed: Optional[float] = None
         bad = False
         for raw in raws:
-            p = _parse(raw, group, chunks, ctx)
+            p = _parse(raw, group, chunks, ctx,
+                       pinned=None if sender == owner_index else pinned)
             if p is None:
                 return ReplayResult(False, "unverifiable-frame")
             status, psender, w, ci, data = p
@@ -523,12 +562,14 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
     for sender, reason in drops.items():
         if reason == "corrupt-chunk":
             ev = tr["evidence"].get(sender)
-            p = _parse(ev, group, chunks, ctx) if ev is not None else None
+            p = _parse(ev, group, chunks, ctx, pinned=pinned) \
+                if ev is not None else None
             if p is None or p[0] != "bad" or p[1] != sender:
                 return ReplayResult(False, "unevidenced-corrupt-drop")
         elif reason == "weight-overclaim":
             ev = tr["evidence"].get(sender)
-            p = _parse(ev, group, chunks, ctx) if ev is not None else None
+            p = _parse(ev, group, chunks, ctx, pinned=pinned) \
+                if ev is not None else None
             if (p is None or p[0] != "ok" or p[1] != sender
                     or max_peer_weight is None
                     or 0.0 <= p[2] <= max_peer_weight):
@@ -639,13 +680,26 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
                             screen_drops=replay_drops)
     averaged = acc / total_w
 
-    # 7. wire-codec round-trip, chunk by chunk, exactly as the gather
-    # phase applies its own broadcast bytes locally
+    # 7. wire-codec round-trip with the GATHER leg's codec, chunk by
+    # chunk, exactly as the gather phase applies its own broadcast
+    # bytes locally. ``observed_codecs`` — what each gathered chunk's
+    # wire header actually named (per-member ground truth: these ARE
+    # the bytes the member applied) — takes precedence, so an unpinned
+    # owner serving its config's codec replays faithfully; the
+    # auditor-side dispatch is the fallback for synthetic replays.
+    # Gather error-feedback never enters here: the carry-in is
+    # suspended on challenged parts (error_feedback.py's determinism
+    # contract), so an honest challenged owner served exactly
+    # quantize(average).
     out = np.empty(part_elems, np.float32)
-    for clo, chi in chunks:
+    g_pin = gather_codec if gather_codec is not None else codec
+    for ci, (clo, chi) in enumerate(chunks):
         nelem = chi - clo
-        c = (codec if codec is not None
-             else compression.adaptive_codec(nelem, adaptive_threshold))
+        c = (observed_codecs or {}).get(ci)
+        if c is None:
+            c = (g_pin if g_pin is not None
+                 else compression.adaptive_codec(nelem,
+                                                 adaptive_threshold))
         wire = compression.compress(averaged[clo:chi], c)
         out[clo:chi] = compression.decompress(wire, c, nelem)
     return ReplayResult(True, values=out, screen_drops=replay_drops)
@@ -693,7 +747,9 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
             part=p, part_elems=ra.part_sizes[p],
             chunk_elems=ra.chunk_elems, codec=ra.codec,
             adaptive_threshold=ra.adaptive_threshold, screen=ra.screen,
-            max_peer_weight=ra.max_peer_weight)
+            max_peer_weight=ra.max_peer_weight,
+            gather_codec=ra.gather_codec, pinned=ra.pinned,
+            observed_codecs=ra.gather_codecs.get(p))
         if not res.ok:
             return p, "failed", res.why, res.screen_drops
         if res.values.tobytes() != ra.gathered[p].tobytes():

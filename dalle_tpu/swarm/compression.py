@@ -31,11 +31,46 @@ import numpy as np
 NONE = 0
 FLOAT16 = 1
 UNIFORM8BIT = 2
+UNIFORM4BIT = 3
 
 #: elements >= this threshold use 8-bit, below it fp16 (task.py:125-126)
 SIZE_ADAPTIVE_THRESHOLD = 2 ** 16 + 1
 
 _QBLOCK = 256
+#: u4 quantization block. Larger than u8's 256 so the per-block f32
+#: scale overhead shrinks with the payload: u4 wire bytes are
+#: n/2 + 4*ceil(n/1024) ~ 0.504n vs u8's n + 4*ceil(n/256) ~ 1.016n —
+#: a >= 2x sync-byte reduction (the r15 gate), where a 256-element u4
+#: block would land at 1.97x. 1024 = 8 * 128 keeps the block a native
+#: TPU tile row (ops/pallas/quant_kernels.py).
+_QBLOCK4 = 1024
+
+
+def codec_for_bits(bits: "int | None") -> "int | None":
+    """CollabConfig.wire_bits_* knob -> codec id (None passes through).
+
+    The ONE mapping every wire_bits consumer shares — the optimizer,
+    the averaging assistant, the churn soak and the payload bench: a
+    consumer that mapped the knob differently would be banned as codec
+    flapping on every pinned round."""
+    if bits is None:
+        return None
+    if bits == 8:
+        return UNIFORM8BIT
+    if bits == 4:
+        return UNIFORM4BIT
+    raise ValueError(f"wire_bits must be None, 4 or 8 (got {bits!r})")
+
+
+def codec_block(codec: int) -> int:
+    """Quantization block of ``codec`` in elements (1 for the
+    unblocked codecs): wire chunk boundaries must be multiples of this
+    for whole-part encodes to slice per chunk (device_codec)."""
+    if codec == UNIFORM8BIT:
+        return _QBLOCK
+    if codec == UNIFORM4BIT:
+        return _QBLOCK4
+    return 1
 
 
 def compress_f16(x: np.ndarray) -> bytes:
@@ -89,6 +124,72 @@ def decompress_u8(buf: bytes) -> np.ndarray:
     return padded.reshape(-1)[:n]
 
 
+def compress_u4(x: np.ndarray) -> bytes:
+    """Block-wise symmetric uniform quantization to 4-bit nibbles.
+
+    Layout: u32 n, then ceil(n/1024) fp32 scales, then ceil(n/2) bytes
+    of packed codes — two per byte, low nibble first (code 8 = zero,
+    scale = max|x| per block / 7; an odd tail pads nibble 0, sliced off
+    at decode). Same op sequence as the u8 codec so the device twin
+    (swarm/device_codec.py) stays byte-compatible.
+    """
+    flat = np.asarray(x, np.float32).reshape(-1)
+    n = flat.size
+    pad = (-n) % _QBLOCK4
+    padded = np.pad(flat, (0, pad)).reshape(-1, _QBLOCK4)  # working copy
+    scales = np.abs(padded).max(axis=1)
+    scales /= 7.0
+    safe = np.where(scales > 0, scales, 1.0)
+    np.divide(padded, safe[:, None], out=padded)
+    np.rint(padded, out=padded)
+    np.clip(padded, -8.0, 7.0, out=padded)
+    padded += 8.0
+    codes = padded.astype(np.uint8).reshape(-1)[:n]
+    if n % 2:
+        codes = np.concatenate([codes, np.zeros(1, np.uint8)])
+    packed = codes[0::2] | (codes[1::2] << 4)
+    return (struct.pack(">I", n) + scales.astype(np.float32).tobytes()
+            + packed.tobytes())
+
+
+def decompress_u4(buf: bytes) -> np.ndarray:
+    (n,) = struct.unpack(">I", buf[:4])
+    nblocks = (n + _QBLOCK4 - 1) // _QBLOCK4
+    scales = np.frombuffer(buf, np.float32, count=nblocks, offset=4)
+    packed = np.frombuffer(buf, np.uint8, count=(n + 1) // 2,
+                           offset=4 + 4 * nblocks)
+    codes = np.empty(2 * packed.size, np.uint8)
+    codes[0::2] = packed & 0x0F
+    codes[1::2] = packed >> 4
+    out = codes[:n].astype(np.float32)   # the one working copy
+    out -= 8.0
+    pad = nblocks * _QBLOCK4 - n
+    padded = np.pad(out, (0, pad)) if pad else out
+    padded = padded.reshape(nblocks, _QBLOCK4)
+    padded *= scales[:, None]
+    return padded.reshape(-1)[:n]
+
+
+def quant_payload_valid(buf: bytes, codec: int, n: int) -> bool:
+    """Structural validity of a u8/u4 wire payload for ``n`` elements
+    WITHOUT decoding it — the deferred-decode twin of the decompress
+    try/except in allreduce._parse (every byte is a valid code for
+    these codecs, so header + length checks are exactly as strict).
+    The fused device accumulate (device_codec.py) consumes validated
+    payloads whole instead of per-chunk host floats."""
+    if codec not in (UNIFORM8BIT, UNIFORM4BIT):
+        return False
+    if len(buf) < 4:
+        return False
+    (n_hdr,) = struct.unpack(">I", buf[:4])
+    if n_hdr != n:
+        return False
+    block = codec_block(codec)
+    nblocks = (n + block - 1) // block
+    code_bytes = n if codec == UNIFORM8BIT else (n + 1) // 2
+    return len(buf) >= 4 + 4 * nblocks + code_bytes
+
+
 def adaptive_codec(n_elements: int,
                    threshold: int = SIZE_ADAPTIVE_THRESHOLD) -> int:
     """SizeAdaptiveCompression dispatch (reference task.py:125-126)."""
@@ -108,6 +209,8 @@ def compress(x: np.ndarray, codec: int) -> bytes:
         return compress_f16(x)
     if codec == UNIFORM8BIT:
         return compress_u8(x)
+    if codec == UNIFORM4BIT:
+        return compress_u4(x)
     raise ValueError(f"unknown codec {codec}")
 
 
@@ -118,6 +221,11 @@ def decompress(buf: bytes, codec: int, n: int) -> np.ndarray:
         return decompress_f16(buf, n)
     if codec == UNIFORM8BIT:
         out = decompress_u8(buf)
+        if out.size != n:
+            raise ValueError(f"decoded {out.size} elements, expected {n}")
+        return out
+    if codec == UNIFORM4BIT:
+        out = decompress_u4(buf)
         if out.size != n:
             raise ValueError(f"decoded {out.size} elements, expected {n}")
         return out

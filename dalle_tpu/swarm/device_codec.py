@@ -49,6 +49,7 @@ import numpy as np
 from dalle_tpu.swarm import compression
 
 _QBLOCK = compression._QBLOCK
+_QBLOCK4 = compression._QBLOCK4
 
 _F16_MIN = float(np.finfo(np.float16).min)
 _F16_MAX = float(np.finfo(np.float16).max)
@@ -75,13 +76,15 @@ def resolve_backend(name: Optional[str]) -> str:
 # input bytes. Do not "simplify" the order (e.g. folding /127 into the
 # divide): it changes rounding and breaks cross-peer wire parity.
 #
-# The 127 divisor is passed as a RUNTIME operand, never a literal: XLA's
-# simplifier strength-reduces divide-by-constant into multiply-by-
-# reciprocal, which is 1 ulp off the IEEE divide for ~3% of absmax
-# values — enough to flip wire scale bytes vs the host codec (caught by
-# the parity tests at n=2^16). A traced operand keeps the true divide.
+# The 127 (and the u4 path's 7) divisor is passed as a RUNTIME operand,
+# never a literal: XLA's simplifier strength-reduces divide-by-constant
+# into multiply-by-reciprocal, which is 1 ulp off the IEEE divide for
+# ~3% of absmax values — enough to flip wire scale bytes vs the host
+# codec (caught by the parity tests at n=2^16). A traced operand keeps
+# the true divide.
 
 _D127: Optional[jax.Array] = None
+_D7: Optional[jax.Array] = None
 
 
 def _d127() -> jax.Array:
@@ -89,6 +92,13 @@ def _d127() -> jax.Array:
     if _D127 is None:
         _D127 = jnp.asarray(np.float32(127.0))
     return _D127
+
+
+def _d7() -> jax.Array:
+    global _D7
+    if _D7 is None:
+        _D7 = jnp.asarray(np.float32(7.0))
+    return _D7
 
 
 @jax.jit
@@ -115,6 +125,40 @@ def _dec_u8(codes: jax.Array, scales: jax.Array) -> jax.Array:
     c = jnp.pad(codes, (0, n_blocks * _QBLOCK - n)).astype(jnp.float32)
     c = c - 128.0
     out = c.reshape(n_blocks, _QBLOCK) * scales[:, None]
+    return out.reshape(-1)[:n]
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _enc_u4_impl(flat: jax.Array, d7: jax.Array, n: int):
+    """(packed codes (ceil(n/2),) u8 — two per byte, low nibble first —
+    scales (ceil(n/1024),) f32). Same IEEE op order as the host
+    compress_u4 and the Pallas u4 kernel; an odd tail packs nibble 0
+    exactly like the host codec."""
+    n_blocks = -(-n // _QBLOCK4)
+    blocks = jnp.pad(flat, (0, n_blocks * _QBLOCK4 - n)).reshape(
+        n_blocks, _QBLOCK4)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = absmax / d7
+    safe = jnp.where(scales > 0, scales, 1.0)
+    q = jnp.clip(jnp.rint(blocks / safe[:, None]), -8.0, 7.0) + 8.0
+    codes = q.astype(jnp.uint8).reshape(-1)[:n]
+    codes = jnp.pad(codes, (0, n % 2))
+    packed = codes[0::2] | (codes[1::2] << 4)
+    return packed, scales
+
+
+def _enc_u4_xla(flat: jax.Array):
+    return _enc_u4_impl(flat, _d7(), flat.shape[0])
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _dec_u4(packed: jax.Array, scales: jax.Array, n: int) -> jax.Array:
+    n_blocks = scales.shape[0]
+    codes = jnp.stack([packed & 0x0F, packed >> 4], axis=1).reshape(-1)
+    c = jnp.pad(codes[:n], (0, n_blocks * _QBLOCK4 - n)).astype(
+        jnp.float32)
+    c = c - 8.0
+    out = c.reshape(n_blocks, _QBLOCK4) * scales[:, None]
     return out.reshape(-1)[:n]
 
 
@@ -151,6 +195,24 @@ def _encode_u8(flat: jax.Array):
     return _enc_u8_xla(flat)
 
 
+@jax.jit
+def _pack_nibbles(codes: jax.Array) -> jax.Array:
+    padded = jnp.pad(codes, (0, codes.shape[0] % 2))
+    return padded[0::2] | (padded[1::2] << 4)
+
+
+def _encode_u4(flat: jax.Array):
+    """(packed codes (ceil(n/2),) u8, scales (ceil(n/1024),) f32) —
+    Pallas VPU quantize + XLA nibble pack on TPU, one XLA program
+    elsewhere; wire bytes identical either way."""
+    if jax.default_backend() == "tpu" and flat.shape[0] > 0:
+        from dalle_tpu.ops.pallas.quant_kernels import \
+            wire_quantize_u4_pallas
+        codes, scales = wire_quantize_u4_pallas(flat)
+        return _pack_nibbles(codes), scales
+    return _enc_u4_xla(flat)
+
+
 def flatten_device(tensors: Sequence) -> jax.Array:
     """Device-side flatten_tensors: one jitted concat, no host pull.
     Accepts a mix of device and host arrays (host leaves are pushed)."""
@@ -178,6 +240,12 @@ def compress(x, codec: int) -> bytes:
         return (struct.pack(">I", codes_np.size)
                 + scales_np.astype(np.float32, copy=False).tobytes()
                 + codes_np.tobytes())
+    if codec == compression.UNIFORM4BIT:
+        packed, scales = _encode_u4(flat)
+        packed_np, scales_np = jax.device_get((packed, scales))
+        return (struct.pack(">I", flat.shape[0])
+                + scales_np.astype(np.float32, copy=False).tobytes()
+                + packed_np.tobytes())
     raise ValueError(f"unknown codec {codec}")
 
 
@@ -199,23 +267,36 @@ def decompress(buf: bytes, codec: int, n: int) -> np.ndarray:
         if out.size != n:
             raise ValueError(f"decoded {out.size} elements, expected {n}")
         return out
+    if codec == compression.UNIFORM4BIT:
+        (n_hdr,) = struct.unpack(">I", buf[:4])
+        n_blocks = (n_hdr + _QBLOCK4 - 1) // _QBLOCK4
+        scales = np.frombuffer(buf, np.float32, count=n_blocks, offset=4)
+        packed = np.frombuffer(buf, np.uint8, count=(n_hdr + 1) // 2,
+                               offset=4 + 4 * n_blocks)
+        out = np.asarray(_dec_u4(jnp.asarray(packed), jnp.asarray(scales),
+                                 int(n_hdr)))
+        if out.size != n:
+            raise ValueError(f"decoded {out.size} elements, expected {n}")
+        return out
     raise ValueError(f"unknown codec {codec}")
 
 
 # -- whole-part encode for the all-reduce hot path -----------------------
 
 class EncodedPart:
-    """A u8-quantized all-reduce part: packed device buffers from one
-    encode call, materialized to host AT MOST once (lock-guarded — chunk
-    producers race on it from the send pool), then framed per chunk by
-    byte slicing. ``decoded`` caches the device dequantize of the same
+    """A u8- or u4-quantized all-reduce part: packed device buffers from
+    one encode call, materialized to host AT MOST once (lock-guarded —
+    chunk producers race on it from the send pool), then framed per chunk
+    by byte slicing. ``decoded`` caches the device dequantize of the same
     buffers for the gather phase's local apply, so the applied values are
     exactly the wire bytes' values."""
 
-    def __init__(self, codes: jax.Array, scales: jax.Array, n: int):
-        self._codes_dev = codes
+    def __init__(self, codes: jax.Array, scales: jax.Array, n: int,
+                 codec: int = compression.UNIFORM8BIT):
+        self._codes_dev = codes          # u4: packed nibble pairs
         self._scales_dev = scales
         self.n = n
+        self.codec = codec
         self._lock = threading.Lock()
         self._codes: Optional[np.ndarray] = None
         self._scales: Optional[np.ndarray] = None
@@ -227,37 +308,58 @@ class EncodedPart:
                 self._codes, self._scales = jax.device_get(
                     (self._codes_dev, self._scales_dev))
 
+    def decoded_dev(self) -> jax.Array:
+        """The dequantized part as a DEVICE array — what every receiver
+        of these wire bytes decodes; the error-feedback residual update
+        (swarm/error_feedback.py) subtracts it from the compensated
+        gradient without a host round-trip."""
+        if self.codec == compression.UNIFORM4BIT:
+            return _dec_u4(self._codes_dev, self._scales_dev, self.n)
+        return _dec_u8(self._codes_dev, self._scales_dev)
+
     def _decode(self) -> np.ndarray:
         with self._lock:
             if self._decoded is None:
-                self._decoded = np.asarray(
-                    _dec_u8(self._codes_dev, self._scales_dev))
+                self._decoded = np.asarray(self.decoded_dev())
             return self._decoded
 
 
-def encode_part(src, lo: int, hi: int) -> "EncodedPart":
-    """Quantize ``src[lo:hi]`` blockwise-u8 in ONE device call (async
-    dispatch — returns immediately with the device buffers in flight).
-    ``src`` is the device-flattened gradient vector; a host array works
-    too (pushed once, e.g. the gather phase's host-accumulated part)."""
+def encode_part(src, lo: int, hi: int,
+                codec: int = compression.UNIFORM8BIT) -> "EncodedPart":
+    """Quantize ``src[lo:hi]`` blockwise (u8 or u4) in ONE device call
+    (async dispatch — returns immediately with the device buffers in
+    flight). ``src`` is the device-flattened gradient vector; a host
+    array works too (pushed once, e.g. the gather phase's
+    host-accumulated part)."""
     piece = _as_flat_f32(src[lo:hi])
+    if codec == compression.UNIFORM4BIT:
+        packed, scales = _encode_u4(piece)
+        return EncodedPart(packed, scales, hi - lo, codec)
+    if codec != compression.UNIFORM8BIT:
+        raise ValueError(f"encode_part: unsupported codec {codec}")
     codes, scales = _encode_u8(piece)
-    return EncodedPart(codes, scales, hi - lo)
+    return EncodedPart(codes, scales, hi - lo, codec)
 
 
 def part_payload(enc: EncodedPart, clo: int, chi: int) -> bytes:
     """Wire payload of the chunk ``[clo, chi)`` of an encoded part —
-    byte-identical to ``compression.compress(part[clo:chi], UNIFORM8BIT)``
-    provided ``clo`` is a multiple of the 256-element quant block (the
-    caller guarantees it: CHUNK_ELEMS is). Pure byte slicing after the
-    one-time materialize."""
-    assert clo % _QBLOCK == 0, "chunk start must align to the quant block"
+    byte-identical to ``compression.compress(part[clo:chi], enc.codec)``
+    provided ``clo`` is a multiple of the codec's quant block (the
+    caller guarantees it: CHUNK_ELEMS is a multiple of both, and the u4
+    block's evenness means nibble pairs never straddle a chunk). Pure
+    byte slicing after the one-time materialize."""
+    block = compression.codec_block(enc.codec)
+    assert clo % block == 0, "chunk start must align to the quant block"
     enc._materialize()
-    b_lo = clo // _QBLOCK
-    b_hi = (chi + _QBLOCK - 1) // _QBLOCK
+    b_lo = clo // block
+    b_hi = (chi + block - 1) // block
+    if enc.codec == compression.UNIFORM4BIT:
+        body = enc._codes[clo // 2:(chi + 1) // 2]
+    else:
+        body = enc._codes[clo:chi]
     return (struct.pack(">I", chi - clo)
             + enc._scales[b_lo:b_hi].tobytes()
-            + enc._codes[clo:chi].tobytes())
+            + body.tobytes())
 
 
 def part_decode(enc: EncodedPart, clo: int, chi: int) -> np.ndarray:
@@ -266,3 +368,79 @@ def part_decode(enc: EncodedPart, clo: int, chi: int) -> np.ndarray:
     the part owner's local apply. One device dequantize per part, then
     host views."""
     return enc._decode()[clo:chi]
+
+
+# -- fused owner accumulation (the reduce phase's hot path) ---------------
+# Per completed sender: wire codes + scales in, the f32 part accumulator
+# in/out (DONATED) — the owner's per-chunk host f32 numpy (decode into a
+# buffer, then acc += seg * w) collapses into device dispatches, and
+# only the finished accumulator ever crosses back to the host (once, at
+# averaging time). The decode·weight multiply and the accumulator add
+# are deliberately TWO executables, not one: inside a single XLA program
+# the CPU (and TPU) backends contract mul+add into an FMA — one rounding
+# where the host path takes two — which flips low bits against the r14
+# protocol and the audit replay (measured: optimization_barrier does NOT
+# block the contraction). Across executable boundaries contraction is
+# impossible, and nothing but the two dispatches' latency is lost.
+
+@jax.jit
+def _dec_mul_u8(codes: jax.Array, scales: jax.Array,
+                w: jax.Array) -> jax.Array:
+    return _dec_u8(codes, scales) * w
+
+
+@functools.partial(jax.jit, static_argnums=3)
+def _dec_mul_u4(packed: jax.Array, scales: jax.Array, w: jax.Array,
+                n: int) -> jax.Array:
+    return _dec_u4(packed, scales, n) * w
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _acc_add(acc: jax.Array, contrib: jax.Array) -> jax.Array:
+    return acc + contrib
+
+
+def add_contrib(acc: jax.Array, contrib) -> jax.Array:
+    """Add a HOST-computed weighted contribution to the donated device
+    accumulator — the fused reduce's fallback for senders whose frames
+    arrived in some other codec (an unpinned round's r14 mixed-codec
+    interop). The add is the same IEEE f32 elementwise op as the host
+    path's, so parity holds."""
+    return _acc_add(acc, jnp.asarray(contrib))
+
+
+def accumulator_init(src, lo: int, hi: int, weight: float) -> jax.Array:
+    """The owner's own contribution as the device accumulator seed —
+    ``src[lo:hi] * weight`` with the same f32 multiply the host path
+    runs."""
+    return _as_flat_f32(src[lo:hi]) * jnp.float32(weight)
+
+
+def fused_accumulate(acc: jax.Array, payloads: Sequence[bytes],
+                     codec: int, n: int, w: float) -> jax.Array:
+    """Apply one sender's complete contribution to the donated device
+    accumulator. ``payloads`` are the sender's validated wire chunk
+    payloads in chunk order (compression.quant_payload_valid): their
+    scale and code byte ranges concatenate into the whole part's
+    because chunk boundaries are quant-block multiples."""
+    block = compression.codec_block(codec)
+    # one header parse per payload (this IS the reduce hot path)
+    ns = [struct.unpack(">I", p[:4])[0] for p in payloads]
+    blks = [(pn + block - 1) // block for pn in ns]
+    scales = np.concatenate([
+        np.frombuffer(p, np.float32, count=nb, offset=4)
+        for p, nb in zip(payloads, blks)])
+    if codec == compression.UNIFORM4BIT:
+        codes = np.concatenate([
+            np.frombuffer(p, np.uint8, count=(pn + 1) // 2,
+                          offset=4 + 4 * nb)
+            for p, pn, nb in zip(payloads, ns, blks)])
+        contrib = _dec_mul_u4(jnp.asarray(codes), jnp.asarray(scales),
+                              jnp.float32(w), n)
+        return _acc_add(acc, contrib)
+    codes = np.concatenate([
+        np.frombuffer(p, np.uint8, count=pn, offset=4 + 4 * nb)
+        for p, pn, nb in zip(payloads, ns, blks)])
+    contrib = _dec_mul_u8(jnp.asarray(codes), jnp.asarray(scales),
+                          jnp.float32(w))
+    return _acc_add(acc, contrib)

@@ -1,0 +1,187 @@
+"""Error-feedback residuals for the quantized butterfly all-reduce.
+
+EQuARX (arXiv 2506.17615) moves quantization INSIDE the collective;
+DynamiQ (arXiv 2602.08923) adds a second compression stage at the
+aggregation hop. Both hold convergence the same way: every quantizer
+keeps the error it just made and adds it back before quantizing the
+next round, so the quantization noise telescopes instead of
+accumulating (classic EF-SGD, Karimireddy et al. arXiv 1901.09847).
+This module is that state, in the two shapes the butterfly needs:
+
+- **Scatter leg (sender side).** One persistent residual per peer,
+  sized to the flattened gradient vector. ``compensate(flat)`` adds
+  the previous round's error to this round's (already weight-
+  normalized) gradients before the per-part wire encode;
+  ``store(comp, decoded_segs)`` records the new error as
+  ``comp - concat(decoded_segs)``, where the segments are what each
+  part OWNER actually decoded — the peer's own part decodes to itself
+  (it is applied raw f32, so its pending error is delivered in full
+  and its residual clears). Device arrays ride jitted DONATED programs
+  (the old residual buffer is consumed by the compensate add, the
+  compensated vector by the store subtract), so at flagship scale the
+  residual never costs a host copy; host numpy arrays take the same
+  math elementwise.
+
+- **Gather leg (owner side).** ``compensate_slice`` /
+  ``store_slice``: the owner re-quantizes its averaged part for the
+  broadcast (the DynamiQ second stage) with its own residual carried
+  between rounds. The residual persists full-vector-sized because
+  part boundaries move with the roster; only the slice this peer owns
+  this round is read and written. Host-resident: the averaged part is
+  already host-side for the trust layers (screen/audit/tamper seams).
+
+Determinism contract (the audit carry-over, swarm/audit.py): the
+scatter residual never needs replaying — the sender-signed frames pin
+the bytes actually sent, whatever compensation produced them. The
+GATHER residual would make a challenged owner's served part depend on
+private cross-round state, so ``run_allreduce`` SUSPENDS the gather
+carry-in on audit-challenged parts (the deterministic challenge is
+known to everyone at round start): the replay's codec round-trip of
+the replayed average is then bit-exact, and no residual — which an
+owner could fabricate to "explain" a wrong part — ever appears in a
+transcript. The round's fresh quantization error is still stored, so
+an audited round costs one carry, not the whole feedback loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+try:  # host-only peers use the numpy paths without importing jax
+    import jax
+    import jax.numpy as jnp
+except ImportError:  # pragma: no cover - jax is baked into this container
+    jax = None
+    jnp = None
+
+Array = Union[np.ndarray, "jax.Array"]
+
+
+def _is_device(x) -> bool:
+    return jax is not None and isinstance(x, jax.Array)
+
+
+if jax is not None:
+    # only the residual is donated: the add has ONE output, so donating
+    # the flat too would leave an unusable donation (and a jax warning)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _ef_add(resid, flat):
+        return flat + resid
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _ef_store(comp, segs):
+        return comp - jnp.concatenate(segs)
+
+
+class ErrorFeedback:
+    """Persistent quantization-error residual for one all-reduce leg.
+
+    A fresh instance starts at zero error; the buffer (re)initializes
+    whenever the vector size changes (model/shape change). The scatter
+    API (``compensate``/``store``) consumes and replaces the whole
+    residual each round; the gather API (``compensate_slice``/
+    ``store_slice``) updates only the owned slice.
+    """
+
+    def __init__(self) -> None:
+        self._resid: Optional[Array] = None
+        self._in_flight = False
+        self.rounds = 0        # stores completed — observability/tests
+        self.lost_rounds = 0   # consumed-but-never-stored residuals
+
+    # -- scatter leg (whole vector, device-capable) --------------------
+
+    def compensate(self, flat: Array) -> Array:
+        """``flat + residual``. Device inputs run the donated jitted
+        add (the old residual buffer is consumed); the caller MUST
+        rebind its vector to the return value. A round that dies
+        between compensate and store loses its residual (the device
+        buffer was donated into the compensated vector) — EF restarts
+        from zero, which is safe-but-lossy, so the loss is COUNTED
+        and logged rather than silent."""
+        if self._in_flight:
+            self.lost_rounds += 1
+            logger.warning(
+                "error-feedback residual lost: the previous round "
+                "consumed it and never stored (failed round?) — "
+                "restarting from zero (%d lost so far)",
+                self.lost_rounds)
+        n = int(flat.shape[0])
+        if self._resid is None or int(self._resid.shape[0]) != n:
+            self._resid = (jnp.zeros((n,), jnp.float32)
+                           if _is_device(flat)
+                           else np.zeros(n, np.float32))
+        resid = self._resid
+        self._resid = None  # consumed (and donated, on device)
+        self._in_flight = True
+        if _is_device(flat):
+            return _ef_add(resid, flat)
+        return flat + np.asarray(resid, np.float32)
+
+    def store(self, comp: Array, decoded_segs: Sequence[Array]) -> None:
+        """``residual = comp - concat(decoded_segs)`` — the error the
+        wire just made. ``decoded_segs`` cover the vector contiguously
+        in part order (the peer's own part decodes to itself). Device
+        inputs donate ``comp``: the caller must not read it again."""
+        if _is_device(comp):
+            self._resid = _ef_store(comp, list(decoded_segs))
+        else:
+            decoded = (np.asarray(decoded_segs[0], np.float32)
+                       if len(decoded_segs) == 1 else np.concatenate(
+                           [np.asarray(s, np.float32)
+                            for s in decoded_segs]))
+            self._resid = comp - decoded
+        self._in_flight = False
+        self.rounds += 1
+
+    # -- gather leg (owned slice of a persistent full vector) ----------
+
+    def compensate_slice(self, part: np.ndarray, lo: int, hi: int,
+                         total: int) -> np.ndarray:
+        """``part + residual[lo:hi]`` (host). The residual persists at
+        ``total`` elements across rounds; slices outside this round's
+        ownership keep their pending error for whenever this peer owns
+        them again. A round that dies between compensate and store
+        leaves the slice's residual in place even though SOME receivers
+        may already hold the compensated part — the next carry can
+        double-apply up to one quantization step, so (like the scatter
+        leg's loss) the window is COUNTED and logged, never silent."""
+        if self._in_flight:
+            self.lost_rounds += 1
+            logger.warning(
+                "gather error-feedback residual re-carried without a "
+                "store (failed round?) — receivers of the dead round "
+                "may see up to one extra quantization step (%d such "
+                "rounds so far)", self.lost_rounds)
+        if self._resid is None or int(self._resid.shape[0]) != total:
+            self._resid = np.zeros(total, np.float32)
+        self._in_flight = True
+        return part + self._resid[lo:hi]
+
+    def store_slice(self, comp_part: np.ndarray, decoded: np.ndarray,
+                    lo: int, hi: int, total: int) -> None:
+        if self._resid is None or int(self._resid.shape[0]) != total:
+            self._resid = np.zeros(total, np.float32)
+        self._resid[lo:hi] = comp_part - decoded
+        self._in_flight = False
+        self.rounds += 1
+
+    # -- observability --------------------------------------------------
+
+    def residual_host(self) -> Optional[np.ndarray]:
+        """Host copy of the residual (None before any round) — tests
+        and the convergence A/B read it; never mutate through it."""
+        if self._resid is None:
+            return None
+        return np.asarray(self._resid, np.float32)
+
+
+def make_pair() -> List[ErrorFeedback]:
+    """(scatter EF, gather EF) — the two legs one peer carries."""
+    return [ErrorFeedback(), ErrorFeedback()]

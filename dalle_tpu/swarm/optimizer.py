@@ -229,6 +229,44 @@ class CollaborativeOptimizer:
             self._powersgd = None
             self._grad_codec = _CODECS[cfg.grad_compression]
         self._state_codec = _CODECS[cfg.state_compression]
+        # In-collective quantization (r15): wire_bits_reduce/_gather pin
+        # the butterfly legs' codecs for the run (receivers reject codec
+        # flapping); ef_residuals arms both error-feedback legs —
+        # sender-side scatter compensation and the owner's gather
+        # second stage (swarm/error_feedback.py). Grad rounds only:
+        # state averaging keeps its own codec, PowerSGD factor rounds
+        # are a different compression family entirely.
+        wb_r = getattr(cfg, "wire_bits_reduce", None)
+        wb_g = getattr(cfg, "wire_bits_gather", None)
+        ef_on = getattr(cfg, "ef_residuals", False)
+        # the shared knob mapping (compression.codec_for_bits) raises
+        # on anything outside {None, 4, 8}
+        reduce_codec = compression.codec_for_bits(wb_r)
+        gather_codec = compression.codec_for_bits(wb_g)
+        if (wb_r is not None or wb_g is not None or ef_on) \
+                and self._powersgd is not None:
+            raise ValueError(
+                "wire_bits_*/ef_residuals pin the uniform wire codec; "
+                "power_sgd exchanges low-rank factors — choose one "
+                "compression family")
+        if ef_on and (wb_r is None or wb_g is None):
+            raise ValueError(
+                "ef_residuals carries quantization error between rounds, "
+                "which is only meaningful against a STABLE codec: pin "
+                "both wire_bits_reduce and wire_bits_gather (8 or 4)")
+        if reduce_codec is not None:
+            self._grad_codec = reduce_codec
+        self._gather_codec = gather_codec
+        # a wire_bits run is a PINNED run: receivers reject codec
+        # flapping (run_allreduce pin_codec)
+        self._pin_codec = wb_r is not None or wb_g is not None
+        if ef_on:
+            from dalle_tpu.swarm.error_feedback import ErrorFeedback
+            self._ef_scatter = ErrorFeedback()
+            self._ef_gather = ErrorFeedback()
+        else:
+            self._ef_scatter = None
+            self._ef_gather = None
         self._grad_acc = None
         self._accumulate = jax.jit(
             lambda acc, g, s: jax.tree.map(
@@ -470,7 +508,10 @@ class CollaborativeOptimizer:
                         codec_backend=self._codec_backend,
                         ledger=self.ledger, screen=self._screen,
                         max_peer_weight=self._max_peer_weight,
-                        audit=ra)
+                        audit=ra, gather_codec=self._gather_codec,
+                        ef_scatter=self._ef_scatter,
+                        ef_gather=self._ef_gather,
+                        pin_codec=self._pin_codec)
                     if ra is not None:
                         self._auditor.submit(ra)
                 pending.result = averaged
@@ -644,7 +685,10 @@ class CollaborativeOptimizer:
                     codec_backend=self._codec_backend, ledger=self.ledger,
                     screen=self._screen,
                     max_peer_weight=self._max_peer_weight,
-                    audit=ra)
+                    audit=ra, gather_codec=self._gather_codec,
+                    ef_scatter=self._ef_scatter,
+                    ef_gather=self._ef_gather,
+                    pin_codec=self._pin_codec)
                 if ra is not None:
                     self._auditor.submit(ra)
         else:

@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -72,6 +73,58 @@ logger = logging.getLogger(__name__)
 
 #: strike reason fed to the health ledger for screened senders
 SCREEN_REASON = "screen-outlier"
+
+
+#: lane width of the fixed-order summation: n/4096 sequential
+#: vectorized adds, then one exact fsum over 4096 lane partials
+_SUM_LANES = 4096
+
+
+def _fixed_order_sum(x: np.ndarray) -> float:
+    """Build-independent f64 sum with an explicitly-spelled-out order.
+
+    The screen's verdicts are a DETERMINISM surface: the audit replay
+    (swarm/audit.py) recomputes them on arbitrary hosts and convicts
+    owners on a mismatch. numpy/BLAS reductions (np.sum, linalg.norm,
+    ``@``) sum in a SIMD-width/build-dependent order, so a mixed-build
+    fleet could split honest verdicts on ulp-boundary inputs (the
+    CHAOS.md "Known gaps" entry this function closes). Here the order
+    is fixed BY THE CODE, never by the backend: the (zero-padded)
+    input is viewed as rows of ``_SUM_LANES`` and rows are accumulated
+    one by one — pure elementwise f64 vector adds, which have no
+    intra-op reduction to reorder — then the 4096 lane partials are
+    combined with ``math.fsum``, which is exactly rounded and hence
+    order-free. Cost: one vectorized pass over the data plus an fsum
+    over 4096 scalars — near np.sum speed, not the per-element-Python
+    fsum this replaced (seconds per flagship-scale sender).
+    """
+    x = np.ascontiguousarray(x, np.float64).reshape(-1)
+    n = x.size
+    if n == 0:
+        return 0.0
+    pad = (-n) % _SUM_LANES
+    if pad:
+        x = np.concatenate([x, np.zeros(pad, np.float64)])
+    rows = x.reshape(-1, _SUM_LANES)
+    acc = rows[0].copy()
+    for i in range(1, rows.shape[0]):
+        acc += rows[i]          # explicit order: ascending row index
+    return math.fsum(acc.tolist())
+
+
+def _fsum_sq(seg: np.ndarray) -> float:
+    """Fixed-order sum of squares — see :func:`_fixed_order_sum`."""
+    return _fixed_order_sum(np.square(np.asarray(seg, np.float64)))
+
+
+def _fsum_dot(a: np.ndarray, b: np.ndarray) -> float:
+    """Fixed-order f64 dot product — see :func:`_fixed_order_sum`."""
+    return _fixed_order_sum(np.asarray(a, np.float64)
+                            * np.asarray(b, np.float64))
+
+
+def _fsum_norm(seg: np.ndarray) -> float:
+    return math.sqrt(_fsum_sq(seg))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,10 +228,9 @@ class GradientScreen:
 
     @staticmethod
     def _abs_norm(seg: np.ndarray) -> float:
-        """f64 L2 norm — the determinism surface the audit replay
-        recomputes bit-equal."""
-        return float(np.linalg.norm(
-            np.asarray(seg).astype(np.float64)))
+        """Fixed-order (fsum) f64 L2 norm — the determinism surface
+        the audit replay recomputes bit-equal on ANY host build."""
+        return _fsum_norm(seg)
 
     def over_ceiling(self, seg: np.ndarray) -> bool:
         """Whether a segment violates the absolute-norm ceiling; the
@@ -192,12 +244,14 @@ class GradientScreen:
     def _measure(contribs: Dict[int, Tuple[float, np.ndarray]],
                  keys: List[int]) -> Dict[int, Tuple[float, float]]:
         """(norm_ratio, cosine vs leave-one-out mean) per sender over
-        the given survivor set. Statistics accumulate in f64 — the
-        verdict must not depend on f32 summation order — while the
-        segments themselves are untouched (the caller's accumulation
-        stays the bit-exact f32 path)."""
-        norms = {k: float(np.linalg.norm(
-            contribs[k][1].astype(np.float64))) for k in keys}
+        the given survivor set. The reductions (norms, dots) are
+        exactly-rounded fixed-order fsum — the verdict must not depend
+        on f32 OR f64 summation order (mixed numpy builds must never
+        split audit verdicts) — while the segments themselves are
+        untouched (the caller's accumulation stays the bit-exact f32
+        path). The leave-one-out mean is built from elementwise f64
+        ops only, which are order-free by construction."""
+        norms = {k: _fsum_norm(contribs[k][1]) for k in keys}
         med = float(np.median([norms[k] for k in keys]))
         total = np.zeros(contribs[keys[0]][1].size, np.float64)
         total_w = 0.0
@@ -215,8 +269,8 @@ class GradientScreen:
                 out[k] = (ratio, 1.0)  # nobody to disagree with
                 continue
             loo = (total - seg.astype(np.float64) * w) / rest_w
-            denom = norms[k] * float(np.linalg.norm(loo))
-            cos = (float(seg.astype(np.float64) @ loo) / denom
+            denom = norms[k] * _fsum_norm(loo)
+            cos = (_fsum_dot(seg, loo) / denom
                    if denom > 0.0 else 1.0)  # a zero vector harms nobody
             out[k] = (ratio, cos)
         return out

@@ -132,17 +132,40 @@ from dalle_tpu.swarm.state_transfer import (StateServer,  # noqa: E402
 
 STATE_ELEMS = 256
 
+#: soak wire codecs by --wire-bits (0 = the legacy exact NONE path;
+#: 8/4 via the shared knob mapping every wire_bits consumer uses)
+_WIRE_CODECS = {0: compression.NONE,
+                8: compression.codec_for_bits(8),
+                4: compression.codec_for_bits(4)}
+#: codec-exact full scale per quantized codec (see grads_for_epoch)
+_FULL_SCALE = {compression.UNIFORM8BIT: 127.0,
+               compression.UNIFORM4BIT: 7.0}
+
 
 def fingerprint(state: np.ndarray) -> str:
     return hashlib.sha256(np.ascontiguousarray(state).tobytes()) \
         .hexdigest()[:16]
 
 
-def grads_for_epoch(epoch: int, n: int = STATE_ELEMS) -> np.ndarray:
-    """The shared per-epoch contribution: small INTEGER values, so sums
-    and the divide-by-group-size renormalize back bit-exactly (k*g/k
-    == g in IEEE f32 when k*g is exact) — the convergence oracle."""
+def grads_for_epoch(epoch: int, n: int = STATE_ELEMS,
+                    full_scale: Optional[float] = None) -> np.ndarray:
+    """The shared per-epoch contribution. Legacy (``full_scale=None``,
+    the exact NONE codec): small INTEGER values, so sums and the
+    divide-by-group-size renormalize back bit-exactly (k*g/k == g in
+    IEEE f32 when k*g is exact). QUANTIZED soaks (r15: u8/u4 wire +
+    error feedback) need the convergence oracle to survive the codec
+    too, so every element is ±full_scale (127 for u8, 7 for u4): ANY
+    slice of the vector then has absmax == full_scale, the blockwise
+    scale is exactly 1.0, and quantize/dequantize round-trips every
+    value bit-exactly — the full wire machinery (codes on the wire, EF
+    buffers, fused accumulate, audit replay of quantized parts) runs
+    for real while the analytic fingerprint stays exact. EF residuals
+    are identically zero on such inputs, which is itself an oracle: a
+    nonzero residual means the codec misrounded."""
     rng = np.random.RandomState(1000 + epoch)
+    if full_scale is not None:
+        return (rng.choice([-1.0, 1.0], size=n)
+                * full_scale).astype(np.float32)
     return rng.randint(-8, 9, size=n).astype(np.float32)
 
 
@@ -202,7 +225,9 @@ class SoakPeer:
                  screen: Optional[GradientScreen] = None,
                  max_peer_weight: Optional[float] = None,
                  gossip: bool = False,
-                 audit_policy: Optional[AuditPolicy] = None):
+                 audit_policy: Optional[AuditPolicy] = None,
+                 wire_codec: int = compression.NONE,
+                 ef: bool = False):
         self.name = name
         self.node = node
         self.dht = ChaosDHT(node, plan) if plan.enabled else node
@@ -211,6 +236,19 @@ class SoakPeer:
         self.deadline = deadline
         self.mt = matchmaking_time
         self.at = allreduce_timeout
+        # r15 wire: a pinned quantized codec on both legs, with
+        # per-peer persistent error-feedback residuals. The codec-exact
+        # ±full-scale gradients (grads_for_epoch) keep the analytic
+        # convergence oracle bit-exact through real quantization.
+        self.wire_codec = wire_codec
+        self.full_scale = _FULL_SCALE.get(wire_codec)
+        if ef:
+            from dalle_tpu.swarm.error_feedback import ErrorFeedback
+            self.ef_scatter = ErrorFeedback()
+            self.ef_gather = ErrorFeedback()
+        else:
+            self.ef_scatter = None
+            self.ef_gather = None
         self.lock = threading.Lock()
         self.state = (state.copy() if state is not None
                       else np.zeros(STATE_ELEMS, np.float32))
@@ -266,7 +304,8 @@ class SoakPeer:
                 if isinstance(self.dht, ChaosDHT) and not self.dht.alive:
                     self.died = True
                     return
-                grads = grads_for_epoch(self.epoch)
+                grads = grads_for_epoch(self.epoch,
+                                        full_scale=self.full_scale)
                 averaged = grads
                 ra = (RoundAudit(self.prefix, self.epoch,
                                  self.audit_policy)
@@ -282,10 +321,13 @@ class SoakPeer:
                             [grads], weight=1.0,
                             allreduce_timeout=self.at,
                             sender_timeout=min(2.0, self.at / 3),
-                            codec=compression.NONE, ledger=self.ledger,
+                            codec=self.wire_codec, ledger=self.ledger,
                             screen=self.screen,
                             max_peer_weight=self.max_peer_weight,
-                            audit=ra)
+                            audit=ra, ef_scatter=self.ef_scatter,
+                            ef_gather=self.ef_gather,
+                            pin_codec=self.wire_codec
+                            != compression.NONE)
                         averaged = out[0]
                 except Exception as e:  # noqa: BLE001 - degraded epoch
                     # a failed round is an ALONE-equivalent epoch (the
@@ -358,7 +400,9 @@ class SoakPeer:
 def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
                   name: str, prefix: str, target_epochs: int,
                   deadline: float, mt: float, at: float,
-                  violations: List[str]) -> None:
+                  violations: List[str],
+                  wire_codec: int = compression.NONE,
+                  ef: bool = False) -> None:
     boot = None
     with peers_lock:
         for p in peers:
@@ -388,7 +432,8 @@ def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
     peer = SoakPeer(name, node, FaultPlan(), prefix,
                     target_epochs=target_epochs, deadline=deadline,
                     matchmaking_time=mt, allreduce_timeout=at,
-                    state=arrays[0].astype(np.float32), epoch=epoch)
+                    state=arrays[0].astype(np.float32), epoch=epoch,
+                    wire_codec=wire_codec, ef=ef)
     with peers_lock:
         peers.append(peer)
     peer.start()
@@ -396,6 +441,8 @@ def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
 
 def run_soak(args) -> dict:
     prefix = f"soak{args.seed}"
+    wire_codec = _WIRE_CODECS[args.wire_bits]
+    full_scale = _FULL_SCALE.get(wire_codec)
     schedule = build_schedule(args.seed, args.peers, args.epochs,
                               args.kills, args.joins)
     kill_by_peer = {k["peer"]: k["epoch"] for k in schedule["kills"]}
@@ -424,7 +471,8 @@ def run_soak(args) -> dict:
                               target_epochs=args.epochs,
                               deadline=deadline,
                               matchmaking_time=args.matchmaking_time,
-                              allreduce_timeout=args.allreduce_timeout))
+                              allreduce_timeout=args.allreduce_timeout,
+                              wire_codec=wire_codec, ef=args.ef))
     for p in peers:
         p.start()
 
@@ -443,7 +491,8 @@ def run_soak(args) -> dict:
                 target=_spawn_joiner,
                 args=(peers, peers_lock, f"joiner{n_joined}", prefix,
                       args.epochs, deadline, args.matchmaking_time,
-                      args.allreduce_timeout, violations),
+                      args.allreduce_timeout, violations, wire_codec,
+                      args.ef),
                 daemon=True, name=f"soak-join{n_joined}")
             jt.start()
             join_threads.append(jt)
@@ -480,7 +529,7 @@ def run_soak(args) -> dict:
     fps = {r["fingerprint"] for r in done}
     if len(fps) > 1:
         violations.append(f"fingerprints diverged: {sorted(fps)}")
-    want = fingerprint(sum((grads_for_epoch(e)
+    want = fingerprint(sum((grads_for_epoch(e, full_scale=full_scale)
                             for e in range(args.epochs)),
                            np.zeros(STATE_ELEMS, np.float32)))
     if done and fps != {want}:
@@ -498,7 +547,8 @@ def run_soak(args) -> dict:
                        "kills": args.kills, "joins": args.joins,
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
-                       "deadline": args.deadline},
+                       "deadline": args.deadline,
+                       "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule, "elapsed_s": elapsed,
             "peers": results, "violations": violations,
             "pass": not violations}
@@ -545,7 +595,8 @@ def _byzantine_pass(args, schedule: dict, attacks_on: bool,
                  matchmaking_time=args.matchmaking_time,
                  allreduce_timeout=args.allreduce_timeout,
                  screen=GradientScreen(ScreenPolicy()),
-                 max_peer_weight=100.0, gossip=True)
+                 max_peer_weight=100.0, gossip=True,
+                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef)
         for i, node in enumerate(nodes)]
     for p in peers:
         p.start()
@@ -577,7 +628,9 @@ def run_byzantine(args) -> dict:
     t0 = time.monotonic()
     threads_before = set(threading.enumerate())
     violations: List[str] = []
-    want = fingerprint(sum((grads_for_epoch(e) for e in range(args.epochs)),
+    full_scale = _FULL_SCALE.get(_WIRE_CODECS[args.wire_bits])
+    want = fingerprint(sum((grads_for_epoch(e, full_scale=full_scale)
+                            for e in range(args.epochs)),
                            np.zeros(STATE_ELEMS, np.float32)))
 
     control = _byzantine_pass(args, schedule, attacks_on=False,
@@ -631,7 +684,8 @@ def run_byzantine(args) -> dict:
             "params": {"peers": args.peers, "epochs": args.epochs,
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
-                       "deadline": args.deadline},
+                       "deadline": args.deadline,
+                       "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "control": control, "attack": attack,
@@ -685,7 +739,8 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
                  allreduce_timeout=args.allreduce_timeout,
                  screen=GradientScreen(ScreenPolicy()),
                  max_peer_weight=100.0, gossip=True,
-                 audit_policy=policy)
+                 audit_policy=policy,
+                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef)
         for i, node in enumerate(nodes)]
     for p in peers:
         p.start()
@@ -723,7 +778,9 @@ def run_hostile(args) -> dict:
     t0 = time.monotonic()
     threads_before = set(threading.enumerate())
     violations: List[str] = []
-    want = fingerprint(sum((grads_for_epoch(e) for e in range(args.epochs)),
+    full_scale = _FULL_SCALE.get(_WIRE_CODECS[args.wire_bits])
+    want = fingerprint(sum((grads_for_epoch(e, full_scale=full_scale)
+                            for e in range(args.epochs)),
                            np.zeros(STATE_ELEMS, np.float32)))
 
     control = _hostile_pass(args, schedule, attacks_on=False,
@@ -813,7 +870,8 @@ def run_hostile(args) -> dict:
             "params": {"peers": args.peers, "epochs": args.epochs,
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
-                       "deadline": args.deadline},
+                       "deadline": args.deadline,
+                       "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "control": control, "attack": attack,
@@ -851,10 +909,25 @@ def main(argv=None) -> int:
                              "epochs w/ gossiped receipts) + "
                              "transparency (audits off, pre-audit "
                              "byte identity) over one schedule")
+    parser.add_argument("--wire-bits", type=int, default=8,
+                        choices=(0, 4, 8),
+                        help="pinned wire codec for every round's BOTH "
+                             "legs: 8/4 = blockwise u8/u4 with "
+                             "codec-exact ±full-scale gradients (the "
+                             "r15 quantized-wire soak, EF-capable); 0 "
+                             "= the legacy exact NONE codec")
+    parser.add_argument("--ef", dest="ef", action="store_true",
+                        default=True,
+                        help="carry error-feedback residuals on both "
+                             "legs (default ON — the r15 gates run "
+                             "with EF armed; requires --wire-bits 8/4)")
+    parser.add_argument("--no-ef", dest="ef", action="store_false")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args(argv)
     if args.hostile_owner and args.byzantine:
         parser.error("--byzantine and --hostile-owner are exclusive")
+    if args.wire_bits == 0 and args.ef:
+        args.ef = False  # EF is meaningless without a quantized codec
     if args.out is None:
         args.out = os.path.join(
             _REPO, "HOSTILE_OWNER_SOAK.json" if args.hostile_owner

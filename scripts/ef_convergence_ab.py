@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Seeded short-horizon convergence A/B for in-collective quantization.
+
+The r15 wire change (u4 + error feedback through the butterfly,
+ISSUE 11 / SWARM_SCALE.md r15) halves sync bytes again — this script
+gates the OTHER half of the claim: the loss trajectory must track full
+precision. K peers share a seeded least-squares problem (each holds a
+data shard; the shared model updates by plain GD on the allreduce-
+averaged gradient), chosen so naive low-bit quantization visibly hurts:
+feature columns span ~3 decades, so inside one quant block the
+small-scale coordinates' gradient components round to ZERO every round
+(|g| < half the u4 step) and never update — exactly the bias
+error-feedback exists to fix (residuals accumulate until the
+coordinate pushes through the quantizer; EF-SGD, arXiv 1901.09847).
+
+Configs, one trajectory each, identical seeds and schedule:
+
+- ``fp32``   — exact NONE codec (the reference trajectory)
+- ``u8``     — r6-era pinned 8-bit wire, no EF
+- ``u4``     — the new 4-bit wire, no EF (the ablation that shows the
+               failure EF repairs)
+- ``u4+ef``  — the shipped r15 configuration (both EF legs)
+- ``u8+ef``  — 8-bit with EF (the intermediate point)
+
+Two execution modes, same math:
+
+- ``--wire``: loopback DHT peers through the REAL ``run_allreduce``
+  (matchmaking, chunked signed frames, AEAD) — the artifact mode,
+  slow-marked in tests (EF_CONVERGENCE_AB.json).
+- default: an in-process simulation of the butterfly's quantization
+  semantics (same part slicing, same codec round-trips, same
+  ErrorFeedback objects, owner's own part applied raw) — milliseconds,
+  the tier-1 fast variant. The sim is value-faithful, not bit-faithful
+  (sender accumulation order differs), which is all a loss-level A/B
+  needs.
+
+Gate (exit 1 on violation): final u4+ef loss within ``--tolerance``
+(relative) of fp32's, and u4+ef strictly better than u4-no-EF.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+from dalle_tpu.swarm import compression  # noqa: E402
+from dalle_tpu.swarm.allreduce import _part_slices  # noqa: E402
+from dalle_tpu.swarm.error_feedback import (ErrorFeedback,  # noqa: E402
+                                            make_pair)
+
+CONFIGS = {
+    "fp32": dict(codec=None, ef=False),
+    "u8": dict(codec=compression.UNIFORM8BIT, ef=False),
+    "u4": dict(codec=compression.UNIFORM4BIT, ef=False),
+    "u8+ef": dict(codec=compression.UNIFORM8BIT, ef=True),
+    "u4+ef": dict(codec=compression.UNIFORM4BIT, ef=True),
+}
+
+
+def make_problem(seed: int, n_peers: int, dim: int, rows_per_peer: int):
+    """Shared seeded least-squares shards with ~3 decades of feature
+    scale inside each quant block (the EF-stress shape)."""
+    rng = np.random.RandomState(seed)
+    col_scale = 10.0 ** rng.uniform(-3, 0, size=dim)
+    w_true = rng.randn(dim).astype(np.float64)
+    shards = []
+    for _ in range(n_peers):
+        x = rng.randn(rows_per_peer, dim) * col_scale
+        y = x @ w_true + 0.01 * rng.randn(rows_per_peer)
+        shards.append((x.astype(np.float32), y.astype(np.float32)))
+    return shards
+
+
+def shard_grad(w: np.ndarray, shard) -> np.ndarray:
+    x, y = shard
+    resid = x @ w - y
+    return (x.T @ resid / x.shape[0]).astype(np.float32)
+
+
+def global_loss(w: np.ndarray, shards) -> float:
+    num = sum(float(np.sum((x @ w - y) ** 2)) for x, y in shards)
+    rows = sum(x.shape[0] for x, y in shards)
+    return num / rows
+
+
+def simulate_round(flats: List[np.ndarray], efs, codec: Optional[int],
+                   gather_codec: Optional[int]) -> np.ndarray:
+    """One butterfly round's VALUE semantics in-process: part slicing,
+    per-sender codec round-trips, owner's own part raw, gather
+    re-quantize — driving the same ErrorFeedback objects the real
+    rounds do. All peers receive identical bytes, so one output."""
+    k_peers = len(flats)
+    d = flats[0].size
+    slices = _part_slices(d, k_peers)
+    if efs is not None:
+        comps = [efs[i][0].compensate(flats[i]) for i in range(k_peers)]
+    else:
+        comps = flats
+    out = np.empty(d, np.float32)
+    for k, (lo, hi) in enumerate(slices):
+        acc = comps[k][lo:hi] * np.float32(1.0)
+        total_w = 1.0
+        for i in range(k_peers):
+            if i == k:
+                continue
+            if codec is None:
+                seg = comps[i][lo:hi]
+            else:
+                seg = compression.decompress(
+                    compression.compress(comps[i][lo:hi], codec), codec,
+                    hi - lo)
+            acc = acc + seg * np.float32(1.0)
+            total_w += 1.0
+        avg = (acc / total_w).astype(np.float32)
+        if efs is not None:
+            avg = efs[k][1].compensate_slice(avg, lo, hi, d)
+        if gather_codec is None:
+            dec = avg.copy()
+        else:
+            dec = compression.decompress(
+                compression.compress(avg, gather_codec), gather_codec,
+                hi - lo)
+        if efs is not None:
+            efs[k][1].store_slice(avg, dec, lo, hi, d)
+        out[lo:hi] = dec
+    if efs is not None:
+        for i in range(k_peers):
+            decoded = np.empty(d, np.float32)
+            for k, (lo, hi) in enumerate(slices):
+                if i == k or codec is None:
+                    decoded[lo:hi] = comps[i][lo:hi]
+                else:
+                    decoded[lo:hi] = compression.decompress(
+                        compression.compress(comps[i][lo:hi], codec),
+                        codec, hi - lo)
+            efs[i][0].store(comps[i], [decoded])
+    return out
+
+
+def run_trajectory_sim(name: str, shards, epochs: int, lr: float) -> dict:
+    cfg = CONFIGS[name]
+    n_peers = len(shards)
+    dim = shards[0][0].shape[1]
+    w = np.zeros(dim, np.float32)
+    efs = [make_pair() for _ in range(n_peers)] if cfg["ef"] else None
+    losses = []
+    for _epoch in range(epochs):
+        flats = [shard_grad(w, s) for s in shards]
+        avg = simulate_round(flats, efs, cfg["codec"], cfg["codec"])
+        w = w - np.float32(lr) * avg
+        losses.append(round(global_loss(w, shards), 6))
+    return {"config": name, "mode": "sim", "losses": losses,
+            "final_loss": losses[-1]}
+
+
+def run_trajectory_wire(name: str, shards, epochs: int, lr: float,
+                        tag: str) -> dict:
+    """The same trajectory through the REAL stack: loopback DHT peers,
+    matchmaking + run_allreduce per epoch, per-peer EF objects
+    persisting across rounds (the artifact mode)."""
+    from dalle_tpu.swarm import DHT, Identity
+    from dalle_tpu.swarm.allreduce import run_allreduce
+    from dalle_tpu.swarm.identity import Ed25519PrivateKey
+    from dalle_tpu.swarm.matchmaking import make_group
+
+    cfg = CONFIGS[name]
+    n_peers = len(shards)
+    dim = shards[0][0].shape[1]
+    nodes = []
+    for i in range(n_peers):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([41 + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=5.0))
+    efs = [make_pair() if cfg["ef"] else (None, None)
+           for _ in range(n_peers)]
+    w = np.zeros(dim, np.float32)
+    losses = []
+    try:
+        for epoch in range(epochs):
+            flats = [shard_grad(w, s) for s in shards]
+            groups = [None] * n_peers
+            results: List[Optional[List[np.ndarray]]] = [None] * n_peers
+            errs: List[str] = []
+
+            def one(i, epoch=epoch):
+                try:
+                    g = make_group(nodes[i], f"efab_{tag}_{name}", epoch,
+                                   weight=1.0, matchmaking_time=2.0,
+                                   min_group_size=n_peers, encrypt=True)
+                    groups[i] = g
+                    results[i] = run_allreduce(
+                        nodes[i], g, f"efab_{tag}_{name}", epoch,
+                        [flats[i]], weight=1.0, allreduce_timeout=15.0,
+                        codec=cfg["codec"], gather_codec=cfg["codec"],
+                        chunk_elems=1024,
+                        ef_scatter=efs[i][0], ef_gather=efs[i][1])
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errs.append(f"peer{i}@{epoch}: {e!r}")
+
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in range(n_peers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise RuntimeError(errs)
+            outs = [r[0] for r in results]
+            for o in outs[1:]:
+                np.testing.assert_array_equal(outs[0], o)
+            w = w - np.float32(lr) * outs[0]
+            losses.append(round(global_loss(w, shards), 6))
+    finally:
+        for n in nodes:
+            n.shutdown()
+    return {"config": name, "mode": "wire", "losses": losses,
+            "final_loss": losses[-1]}
+
+
+def run_ab(seed: int = 0, n_peers: int = 2, dim: int = 4096,
+           rows_per_peer: int = 64, epochs: int = 24, lr: float = 0.05,
+           tolerance: float = 0.10, wire: bool = False,
+           configs=None, tag: str = "0") -> dict:
+    shards = make_problem(seed, n_peers, dim, rows_per_peer)
+    rows: Dict[str, dict] = {}
+    for name in (configs or list(CONFIGS)):
+        rows[name] = (run_trajectory_wire(name, shards, epochs, lr, tag)
+                      if wire else
+                      run_trajectory_sim(name, shards, epochs, lr))
+    violations = []
+    ref = rows.get("fp32")
+    u4ef = rows.get("u4+ef")
+    u4 = rows.get("u4")
+    if ref is not None and u4ef is not None:
+        rel = abs(u4ef["final_loss"] - ref["final_loss"]) \
+            / max(ref["final_loss"], 1e-12)
+        rows["u4+ef"]["rel_final_vs_fp32"] = round(rel, 4)
+        if rel > tolerance:
+            violations.append(
+                f"u4+ef final loss {u4ef['final_loss']} deviates "
+                f"{rel:.1%} from fp32 {ref['final_loss']} "
+                f"(tolerance {tolerance:.0%})")
+        if u4 is not None and not u4ef["final_loss"] < u4["final_loss"]:
+            violations.append(
+                f"EF bought nothing: u4+ef {u4ef['final_loss']} !< "
+                f"u4 {u4['final_loss']} — the stress problem should "
+                "punish quantization bias")
+    return {"seed": seed, "params": {
+                "n_peers": n_peers, "dim": dim,
+                "rows_per_peer": rows_per_peer, "epochs": epochs,
+                "lr": lr, "tolerance": tolerance,
+                "mode": "wire" if wire else "sim"},
+            "trajectories": rows, "violations": violations,
+            "pass": not violations}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--peers", type=int, default=2)
+    parser.add_argument("--dim", type=int, default=4096)
+    parser.add_argument("--rows", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=24)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--tolerance", type=float, default=0.10)
+    parser.add_argument("--wire", action="store_true",
+                        help="run through real loopback DHT rounds "
+                             "(the artifact mode; default is the "
+                             "in-process butterfly simulation)")
+    parser.add_argument("--out", type=str,
+                        default=os.path.join(_REPO,
+                                             "EF_CONVERGENCE_AB.json"))
+    args = parser.parse_args(argv)
+    report = run_ab(seed=args.seed, n_peers=args.peers, dim=args.dim,
+                    rows_per_peer=args.rows, epochs=args.epochs,
+                    lr=args.lr, tolerance=args.tolerance, wire=args.wire)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(f"EF convergence A/B ({report['params']['mode']}): "
+          f"{'PASS' if report['pass'] else 'FAIL'}")
+    for name, row in report["trajectories"].items():
+        print(f"  {name:>6}: final loss {row['final_loss']:.6f}"
+              + (f" (vs fp32: {row['rel_final_vs_fp32']:.2%})"
+                 if "rel_final_vs_fp32" in row else ""))
+    for v in report["violations"]:
+        print(f"  VIOLATION: {v}")
+    print(f"report: {args.out}")
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,14 +10,23 @@ against the reference's 60 s all-reduce budget (arguments.py:69-74).
 
 Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
       python scripts/swarm_payload_bench.py [n_peers ...] [assist] \
-          [--device-codec]
+          [--device-codec] [--bits {8,4}] [--ef] [--out FILE]
 
 ``--device-codec`` runs every row through the device wire codec
 (swarm/device_codec.py, ``codec_backend="device"``): parts are
-quantized as jitted whole-part programs and only packed u8/scale
+quantized as jitted whole-part programs and only packed code/scale
 buffers cross to the host — encode_s/decode_s then measure the host
 wall spent in the device codec hooks (dispatch + the one materialize
 pull per part) instead of numpy math.
+
+``--bits 8|4`` PINS the wire codec of both butterfly legs (the r15
+in-collective quantization; 4 = the blockwise-u4 stage, ~2x fewer sync
+bytes than the r6 u8 wire) instead of SizeAdaptive; ``--ef`` arms the
+error-feedback residual legs (requires --bits). Every row reports
+``wire_mb`` — actual bytes through DHT.send/post, frames + AEAD
+included — which is the sync-byte A/B the r15 gate compares
+(``--bits 4 --ef`` vs the plain u8 row). ``--out FILE`` additionally
+dumps the row list as JSON (the committed artifact).
 
 Prints one JSON line per configuration (driver-readable) plus the table
 SWARM_SCALE.md records. Note the VM has ONE host core: encode/decode of
@@ -69,14 +78,17 @@ def flagship_grad_arrays(seed: int):
 
 
 class PhaseTimers:
-    """Global (process-wide) instrumentation of codec + AEAD time. One
-    host core means per-peer attribution is moot — what matters is the
-    total CPU each stage burns vs the epoch wall clock."""
+    """Global (process-wide) instrumentation of codec + AEAD time plus
+    WIRE BYTES (every DHT.send/post payload — frames, signatures and
+    AEAD included: the honest sync-byte number the r15 A/B gates on).
+    One host core means per-peer attribution is moot — what matters is
+    the total CPU each stage burns vs the epoch wall clock."""
 
     def __init__(self):
         self.encode = 0.0
         self.decode = 0.0
         self.aead = 0.0
+        self.wire_bytes = 0
         self._lock = threading.Lock()
 
     def patch(self):
@@ -114,12 +126,29 @@ class PhaseTimers:
         # allreduce imports `compression` as a module and crypto inside
         # the function body, so module-attr patching reaches it
 
+        # wire-byte counters: class-level patch of the two outbound data
+        # planes (pushes + mailbox posts) — every loopback node counts
+        orig_send, orig_post = DHT.send, DHT.post
+
+        def counting_send(node, addr, tag, payload, *a, **kw):
+            with self._lock:
+                self.wire_bytes += len(payload)
+            return orig_send(node, addr, tag, payload, *a, **kw)
+
+        def counting_post(node, tag, payload, *a, **kw):
+            with self._lock:
+                self.wire_bytes += len(payload)
+            return orig_post(node, tag, payload, *a, **kw)
+
+        DHT.send, DHT.post = counting_send, counting_post
+
         def restore():
             compression.compress, compression.decompress = orig_c, orig_d
             crypto.maybe_encrypt, crypto.maybe_decrypt = orig_e, orig_x
             (device_codec.compress, device_codec.decompress,
              device_codec.encode_part, device_codec.part_payload,
              device_codec.part_decode) = dev_orig
+            DHT.send, DHT.post = orig_send, orig_post
         return restore
 
 
@@ -145,11 +174,13 @@ def run_threads(fns):
 
 def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
                  budget: float = 60.0, n_assist: int = 0,
-                 codec_backend: str = "host"):
+                 codec_backend: str = "host", bits=None, ef: bool = False):
     """``n_assist`` weight-0 averaging assistants (swarm/assist.py) join
     the trainers' round as extra part owners at the full flagship
     payload — the M44 mode at realistic scale. ``codec_backend="device"``
-    routes every peer's codec through the jitted device path."""
+    routes every peer's codec through the jitted device path. ``bits``
+    pins both wire legs to u8/u4 (the r15 in-collective stage) and
+    ``ef`` arms per-peer error-feedback residuals on both legs."""
     n_all = n_peers + n_assist
     nodes = []
     for _ in range(n_all):
@@ -174,14 +205,25 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
 
     compressors = [PowerSGDCompressor(rank=4) for _ in range(n_peers)]
     reports = [dict() for _ in range(n_all)]
+    pinned = compression.codec_for_bits(bits)
+    pin_kw = {}
+    if pinned is not None:
+        pin_kw = dict(codec=pinned, gather_codec=pinned)
+    efs = [None] * n_all
+    if ef:
+        from dalle_tpu.swarm.error_feedback import make_pair
+        efs = [make_pair() if i < n_peers else None
+               for i in range(n_all)]
 
     def peer(i):
+        ef_kw = {} if efs[i] is None else dict(ef_scatter=efs[i][0],
+                                               ef_gather=efs[i][1])
         if i >= n_peers:  # averaging assistant: zero template, weight 0
             template = [np.zeros(total_elems, np.float32)]
             return run_allreduce(
                 nodes[i], groups[i], f"payload_{mode}", 0, template,
                 weight=0.0, allreduce_timeout=budget, report=reports[i],
-                codec_backend=codec_backend)
+                codec_backend=codec_backend, **pin_kw)
         if mode == "power_sgd":
             def reduce_fn(tensors, phase):
                 rep = {}
@@ -198,7 +240,7 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
         out = run_allreduce(
             nodes[i], groups[i], f"payload_{mode}", 0, arrays_per_peer[i],
             weight=1.0, allreduce_timeout=budget, report=reports[i],
-            codec_backend=codec_backend)
+            codec_backend=codec_backend, **pin_kw, **ef_kw)
         return out
 
     t0 = time.monotonic()
@@ -225,10 +267,15 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
                   key=lambda p: sum(p.values()), default={})
     label = (f"{mode}, {n_peers} peers"
              + (f" + {n_assist} assist" if n_assist else "")
-             + (", device codec" if codec_backend == "device" else ""))
+             + (", device codec" if codec_backend == "device" else "")
+             + (f", u{bits} pinned" if bits else "")
+             + (" + EF" if ef else ""))
     row = {
         "metric": f"swarm payload allreduce ({label})",
         "payload_mb_f32": round(mb, 1),
+        "wire_bits": bits,
+        "ef_residuals": ef,
+        "wire_mb": round(timers.wire_bytes / 1e6, 1),
         "epoch_wall_s": round(wall, 2),
         "matchmaking_s": round(t_match, 2),
         "encode_s": round(timers.encode, 2),
@@ -246,13 +293,41 @@ def bench_config(n_peers: int, mode: str, arrays_per_peer, total_elems,
 
 
 def main():
-    device = "--device-codec" in sys.argv[1:]
-    args = [a for a in sys.argv[1:] if a != "--device-codec"]
+    argv = sys.argv[1:]
+    device = "--device-codec" in argv
+    ef = "--ef" in argv
+    bits = None
+    out_path = None
+    args = []
+    skip = False
+    for i, a in enumerate(argv):
+        if skip:
+            skip = False
+            continue
+        if a in ("--bits", "--out"):
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{a} needs a value")
+            if a == "--bits":
+                if not argv[i + 1].isdigit():
+                    raise SystemExit(
+                        f"--bits must be 8 or 4 (got {argv[i + 1]!r})")
+                bits = int(argv[i + 1])
+            else:
+                out_path = argv[i + 1]
+            skip = True
+        elif a not in ("--device-codec", "--ef"):
+            args.append(a)
     bad = [a for a in args if not a.isdigit() and a != "assist"]
     if bad:
         raise SystemExit(f"unknown arguments: {bad} "
-                         "(expected peer counts, 'assist' and/or "
-                         "'--device-codec')")
+                         "(expected peer counts, 'assist', "
+                         "'--device-codec', '--bits {8,4}', '--ef' "
+                         "and/or '--out FILE')")
+    if bits not in (None, 4, 8):
+        raise SystemExit(f"--bits must be 8 or 4 (got {bits})")
+    if ef and bits is None:
+        raise SystemExit("--ef requires --bits (EF residual scales need "
+                         "one stable pinned codec)")
     backend = "device" if device else "host"
     peer_counts = [int(a) for a in args if a.isdigit()] or [2, 4]
     # the assist and power_sgd rows are fixed 2-trainer configs
@@ -272,24 +347,33 @@ def main():
         # budget and report wall/N as the per-peer number a real host sees
         rows.append(bench_config(n, "size_adaptive", arrays[:n], total,
                                  budget=60.0 * max(1, n // 2),
-                                 codec_backend=backend))
+                                 codec_backend=backend, bits=bits, ef=ef))
     if "assist" in args:
         # M44 averaging-assist at the full flagship payload: 2 trainers
         # + 1 weight-0 assistant owning a third of the parts
         rows.append(bench_config(2, "size_adaptive", arrays[:2], total,
                                  budget=90.0, n_assist=1,
+                                 codec_backend=backend, bits=bits, ef=ef))
+    if bits is None:
+        # the PowerSGD row is a different compression family: skip it
+        # on pinned-bits runs (the r15 A/B compares uniform codecs)
+        rows.append(bench_config(2, "power_sgd", arrays[:2], total,
                                  codec_backend=backend))
-    rows.append(bench_config(2, "power_sgd", arrays[:2], total,
-                             codec_backend=backend))
 
-    print("\n| mode | peers | payload | epoch | matchmake | encode | "
-          "decode | aead |")
-    print("|---|---|---|---|---|---|---|---|")
+    print("\n| mode | peers | payload | wire | epoch | matchmake | "
+          "encode | decode | aead |")
+    print("|---|---|---|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['metric'].split('(')[1].rstrip(')')} "
-              f"| {r['payload_mb_f32']} MB | {r['epoch_wall_s']} s "
+              f"| {r['payload_mb_f32']} MB | {r['wire_mb']} MB "
+              f"| {r['epoch_wall_s']} s "
               f"| {r['matchmaking_s']} s | {r['encode_s']} s "
               f"| {r['decode_s']} s | {r['aead_s']} s |")
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(rows, fh, indent=1)
+            fh.write("\n")
+        print(f"# rows -> {out_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -108,28 +108,33 @@ def _run_threads(fns, timeout=60):
 
 def _audited_round(nodes, prefix, tensors, *, dhts=None, screen=None,
                    policy=None, mpw=100.0, codec=compression.NONE,
-                   audit_on=True, chunk_elems=None):
+                   audit_on=True, chunk_elems=None, gather_codec=None,
+                   efs=None, epoch=0):
     """One full-group round with per-peer RoundAudits armed; returns
-    (results[(group, out)], ras, ledgers)."""
+    (results[(group, out)], ras, ledgers). ``efs`` (optional
+    per-peer (scatter, gather) ErrorFeedback pairs) and
+    ``gather_codec`` arm the r15 quantized-wire legs."""
     from dalle_tpu.swarm.allreduce import CHUNK_ELEMS
     n = len(nodes)
     dhts = dhts or list(nodes)
     policy = policy or AuditPolicy(frac=1.0, fetch_timeout=2.0)
     screen = screen or GradientScreen(ScreenPolicy())
     ledgers = [PeerHealthLedger() for _ in range(n)]
-    ras = [RoundAudit(prefix, 0, policy) if audit_on else None
+    ras = [RoundAudit(prefix, epoch, policy) if audit_on else None
            for _ in range(n)]
 
     def peer(i):
-        g = make_group(dhts[i], prefix, epoch=0, weight=1.0,
+        g = make_group(dhts[i], prefix, epoch=epoch, weight=1.0,
                        matchmaking_time=2.0, min_group_size=n)
         assert g is not None and g.size == n
+        ef_kw = {} if efs is None else dict(ef_scatter=efs[i][0],
+                                            ef_gather=efs[i][1])
         return g, run_allreduce(
-            dhts[i], g, prefix, 0, tensors[i], weight=1.0,
+            dhts[i], g, prefix, epoch, tensors[i], weight=1.0,
             allreduce_timeout=8.0, sender_timeout=1.5, codec=codec,
             ledger=ledgers[i], screen=screen, max_peer_weight=mpw,
-            audit=ras[i],
-            chunk_elems=chunk_elems or CHUNK_ELEMS)
+            audit=ras[i], gather_codec=gather_codec,
+            chunk_elems=chunk_elems or CHUNK_ELEMS, **ef_kw)
 
     results = _run_threads([lambda i=i: peer(i) for i in range(n)])
     return results, ras, ledgers
@@ -554,6 +559,154 @@ class TestTransparency:
                 and not rep["omitted"]
             assert led.snapshot() == {}
             assert len(rep["ok"]) == 4
+
+
+# -- quantized wire + error feedback (r15) ---------------------------------
+
+class TestQuantizedAudit:
+    def test_ef_quantized_rounds_replay_bit_exact_across_epochs(self):
+        """The r15 trust-layer carry-over: two consecutive rounds on
+        the pinned u8-reduce/u4-gather wire with PERSISTENT per-peer
+        error-feedback residuals and a PARTIAL challenge (frac=0.5,
+        prefix chosen so the challenged set flips between epochs).
+        Unchallenged parts carry their owner's gather residual;
+        challenged parts suspend the carry — so every audited part
+        must replay bit-exactly even while live residuals exist, and
+        honest owners earn zero strikes. Real-valued (codec-inexact)
+        gradients: the quantization error is genuinely nonzero."""
+        from dalle_tpu.swarm.error_feedback import make_pair
+        rng = np.random.RandomState(3)
+        nodes = _det_swarm(5, base=121)
+        efs = [make_pair() for _ in range(5)]
+        policy = AuditPolicy(frac=0.5, fetch_timeout=2.0)
+        try:
+            gather_resid_seen = False
+            for epoch in (0, 1):
+                tensors = [[(rng.randn(640) * (1 + i)).astype(np.float32)]
+                           for i in range(5)]
+                results, ras, ledgers = _audited_round(
+                    nodes, "qa0", tensors, policy=policy,
+                    codec=compression.UNIFORM8BIT,
+                    gather_codec=compression.UNIFORM4BIT,
+                    efs=efs, chunk_elems=1024, epoch=epoch)
+                assert challenged_parts("qa0", epoch, 5, 0.5), \
+                    "prefix must challenge at least one part"
+                # every member's replay of every challenged part passes
+                for i in range(5):
+                    rep = audit_round(nodes[i], ras[i], ledgers[i])
+                    assert rep["audited"], rep
+                    assert not rep["failed"] and not rep["unserved"] \
+                        and not rep["omitted"], (epoch, i, rep)
+                    assert ledgers[i].snapshot() == {}
+                # all members ended byte-identical (the wire contract)
+                flats = [flatten_tensors(r[1]) for r in results]
+                for f in flats[1:]:
+                    assert flats[0].tobytes() == f.tobytes()
+                # the feedback loop is LIVE: scatter residuals are
+                # nonzero (real quantization error), and at least one
+                # owner carries a nonzero gather residual
+                for sc, _ga in efs:
+                    r = sc.residual_host()
+                    assert r is not None and np.abs(r).max() > 0
+                gather_resid_seen = gather_resid_seen or any(
+                    ga.residual_host() is not None
+                    and np.abs(ga.residual_host()).max() > 0
+                    for _sc, ga in efs)
+            assert gather_resid_seen
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_unpinned_mixed_codec_round_replays_clean(self):
+        """A round whose callers pass an explicit codec WITHOUT
+        opting into pinning accepts mixed-codec senders (r14
+        semantics) — and the replay must apply the SAME acceptance
+        rule: an honest owner that applied a legitimately
+        differently-coded frame is never convicted (the review-found
+        live-vs-replay asymmetry)."""
+        from dalle_tpu.swarm.allreduce import CHUNK_ELEMS
+        nodes = _det_swarm(5, base=161)
+        rng = np.random.RandomState(9)
+        tensors = [[(rng.randn(400) * (1 + i)).astype(np.float32)]
+                   for i in range(5)]
+        policy = AuditPolicy(frac=1.0, fetch_timeout=2.0)
+        screen = GradientScreen(ScreenPolicy())
+        ledgers = [PeerHealthLedger() for _ in range(5)]
+        ras = [RoundAudit("mxr", 0, policy) for _ in range(5)]
+        try:
+            def peer(i):
+                g = make_group(nodes[i], "mxr", epoch=0, weight=1.0,
+                               matchmaking_time=2.0, min_group_size=5)
+                assert g is not None and g.size == 5
+                # peer 4 runs SizeAdaptive (f16 at these sizes); the
+                # rest pass u8 explicitly but UNPINNED
+                return g, run_allreduce(
+                    nodes[i], g, "mxr", 0, tensors[i], weight=1.0,
+                    allreduce_timeout=8.0, sender_timeout=1.5,
+                    codec=None if i == 4 else compression.UNIFORM8BIT,
+                    ledger=ledgers[i], screen=screen,
+                    max_peer_weight=100.0, audit=ras[i],
+                    chunk_elems=CHUNK_ELEMS)
+
+            _run_threads([lambda i=i: peer(i) for i in range(5)])
+            for i in range(5):
+                rep = audit_round(nodes[i], ras[i], ledgers[i])
+                assert rep["audited"], rep
+                assert not rep["failed"] and not rep["unserved"] \
+                    and not rep["omitted"], (i, rep)
+                assert ledgers[i].snapshot() == {}
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_replay_uses_the_gather_codec(self):
+        """A round whose two legs pin DIFFERENT codecs: the replay
+        must re-quantize with the GATHER codec — replaying the same
+        transcript under the wrong gather codec mismatches the
+        gathered bytes (the codec is load-bearing, not decorative)."""
+        nodes = _det_swarm(4, base=141)
+        rng = np.random.RandomState(7)
+        tensors = [[(rng.randn(512) * (1 + i)).astype(np.float32)]
+                   for i in range(4)]
+        try:
+            results, ras, ledgers = _audited_round(
+                nodes, "qg", tensors, codec=compression.UNIFORM8BIT,
+                gather_codec=compression.UNIFORM4BIT, chunk_elems=1024)
+            auditor = next(
+                r for r in ras
+                if any(p != r.my_part and p in r.gathered
+                       for p in r.audited))
+            part = next(p for p in sorted(auditor.audited)
+                        if p != auditor.my_part and p in auditor.gathered)
+            owner = auditor.owners[part]
+            blob = fetch_transcript(
+                nodes[ras.index(auditor)], owner.addr, "qg", 0, part,
+                auditor.policy, group_key=auditor.group.group_key)
+            tr = open_transcript(blob, "qg", 0, part, owner.peer_id)
+            assert tr is not None
+            right = replay_transcript(
+                tr, group=auditor.group, prefix="qg", epoch=0,
+                part=part, part_elems=auditor.part_sizes[part],
+                chunk_elems=1024, codec=compression.UNIFORM8BIT,
+                adaptive_threshold=auditor.adaptive_threshold,
+                screen=auditor.screen, max_peer_weight=100.0,
+                gather_codec=compression.UNIFORM4BIT)
+            assert right.ok
+            assert right.values.tobytes() == \
+                auditor.gathered[part].tobytes()
+            wrong = replay_transcript(
+                tr, group=auditor.group, prefix="qg", epoch=0,
+                part=part, part_elems=auditor.part_sizes[part],
+                chunk_elems=1024, codec=compression.UNIFORM8BIT,
+                adaptive_threshold=auditor.adaptive_threshold,
+                screen=auditor.screen, max_peer_weight=100.0,
+                gather_codec=compression.UNIFORM8BIT)
+            assert wrong.ok  # internally consistent transcript...
+            assert wrong.values.tobytes() != \
+                auditor.gathered[part].tobytes()  # ...wrong bytes
+        finally:
+            for nd in nodes:
+                nd.shutdown()
 
 
 # -- live conviction -------------------------------------------------------

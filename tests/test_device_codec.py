@@ -17,6 +17,7 @@ from dalle_tpu.swarm.allreduce import flatten_tensors, run_allreduce
 from dalle_tpu.swarm.matchmaking import make_group
 
 U8 = compression.UNIFORM8BIT
+U4 = compression.UNIFORM4BIT
 F16 = compression.FLOAT16
 
 
@@ -31,19 +32,21 @@ def _payload(rng, n):
 
 class TestByteParity:
     # sizes hit: single partial block, exact block, block+1 (padding
-    # tail), many blocks + tail (non-multiple-of-block-size), and the
-    # SizeAdaptive threshold neighborhood
-    SIZES = [1, 5, 255, 256, 257, 1000, 2 ** 16, 2 ** 16 + 7]
+    # tail), many blocks + tail (non-multiple-of-block-size), ODD sizes
+    # (the u4 pad nibble), and the SizeAdaptive threshold neighborhood.
+    # 1023/1024/1025 are the u4 block's own boundary.
+    SIZES = [1, 5, 255, 256, 257, 1000, 1023, 1024, 1025,
+             2 ** 16, 2 ** 16 + 7]
 
     @pytest.mark.parametrize("n", SIZES)
-    @pytest.mark.parametrize("codec", [U8, F16, compression.NONE])
+    @pytest.mark.parametrize("codec", [U8, U4, F16, compression.NONE])
     def test_encode_bytes_identical(self, n, codec):
         x = _payload(np.random.default_rng(n), n)
         assert device_codec.compress(x, codec) == \
             compression.compress(x, codec)
 
-    @pytest.mark.parametrize("n", [255, 256, 257, 5000])
-    @pytest.mark.parametrize("codec", [U8, F16])
+    @pytest.mark.parametrize("n", [255, 256, 257, 1023, 1025, 5000])
+    @pytest.mark.parametrize("codec", [U8, U4, F16])
     def test_cross_decode_both_directions(self, n, codec):
         x = _payload(np.random.default_rng(n + 1), n)
         host_buf = compression.compress(x, codec)
@@ -75,6 +78,11 @@ class TestByteParity:
         t = np.tile(np.array([0.5, 1.5, 2.5, -0.5, -1.5, 127.0, -127.0,
                               63.5], np.float32), 64)
         assert device_codec.compress(t, U8) == compression.compress(t, U8)
+        # the u4 face: absmax 7 -> scale 1.0, same midpoint trap
+        t4 = np.tile(np.array([0.5, 1.5, 2.5, -0.5, -1.5, 7.0, -7.0,
+                               3.5], np.float32), 256)
+        assert device_codec.compress(t4, U4) == \
+            compression.compress(t4, U4)
 
     def test_device_array_input(self):
         x = _payload(np.random.default_rng(3), 4096)
@@ -107,11 +115,23 @@ class TestWireGolden:
     GOLD_F16 = bytes.fromhex("0000003800bcf057f0d7f053")
     Y = np.array([3e-5, -2.5e-5, 1e-5, 0.0], np.float32)
     GOLD_U8_SMALL = bytes.fromhex("00000004caa37d34ff16aa80")
+    # u4: two codes per byte (LOW nibble first), code 8 = zero, one f32
+    # scale per 1024-element block. Z's absmax 7 makes the scale exactly
+    # 1.0, so the bytes also pin round-half-even at the nibble level
+    # (0.5 -> code 8, 3.5 -> code 12).
+    GOLD_U4 = bytes.fromhex("000000069224914188f8c1")
+    Z = np.array([0.0, 0.5, -1.0, 7.0, -7.0, 3.5], np.float32)
+    GOLD_U4_UNIT = bytes.fromhex("000000060000803f88f7c1")
 
     @pytest.mark.parametrize("impl", [compression, device_codec])
     def test_u8_golden(self, impl):
         assert impl.compress(self.X, U8) == self.GOLD_U8
         assert impl.compress(self.Y, U8) == self.GOLD_U8_SMALL
+
+    @pytest.mark.parametrize("impl", [compression, device_codec])
+    def test_u4_golden(self, impl):
+        assert impl.compress(self.X, U4) == self.GOLD_U4
+        assert impl.compress(self.Z, U4) == self.GOLD_U4_UNIT
 
     @pytest.mark.parametrize("impl", [compression, device_codec])
     def test_f16_golden(self, impl):
@@ -123,6 +143,9 @@ class TestWireGolden:
         # code 128+k decodes to exactly k * scale with scale 1.0 here
         np.testing.assert_array_equal(
             got, np.array([0, 0, -1, 127, -127, 64], np.float32))
+        got4 = impl.decompress(self.GOLD_U4_UNIT[:], U4, 6)
+        np.testing.assert_array_equal(
+            got4, np.array([0, 0, -1, 7, -7, 4], np.float32))
 
 
 class TestEncodedPart:
@@ -156,6 +179,72 @@ class TestEncodedPart:
         assert device_codec.part_payload(enc, 0, 700) == \
             compression.compress(flat, U8)
 
+    def test_u4_chunk_payloads_match_host(self):
+        """The u4 whole-part encode: chunk boundaries are 1024-block
+        (hence nibble-pair) aligned, so byte slicing reproduces the
+        per-chunk host compression — odd-length final chunk included
+        (the pad nibble)."""
+        rng = np.random.default_rng(5)
+        flat = _payload(rng, 6000)
+        enc = device_codec.encode_part(jnp.asarray(flat), 512, 5535, U4)
+        part = flat[512:5535]
+        chunks = [(0, 1024), (1024, 4096), (4096, 5023)]
+        for clo, chi in chunks:
+            assert device_codec.part_payload(enc, clo, chi) == \
+                compression.compress(part[clo:chi], U4)
+            np.testing.assert_array_equal(
+                device_codec.part_decode(enc, clo, chi),
+                compression.decompress(
+                    compression.compress(part[clo:chi], U4), U4,
+                    chi - clo))
+
+    def test_unsupported_codec_rejected(self):
+        with pytest.raises(ValueError):
+            device_codec.encode_part(np.zeros(16, np.float32), 0, 16,
+                                     compression.FLOAT16)
+
+
+class TestFusedAccumulate:
+    """The r15 owner hot path: decode + weighted add on device, DONATED
+    accumulator, bit-equal to the host multiply-then-add sequence
+    (the audit replay's reference semantics)."""
+
+    @pytest.mark.parametrize("codec", [U8, U4])
+    def test_bit_parity_with_host_sequence(self, codec):
+        rng = np.random.default_rng(11)
+        n = 3000
+        own = _payload(rng, n)
+        acc_h = own * np.float32(1.5)
+        acc_d = device_codec.accumulator_init(jnp.asarray(own), 0, n, 1.5)
+        assert np.asarray(acc_d).tobytes() == acc_h.tobytes()
+        for w in (1.0, 2.5, 0.25):
+            seg = _payload(rng, n)
+            payload = compression.compress(seg, codec)
+            dec = compression.decompress(payload, codec, n)
+            acc_h += dec * w
+            acc_d = device_codec.fused_accumulate(acc_d, [payload],
+                                                  codec, n, w)
+            assert np.asarray(acc_d).tobytes() == acc_h.tobytes()
+
+    @pytest.mark.parametrize("codec", [U8, U4])
+    def test_multi_chunk_payloads(self, codec):
+        """Chunked payloads concatenate into the whole part's codes and
+        scales (block-aligned chunk starts), matching the per-chunk
+        host decode byte-for-byte."""
+        rng = np.random.default_rng(12)
+        n = 4096 + 513
+        seg = _payload(rng, n)
+        chunks = [(0, 1024), (1024, 4096), (4096, n)]
+        payloads = [compression.compress(seg[a:b], codec)
+                    for a, b in chunks]
+        dec = np.concatenate([
+            compression.decompress(p, codec, b - a)
+            for p, (a, b) in zip(payloads, chunks)])
+        acc_h = np.zeros(n, np.float32) + dec * 3.0
+        acc_d = device_codec.fused_accumulate(
+            jnp.zeros(n, jnp.float32), payloads, codec, n, 3.0)
+        assert np.asarray(acc_d).tobytes() == acc_h.tobytes()
+
 
 class TestPallasWireKernel:
     def test_matches_xla_exactly(self):
@@ -166,6 +255,21 @@ class TestPallasWireKernel:
         codes_x, scales_x = device_codec._enc_u8_xla(x)
         np.testing.assert_array_equal(np.asarray(codes_p),
                                       np.asarray(codes_x))
+        np.testing.assert_array_equal(np.asarray(scales_p),
+                                      np.asarray(scales_x))
+
+    def test_u4_kernel_matches_xla_exactly(self):
+        """The u4 VPU kernel (quantize half; packing is a shared XLA
+        byte shuffle) against the XLA path: identical codes and
+        scales, so the TPU wire bytes match the host codec's."""
+        from dalle_tpu.ops.pallas.quant_kernels import \
+            wire_quantize_u4_pallas
+        x = jnp.asarray(_payload(np.random.default_rng(6), 10_007))
+        codes_p, scales_p = wire_quantize_u4_pallas(x, interpret=True)
+        packed_p = device_codec._pack_nibbles(codes_p)
+        packed_x, scales_x = device_codec._enc_u4_xla(x)
+        np.testing.assert_array_equal(np.asarray(packed_p),
+                                      np.asarray(packed_x))
         np.testing.assert_array_equal(np.asarray(scales_p),
                                       np.asarray(scales_x))
 
@@ -255,6 +359,9 @@ class TestAllreduceDeviceBackend:
                        # EncodedPart path (part_payload + part_decode)
         (512, None),   # aligned, SizeAdaptive (f16 at these sizes)
         (300, U8),     # UNALIGNED chunks: the per-chunk device fallback
+        (1024, U4),    # aligned u4: whole-part encode + FUSED device
+                       # accumulate at the owner (screen=None here)
+        (300, U4),     # unaligned u4: per-chunk fallback, fused off
     ])
     def test_matches_host_backend(self, chunk_elems, codec):
         # both backends must produce the same wire bytes, so a 2-peer
@@ -290,6 +397,62 @@ class TestAllreduceDeviceBackend:
             for a, b in zip(r_host, r_dev):
                 np.testing.assert_array_equal(np.asarray(a),
                                               np.asarray(b))
+
+    def test_fused_round_interoperates_with_mixed_codec_sender(self):
+        """An UNPINNED device u8 round (the fused owner path) must
+        still accept a sender whose config picks a different codec —
+        r14 mixed-codec interop: the fused path falls back to host
+        decode for that sender instead of banning it, and the result
+        matches the host backend byte-for-byte."""
+        results = {}
+        for backend in ("host", "device"):
+            nodes = _loopback_swarm(2)
+            try:
+                import threading
+                gs = [None, None]
+
+                def mk(i):
+                    gs[i] = make_group(nodes[i], "mx", 0, weight=1.0 + i,
+                                       matchmaking_time=2.0,
+                                       min_group_size=2, encrypt=True)
+                ts = [threading.Thread(target=mk, args=(i,))
+                      for i in range(2)]
+                [t.start() for t in ts]
+                [t.join() for t in ts]
+                assert all(g is not None and g.size == 2 for g in gs)
+                # peer 0: pinned-arg u8 (fused under the device
+                # backend); peer 1: SizeAdaptive (f16 at these sizes)
+                res, reps = [None, None], [dict(), dict()]
+                errs = []
+
+                def peer(i):
+                    try:
+                        res[i] = run_allreduce(
+                            nodes[i], gs[i], f"mx_{backend}", 0,
+                            self._tensors(30 + i,
+                                          device=(backend == "device"
+                                                  and i == 0)),
+                            weight=1.0 + i, allreduce_timeout=20.0,
+                            codec=U8 if i == 0 else None,
+                            report=reps[i], chunk_elems=512,
+                            codec_backend=backend if i == 0 else "host")
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+                ts = [threading.Thread(target=peer, args=(i,))
+                      for i in range(2)]
+                [t.start() for t in ts]
+                [t.join() for t in ts]
+                assert not errs, errs
+                assert all(r.get("complete") for r in reps), reps
+                assert not reps[0]["corrupt_senders"], reps[0]
+                results[backend] = res
+            finally:
+                for nd in nodes:
+                    nd.shutdown()
+        for a, b in zip(results["host"], results["device"]):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x),
+                                              np.asarray(y))
 
     def test_device_arrays_in_device_out_values(self):
         # device-array handoff end to end; trainers end bit-identical

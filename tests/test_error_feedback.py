@@ -1,0 +1,321 @@
+"""In-collective quantization (r15): error-feedback residual math
+(two-round analytic pin, host/device parity), the wire transparency
+contract (EF off + pinned 8-bit == the r14 protocol byte-for-byte),
+codec pinning (flapping senders banned), the CollabConfig knob
+validation, and the fast convergence A/B (the tier-1 face of
+scripts/ef_convergence_ab.py; the wire-mode artifact run is
+slow-marked)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.swarm import DHT, Identity, compression
+from dalle_tpu.swarm.allreduce import flatten_tensors, run_allreduce
+from dalle_tpu.swarm.error_feedback import ErrorFeedback, make_pair
+from dalle_tpu.swarm.identity import Ed25519PrivateKey
+from dalle_tpu.swarm.matchmaking import make_group
+
+U8 = compression.UNIFORM8BIT
+U4 = compression.UNIFORM4BIT
+
+
+def _roundtrip(x, codec):
+    return compression.decompress(compression.compress(x, codec), codec,
+                                  x.size)
+
+
+class TestResidualMath:
+    @pytest.mark.parametrize("codec", [U8, U4])
+    def test_two_round_carry_analytic(self, codec):
+        """The EF-SGD recurrence, pinned over two rounds: after round
+        1 the residual is exactly g1 - D(Q(g1)); round 2 compensates
+        g2 + r1 before quantizing and stores the new error — so what
+        crosses the wire over both rounds sums to (g1 + g2) minus one
+        bounded residual, never an accumulating bias."""
+        rng = np.random.RandomState(0)
+        g1 = (rng.randn(2048) * 0.1).astype(np.float32)
+        g2 = (rng.randn(2048) * 0.1).astype(np.float32)
+        ef = ErrorFeedback()
+        comp1 = ef.compensate(g1.copy())
+        np.testing.assert_array_equal(comp1, g1)  # fresh residual is 0
+        dec1 = _roundtrip(comp1, codec)
+        ef.store(comp1, [dec1])
+        r1 = ef.residual_host()
+        np.testing.assert_array_equal(r1, g1 - dec1)
+        assert np.abs(r1).max() > 0  # real quantization error
+        comp2 = ef.compensate(g2.copy())
+        np.testing.assert_array_equal(comp2, g2 + r1)
+        dec2 = _roundtrip(comp2, codec)
+        ef.store(comp2, [dec2])
+        np.testing.assert_array_equal(ef.residual_host(),
+                                      (g2 + r1) - dec2)
+        assert ef.rounds == 2
+
+    def test_device_and_host_residuals_byte_equal(self):
+        """The donated device compensate/store produce the same bytes
+        as the host numpy path (the flatten-copy contract of
+        run_allreduce's device branch depends on it)."""
+        rng = np.random.RandomState(1)
+        g = (rng.randn(4096) * 0.3).astype(np.float32)
+        segs = [slice(0, 1024), slice(1024, 4096)]
+        ef_h, ef_d = ErrorFeedback(), ErrorFeedback()
+        comp_h = ef_h.compensate(g.copy())
+        comp_d = ef_d.compensate(jnp.asarray(g))
+        np.testing.assert_array_equal(comp_h, np.asarray(comp_d))
+        dec = [_roundtrip(comp_h[s], U8) for s in segs]
+        ef_h.store(comp_h, [np.concatenate(dec)])
+        ef_d.store(comp_d, [jnp.asarray(d) for d in dec])
+        assert ef_h.residual_host().tobytes() == \
+            ef_d.residual_host().tobytes()
+
+    def test_consumed_but_unstored_residual_is_counted(self):
+        """A round that dies between compensate and store loses its
+        residual (safe-but-lossy restart from zero) — the loss must be
+        COUNTED, never silent (churny swarms would otherwise shed EF
+        every failed round with no trace)."""
+        ef = ErrorFeedback()
+        g = np.ones(64, np.float32)
+        comp = ef.compensate(g.copy())
+        dec = comp - np.float32(0.5)
+        ef.store(comp, [dec])
+        assert ef.lost_rounds == 0 and ef.rounds == 1
+        ef.compensate(g.copy())       # round dies here: no store
+        ef.compensate(g.copy())       # next round notices the loss
+        assert ef.lost_rounds == 1
+        # the gather leg's twin: a compensate_slice whose round dies
+        # before store_slice is counted on the next carry
+        efg = ErrorFeedback()
+        part = np.ones(8, np.float32)
+        comp = efg.compensate_slice(part, 0, 8, total=16)
+        efg.store_slice(comp, comp - np.float32(0.25), 0, 8, total=16)
+        assert efg.lost_rounds == 0
+        efg.compensate_slice(part, 0, 8, total=16)   # round dies
+        efg.compensate_slice(part, 0, 8, total=16)   # counted here
+        assert efg.lost_rounds == 1
+
+    def test_slice_api_partial_ownership(self):
+        """The gather leg: only the owned slice updates; the rest of
+        the residual keeps its pending error across rounds."""
+        ef = ErrorFeedback()
+        part = np.array([1.5, -2.25, 0.5], np.float32)
+        comp = ef.compensate_slice(part, 2, 5, total=8)
+        np.testing.assert_array_equal(comp, part)  # fresh = zeros
+        dec = part - np.float32(0.125)
+        ef.store_slice(comp, dec, 2, 5, total=8)
+        r = ef.residual_host()
+        np.testing.assert_array_equal(r[2:5], np.float32(0.125))
+        np.testing.assert_array_equal(r[[0, 1, 5, 6, 7]], 0.0)
+        # a later round owning a DIFFERENT slice leaves [2:5] pending
+        comp2 = ef.compensate_slice(np.zeros(3, np.float32), 5, 8,
+                                    total=8)
+        np.testing.assert_array_equal(comp2, 0.0)
+        ef.store_slice(comp2, comp2 + 1.0, 5, 8, total=8)
+        r = ef.residual_host()
+        np.testing.assert_array_equal(r[2:5], np.float32(0.125))
+        np.testing.assert_array_equal(r[5:8], -1.0)
+
+
+def _loopback(n, base=31):
+    nodes = []
+    for i in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([base + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=5.0))
+    return nodes
+
+
+def _round(nodes, prefix, tensors_pp, per_peer_kwargs,
+           chunk_elems=1024):
+    gs = [None] * len(nodes)
+    res = [None] * len(nodes)
+    reps = [dict() for _ in nodes]
+    errs = []
+
+    def peer(i):
+        try:
+            gs[i] = make_group(nodes[i], prefix, 0, weight=1.0 + i,
+                               matchmaking_time=2.0,
+                               min_group_size=len(nodes), encrypt=True)
+            assert gs[i] is not None and gs[i].size == len(nodes)
+            res[i] = run_allreduce(
+                nodes[i], gs[i], prefix, 0, tensors_pp[i],
+                weight=1.0 + i, allreduce_timeout=20.0,
+                report=reps[i], chunk_elems=chunk_elems,
+                **per_peer_kwargs[i])
+        except Exception as e:  # noqa: BLE001 - surfaced to the test
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=peer, args=(i,))
+          for i in range(len(nodes))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return res, reps
+
+
+def _tensors(seed):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=3000).astype(np.float32).reshape(50, 60),
+            rng.normal(size=700).astype(np.float32)]
+
+
+class TestWireIntegration:
+    def test_transparency_new_args_inert_when_off(self):
+        """EF off + pinned 8-bit must be the r14 protocol byte-for-
+        byte: a round called with the r15 argument surface
+        (gather_codec explicit, EF None) produces bytes identical to
+        the legacy call shape (codec only)."""
+        outs = {}
+        for tag, kw in (("legacy", dict(codec=U8)),
+                        ("r15", dict(codec=U8, gather_codec=U8,
+                                     ef_scatter=None, ef_gather=None))):
+            nodes = _loopback(2)
+            try:
+                res, reps = _round(nodes, f"tp_{tag}",
+                                   [_tensors(3), _tensors(4)],
+                                   [kw, kw])
+                assert all(r.get("complete") for r in reps)
+                outs[tag] = res
+            finally:
+                for nd in nodes:
+                    nd.shutdown()
+        for a, b in zip(outs["legacy"], outs["r15"]):
+            for x, y in zip(a, b):
+                assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+    def test_ef_round_members_end_byte_identical(self):
+        """EF compensation is sender-local; the gather bytes are still
+        one broadcast — every member ends the round byte-identical,
+        and each peer's residuals come back nonzero (the loop is
+        live)."""
+        nodes = _loopback(3)
+        efs = [make_pair() for _ in range(3)]
+        try:
+            per = [dict(codec=U8, gather_codec=U4,
+                        ef_scatter=efs[i][0], ef_gather=efs[i][1])
+                   for i in range(3)]
+            res, reps = _round(nodes, "efm",
+                               [_tensors(20 + i) for i in range(3)], per)
+            assert all(r.get("complete") for r in reps)
+            flats = [flatten_tensors(r) for r in res]
+            for f in flats[1:]:
+                assert flats[0].tobytes() == f.tobytes()
+            for sc, ga in efs:
+                assert np.abs(sc.residual_host()).max() > 0
+                assert np.abs(ga.residual_host()).max() > 0
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_codec_flapping_sender_banned(self):
+        """The pinned-codec satellite: a validly-signed sender that
+        ships u8 frames into a u4-pinned round is authenticated
+        garbage — banned with its weight renormalized out, exactly
+        like bad geometry (EF residual scales need ONE codec)."""
+        nodes = _loopback(2)
+        try:
+            per = [dict(codec=U4, pin_codec=True),
+                   dict(codec=U8, gather_codec=U4)]  # the flapper
+            res, reps = _round(nodes, "flap",
+                               [_tensors(1), _tensors(2)], per)
+            assert reps[0]["corrupt_senders"] == [nodes[1].peer_id]
+            assert not reps[0]["complete"]
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_ef_requires_pinned_block_codec(self):
+        nodes = _loopback(2)
+        try:
+            gs = [None, None]
+
+            def mk(i):
+                gs[i] = make_group(nodes[i], "v", 0, weight=1.0,
+                                   matchmaking_time=2.0,
+                                   min_group_size=2)
+            ts = [threading.Thread(target=mk, args=(i,))
+                  for i in range(2)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            with pytest.raises(ValueError, match="ef_scatter"):
+                run_allreduce(nodes[0], gs[0], "v", 0, _tensors(0),
+                              weight=1.0, codec=None,
+                              ef_scatter=ErrorFeedback())
+            with pytest.raises(ValueError, match="ef_gather"):
+                run_allreduce(nodes[0], gs[0], "v", 0, _tensors(0),
+                              weight=1.0, codec=U8,
+                              gather_codec=compression.FLOAT16,
+                              ef_gather=ErrorFeedback())
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+
+class TestConfigKnobs:
+    def _mk(self, **over):
+        import dataclasses
+
+        from dalle_tpu.config import CollabConfig
+        from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+
+        class _S:
+            params = {"w": np.zeros(4, np.float32)}
+            opt_state = ()
+
+        cfg = dataclasses.replace(CollabConfig(), **over)
+
+        class _Role:
+            swarm_enabled = False
+        return CollaborativeOptimizer(None, cfg, _S(), lambda s, g: s,
+                                      serve_state=False, role=_Role())
+
+    def test_bits_resolve_and_ef_pair_created(self):
+        opt = self._mk(wire_bits_reduce=4, wire_bits_gather=8,
+                       ef_residuals=True)
+        assert opt._grad_codec == U4
+        assert opt._gather_codec == U8
+        assert opt._ef_scatter is not None and opt._ef_gather is not None
+
+    def test_defaults_stay_legacy(self):
+        opt = self._mk()
+        assert opt._grad_codec is None  # size_adaptive dispatch
+        assert opt._gather_codec is None
+        assert opt._ef_scatter is None and opt._ef_gather is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="wire_bits"):
+            self._mk(wire_bits_reduce=16)
+        with pytest.raises(ValueError, match="ef_residuals"):
+            self._mk(ef_residuals=True, wire_bits_reduce=8)
+        with pytest.raises(ValueError, match="power_sgd"):
+            self._mk(grad_compression="power_sgd", wire_bits_reduce=8)
+
+
+class TestConvergenceAB:
+    def test_fast_sim_ab(self):
+        """Tier-1 face of scripts/ef_convergence_ab.py: the in-process
+        butterfly simulation over a short horizon. u4+EF must track
+        fp32 within tolerance AND beat u4-without-EF (the stress
+        problem is built so naive u4 visibly stalls)."""
+        from scripts.ef_convergence_ab import run_ab
+        report = run_ab(epochs=12, dim=2048, rows_per_peer=48,
+                        tolerance=0.10,
+                        configs=["fp32", "u4", "u4+ef"])
+        assert report["pass"], report["violations"]
+        t = report["trajectories"]
+        assert t["u4+ef"]["final_loss"] < t["u4"]["final_loss"]
+
+    @pytest.mark.slow
+    def test_wire_ab_matches_artifact(self):
+        """The artifact run (EF_CONVERGENCE_AB.json): the same A/B
+        through real loopback DHT rounds, all five configs."""
+        from scripts.ef_convergence_ab import run_ab
+        report = run_ab(wire=True, epochs=24, tag="t")
+        assert report["pass"], report["violations"]

@@ -189,6 +189,76 @@ class TestGradientScreen:
             v = screen.screen(c)
             assert not v.dropped, (seed, v.dropped, v.stats)
 
+    @pytest.mark.parametrize("codec", [compression.UNIFORM8BIT,
+                                       compression.UNIFORM4BIT])
+    def test_honest_heterogeneity_survives_quantized_wire(self, codec):
+        """The r15 re-calibration pin: what the screen actually sees
+        on a quantized run is the codec round-trip of (possibly
+        EF-compensated) segments — quantization noise + a bounded
+        residual must not push honest non-IID volunteers over the
+        pinned thresholds, at u8 OR u4. EF residuals are bounded by
+        one quantization step, so compensation is modeled as one prior
+        round's error added in."""
+        screen = GradientScreen()
+        for seed in range(10):
+            rng = np.random.RandomState(seed)
+            signal = rng.randn(256).astype(np.float32)
+            c = {}
+            for i in range(8):
+                scale = rng.uniform(0.5, 1.6)
+                noise = rng.randn(256).astype(np.float32)
+                seg = (signal * scale + 0.8 * noise).astype(np.float32)
+                # one EF step: residual of a previous round's quantize
+                prev = (signal * scale * 0.9
+                        + 0.8 * rng.randn(256)).astype(np.float32)
+                resid = prev - compression.decompress(
+                    compression.compress(prev, codec), codec, prev.size)
+                comp = seg + resid
+                wire = compression.decompress(
+                    compression.compress(comp, codec), codec, comp.size)
+                c[i] = (float(rng.choice([0.5, 1.0, 2.0, 4.0])), wire)
+            v = screen.screen(c)
+            assert not v.dropped, (codec, seed, v.dropped, v.stats)
+
+    def test_fixed_order_statistics_are_build_independent(self):
+        """The CHAOS.md determinism-gap fix: the screen's norm/dot
+        reductions spell out their summation order in code (row-wise
+        elementwise adds + an exactly-rounded fsum combine), so the
+        result is a pure function of the input BYTES — never of the
+        numpy build's SIMD width or BLAS. Pinned three ways: inputs
+        inside one lane block are EXACTLY rounded (equal math.fsum
+        over any permutation — the ulp-boundary case); a multi-block
+        cancellation-heavy input pins a golden bit pattern (any
+        order change flips it); and the statistics must not regress
+        to backend reductions (np.sum disagrees on this input)."""
+        import math
+        from dalle_tpu.swarm.screening import (_fixed_order_sum,
+                                               _fsum_dot, _fsum_norm)
+        rng = np.random.RandomState(0)
+        # (1) <= one lane: exactly rounded, permutation-invariant
+        small = np.concatenate([
+            rng.randn(1000) * 1e6, rng.randn(1000) * 1e-3,
+            -rng.randn(1000) * 1e6]).astype(np.float64)
+        assert _fixed_order_sum(small) == math.fsum(small.tolist())
+        for _ in range(3):
+            p = rng.permutation(small.size)
+            assert _fixed_order_sum(small[p]) == \
+                math.fsum(small[p].tolist())
+        # (2) multi-block: the spelled-out order IS the spec — a
+        # checked-in golden bit pattern catches any reordering (a
+        # backend-reduction regression, a lane-width change, a
+        # combine-order edit) on the spot
+        big = np.concatenate([
+            rng.randn(5000) * 1e6, rng.randn(5000) * 1e-3,
+            -rng.randn(5000) * 1e6]).astype(np.float64)
+        assert np.float64(_fixed_order_sum(big)).tobytes().hex() == \
+            "191bdb2769b4a5c1"
+        # (3) the deterministic norms/dots flow through the same path
+        other = rng.randn(big.size)
+        assert _fsum_norm(big) == math.sqrt(
+            _fixed_order_sum(np.square(big)))
+        assert _fsum_dot(big, other) == _fixed_order_sum(big * other)
+
 
 # -- signed strike receipts ------------------------------------------------
 

@@ -587,6 +587,63 @@ class Counter:
             self.n = 0
 """,
     ),
+    # r15 in-collective quantization shapes: the error-feedback residual
+    # rides DONATED jitted programs (swarm/error_feedback.py), and the
+    # fused owner accumulate drains per-sender device dispatches through
+    # the decode pool (swarm/allreduce.py) — pin the hazardous variant
+    # of each so the real paths can never regress into them unnoticed.
+    (
+        "use-after-donate",
+        "dalle_tpu/swarm/fake_ef.py",
+        """
+import functools
+import jax
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ef_add(resid, flat):
+    return flat + resid
+def compensate(resid, flat):
+    _ef_add(resid, flat)            # residual donated, never rebound...
+    return resid + flat             # ...then read through the corpse
+""",
+        """
+import functools
+import jax
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ef_add(resid, flat):
+    return flat + resid
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ef_store(comp, segs):
+    return comp - jax.numpy.concatenate(segs)
+def round_residual(resid, flat, segs):
+    comp = _ef_add(resid, flat)     # old residual consumed: rebind
+    resid = _ef_store(comp, segs)   # comp consumed: never read again
+    return resid
+""",
+    ),
+    (
+        "unchecked-pool-future",
+        "dalle_tpu/swarm/fake_fused.py",
+        """
+import concurrent.futures
+def drain_reduce(decode, raws, acc, fused_accumulate):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as dec_pool:
+        futs = [dec_pool.submit(decode, r) for r in raws]
+        concurrent.futures.wait(futs)   # a failed decode (bad codec,
+    return acc                          # device error) vanishes unread
+""",
+        """
+import concurrent.futures
+def drain_reduce(decode, raws, acc, fused_accumulate):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as dec_pool:
+        futs = [dec_pool.submit(decode, r) for r in raws]
+        concurrent.futures.wait(futs)
+        for f in futs:
+            payloads = f.result()     # every decode surfaced, then the
+            if payloads is not None:  # donated device accumulate rebinds
+                acc = fused_accumulate(acc, payloads)
+    return acc
+""",
+    ),
 ]
 
 
