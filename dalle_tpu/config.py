@@ -397,6 +397,32 @@ class CollabConfig:
     audit_gather: bool = True
     audit_frac: float = 0.25
     audit_ttl: float = 120.0
+    # Round repair (swarm/repair.py; CHAOS.md "Round repair"): an
+    # owner-audit-fail conviction whose replay SUCCEEDED (the
+    # replayed-bytes-mismatch class — the wrong_gather_part attack
+    # shape) has recomputed the honest part bytes bit-exactly, so the
+    # optimizer applies the compensating correction honest - served:
+    # assigned over the averaged gradients when the conviction beats
+    # the apply (bit-exact), added into the next applied gradient
+    # vector after the LAMB step fired (bounded-staleness
+    # compensation — one step of preconditioner staleness). False
+    # keeps the r15 detection-only behavior byte-for-byte.
+    repair_convicted: bool = True
+    # BYTE bound on the audit worker's retained-round ring (the
+    # pending RoundAudits hold signed frames + gathered part copies
+    # that late repair/proofs need): oldest-first eviction with a
+    # counted eviction, so flagship-size parts cannot balloon host
+    # RAM under a slow audit. The round-count bound (8) still applies.
+    audit_ring_bytes: int = 256 << 20
+    # Audit the two auxiliary averaging phases too — PowerSGD factor
+    # rounds ({run}_grads_p/_q) and periodic state averaging
+    # ({run}_state) ride the same butterfly and, with this on, the
+    # same challenge/transcript/replay machinery (each phase under its
+    # own prefix). Convictions there strike + gossip proof-carrying
+    # receipts; repair stays scoped to the gradient rounds (factor/
+    # state corrections live in spaces the gradient plane cannot
+    # absorb — CHAOS.md "Round repair").
+    audit_aux_phases: bool = True
     # Plausible-lead bound on progress-record EPOCH claims (the epoch
     # twin of the sample cap): a peer's claimed epoch may lead this
     # node's local epoch by at most this margin in the aggregate —

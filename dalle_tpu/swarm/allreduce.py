@@ -345,7 +345,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     tamper = getattr(dht, "tamper_contribution", None)
     frame_weight = weight
     if tamper is not None:
-        tensors, frame_weight = tamper(epoch, tensors, weight)
+        tensors, frame_weight = tamper(epoch, tensors, weight,
+                                       prefix=prefix)
     phases: Dict[str, float] = {}
     corrupt_senders: List[str] = []
     timeout_senders: List[str] = []
@@ -649,7 +650,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             omit_target = None
             if omit_pick is not None and expected:
                 omit_target = omit_pick(epoch, sorted(
-                    group.members[i].peer_id for i in expected))
+                    group.members[i].peer_id for i in expected),
+                    prefix=prefix)
             # a sender's contribution applies ATOMICALLY once all its
             # chunks arrived (partial senders are dropped wholesale, the
             # same elasticity semantics as the unchunked protocol)
@@ -1128,7 +1130,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     tamper_part = getattr(dht, "tamper_gather_part", None)
     if (tamper_part is not None and my_part is not None
             and averaged_mine is not None):
-        averaged_mine = tamper_part(epoch, my_part, averaged_mine)
+        averaged_mine = tamper_part(epoch, my_part, averaged_mine,
+                                    prefix=prefix)
 
     # --- gather: averaged part i -> everyone; collect the rest ----------
     # an assistant's return value is meaningless (it collects nothing and
@@ -1267,13 +1270,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # the codec this chunk ACTUALLY arrived in (the wire
                 # header, post-signature-verify): the audit replays the
                 # gather re-encode with the codecs this member applied,
-                # so mixed-codec (unpinned) owners replay faithfully
-                return part, parsed, _HDR.unpack_from(raw)[6]
+                # so mixed-codec (unpinned) owners replay faithfully.
+                # The raw signed frame rides along for audited parts —
+                # it is the owner-signed half of a proof receipt and
+                # the served bytes the repair plane corrects.
+                return part, parsed, _HDR.unpack_from(raw)[6], raw
 
             def apply_gather(res) -> bool:
                 if res is None:
                     return False
-                part, (status, sender, _w, ci, data), gcodec = res
+                part, (status, sender, _w, ci, data), gcodec, raw = res
                 if part not in pending:
                     return False  # completed part
                 if status == "bad":
@@ -1303,6 +1309,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 pending[part].discard(ci)
                 if audit is not None and part in audited_parts:
                     audit.note_gather_codec(part, ci, gcodec)
+                    audit.note_gather_frame(part, ci, raw)
                 if not pending[part]:
                     del pending[part]
                     if audit is not None and part in audited_parts:
@@ -1415,6 +1422,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         if audit is not None and k in audited_parts:
                             audit.note_gather_codec(
                                 k, pci, _HDR.unpack_from(raw)[6])
+                            audit.note_gather_frame(k, pci, raw)
                         last_progress = time.monotonic()
                     if not pending.get(k):
                         if (k in pending and audit is not None

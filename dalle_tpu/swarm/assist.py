@@ -66,7 +66,8 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
                      template: np.ndarray, authorizer=None,
                      codec: Optional[int] = None,
                      gather_codec: Optional[int] = None,
-                     pin_codec: bool = False) -> str:
+                     pin_codec: bool = False,
+                     audit_policy=None) -> str:
     """Join epoch ``epoch``'s gradient matchmaking as a weight-0 member
     and, if a real group forms, serve as a part owner for its all-reduce.
 
@@ -82,7 +83,18 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
     owner compresses the part it gathers, so an assistant with a
     different codec would gather its part at different fidelity than
     trainer-owned parts, and on a PINNED run the trainers would ban a
-    wrong-codec assistant's part outright as codec flapping."""
+    wrong-codec assistant's part outright as codec flapping.
+
+    ``audit_policy`` (optional :class:`~dalle_tpu.swarm.audit
+    .AuditPolicy`) arms the OWNER side of the verified-aggregation
+    layer: an assistant owns a part like any routable member, so when
+    the deterministic challenge names its part it must retain the
+    frames it averaged and serve the signed transcript — an r14 gap:
+    trainers audited assistant-owned parts but honest assistants never
+    posted, earning steady ``audit-timeout`` strikes. The assistant
+    audits nobody in return (weight 0: it gathers no parts and
+    scatters nothing, so it has neither replay targets nor omission
+    standing) — the RoundAudit here is pure owner-side duty."""
     group = make_group(
         dht, f"{cfg.run_id}_grads", epoch, weight=0.0,
         matchmaking_time=cfg.matchmaking_time, min_group_size=2,
@@ -92,6 +104,10 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
     if not any(m.weight > 0 for m in group.members):
         return "idle"  # a lobby of assistants has nothing to average
     report: dict = {}
+    ra = None
+    if audit_policy is not None:
+        from dalle_tpu.swarm.audit import RoundAudit
+        ra = RoundAudit(f"{cfg.run_id}_grads", epoch, audit_policy)
     # assistants honor the configured codec backend too: an aux host
     # with an accelerator runs its (large) share of codec work there
     from dalle_tpu.swarm.device_codec import resolve_backend
@@ -100,7 +116,7 @@ def assist_one_round(dht: DHT, cfg: CollabConfig, epoch: int,
                   codec=codec, gather_codec=gather_codec,
                   pin_codec=pin_codec,
                   adaptive_threshold=cfg.size_adaptive_threshold,
-                  report=report,
+                  report=report, audit=ra,
                   codec_backend=resolve_backend(
                       getattr(cfg, "wire_codec_backend", "auto")))
     return "assisted" if report.get("reduced_senders", 0) > 0 else "empty"
@@ -165,6 +181,14 @@ class AveragingAssistant(threading.Thread):
                  else _CODECS[self.cfg.grad_compression])
         gather_codec = codec_for_bits(wb_g)
         pin = wb_r is not None or wb_g is not None
+        # owner-side audit duty (see assist_one_round): the assistant
+        # must answer challenges on the part it owns, or every trainer
+        # down-ranks it with audit-timeout strikes
+        audit_policy = None
+        if getattr(self.cfg, "audit_gather", False):
+            from dalle_tpu.swarm.audit import AuditPolicy
+            audit_policy = AuditPolicy(frac=self.cfg.audit_frac,
+                                       ttl=self.cfg.audit_ttl)
         template = np.zeros(self._n_elements, np.float32)
         tracker = ProgressTracker(self.dht, self.cfg.run_id,
                                   self.cfg.target_batch_size)
@@ -199,7 +223,8 @@ class AveragingAssistant(threading.Thread):
                                            progress.epoch, template,
                                            self.authorizer, codec=codec,
                                            gather_codec=gather_codec,
-                                           pin_codec=pin)
+                                           pin_codec=pin,
+                                           audit_policy=audit_policy)
                 if outcome == "assisted":
                     self.rounds_assisted += 1
                     last_handled = progress.epoch

@@ -115,6 +115,23 @@ AUDIT_TIMEOUT_REASON = "audit-timeout"
 #: wire framing of one posted transcript chunk: (chunk_idx, n_chunks)
 _TCHDR = struct.Struct(">II")
 
+#: the averaging phases of the protocol, by round-prefix convention:
+#: the main gradient rounds ("{run}_grads"), the PowerSGD factor
+#: rounds ("{run}_grads_p"/"_q") and the periodic state averaging
+#: ("{run}_state"). Protocol knowledge — the audit (and the chaos
+#: layer's phase-scoped attack ops) key on it.
+AVERAGING_PHASES = ("grads", "powersgd", "state")
+
+
+def phase_of_prefix(prefix: str) -> str:
+    """Map a round prefix to its averaging phase (see
+    :data:`AVERAGING_PHASES`)."""
+    if prefix.endswith("_state"):
+        return "state"
+    if prefix.endswith("_p") or prefix.endswith("_q"):
+        return "powersgd"
+    return "grads"
+
 
 def _audit_ctx(prefix: str, epoch: int, part: int) -> bytes:
     """Signature context of a transcript: bound to run, epoch and part
@@ -221,6 +238,14 @@ class RoundAudit:
         self.posted = False
         # collector-side retention
         self.gathered: Dict[int, np.ndarray] = {}
+        #: part -> {chunk_idx: raw signed gather frame} — the OWNER-
+        #: signed bytes this member applied. Two consumers: the repair
+        #: plane (the served part that must be corrected is exactly
+        #: these bytes' decode) and the proof-carrying receipt (the
+        #: frames are the third-party-verifiable half of the evidence:
+        #: the owner signed BOTH a transcript and a part the transcript
+        #: cannot reproduce)
+        self.gather_frames: Dict[int, Dict[int, bytes]] = {}
         #: part -> {chunk_idx: codec} the gathered chunks ACTUALLY
         #: arrived in (wire-header ground truth): the replay re-encodes
         #: with these, so an unpinned mixed-codec owner — who is free
@@ -311,8 +336,32 @@ class RoundAudit:
     def note_gather_codec(self, part: int, ci: int, codec: int) -> None:
         self.gather_codecs.setdefault(part, {})[ci] = codec
 
+    def note_gather_frame(self, part: int, ci: int, raw: bytes) -> None:
+        self.gather_frames.setdefault(part, {})[ci] = raw
+
     def note_scatter_ok(self, part: int) -> None:
         self.scatter_ok.add(part)
+
+    # -- retention accounting (the byte-bounded repair ring) -----------
+
+    def part_lo(self, part: int) -> int:
+        """The part's offset in the round's flat gradient layout."""
+        return int(sum(self.part_sizes[:part]))
+
+    def retained_bytes(self) -> int:
+        """Approximate host RAM this round's retention holds — the
+        quantity the AuditWorker's byte-bounded pending ring evicts
+        by. Counts every retained frame/evidence blob and the gathered
+        part copies; bookkeeping (orders, codecs, sets) is noise."""
+        n = 0
+        for chunks in self.frames.values():
+            n += sum(len(b) for b in chunks.values())
+        n += sum(len(b) for b in self.evidence.values())
+        n += sum(len(b) for b in self.self_frames)
+        n += sum(int(a.nbytes) for a in self.gathered.values())
+        for chunks in self.gather_frames.values():
+            n += sum(len(b) for b in chunks.values())
+        return n
 
     # -- transcript (owner side) ---------------------------------------
 
@@ -705,15 +754,308 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
     return ReplayResult(True, values=out, screen_drops=replay_drops)
 
 
+# -- proof-carrying receipts (third-party verifiable convictions) ----------
+#
+# An ``owner-audit-fail`` verdict of the ``replayed-bytes-mismatch``
+# class rests ENTIRELY on owner-signed bytes: the transcript (signed
+# under the (run, epoch, part)-bound context) and the gather frames the
+# issuer applied (signed under the round's gather context). Shipping
+# both as receipt evidence lets ANY peer — in the round or not — rerun
+# the replay and confirm the contradiction, upgrading the receipt from
+# a bounded accusation (the r13 ≤2.0 influence cap) to a PROOF that
+# convicts on its own. The roster the evidence claims is authenticated
+# by the group hash bound into every signed frame header; structural
+# claims a hostile issuer could lie about (part size, weights, flags)
+# are fail-safe by construction: the verifier convicts ONLY when its
+# own replay succeeds AND the replayed bytes contradict the evidence
+# frames — both pure functions of owner-signed data plus the
+# verifier's OWN config — so a lie anywhere else can only make an
+# honest owner's evidence fail verification (falling back to the
+# capped r13 influence), never convict one. Config-dependent replay
+# failures (screen/clamp/codec drift) are likewise treated as
+# UNVERIFIED, under the same run-config-homogeneity contract the r14
+# audit already documents.
+
+
+def build_proof_evidence(ra: RoundAudit, part: int,
+                         transcript_blob: bytes) -> Optional[bytes]:
+    """The evidence bundle for one ``replayed-bytes-mismatch``
+    conviction: the owner-signed transcript + the owner-signed gather
+    frames this member applied, plus the (group-hash-authenticated)
+    roster a verifier needs to rebuild the round context. None when the
+    retention is incomplete (a partial frame set cannot prove a
+    mismatch to a third party)."""
+    import msgpack
+
+    from dalle_tpu.swarm.health import PROOF_MAX_BYTES
+    frames = ra.gather_frames.get(part)
+    if not frames or ra.group is None:
+        return None
+    n_chunks = len(_chunk_slices_for(ra.part_sizes[part],
+                                     ra.chunk_elems))
+    if set(frames) != set(range(n_chunks)):
+        return None
+    body = sum(len(b) for b in frames.values()) + len(transcript_blob)
+    if body > PROOF_MAX_BYTES:
+        # flagship-size parts cannot ship inline evidence: skip
+        # BUILDING the multi-hundred-MB blob the gossip would only
+        # drop against the cap — the conviction degrades to the r13
+        # capped receipt (evidence-by-reference is the named future
+        # work, ROADMAP r16 residuals)
+        logger.warning(
+            "proof evidence for part %d is %d bytes (> %d): receipt "
+            "will carry no proof", part, body, PROOF_MAX_BYTES)
+        return None
+    return msgpack.packb({
+        "v": 1,
+        "prefix": ra.prefix,
+        "epoch": int(ra.epoch),
+        "part": int(part),
+        "part_elems": int(ra.part_sizes[part]),
+        "members": [[m.peer_id, 1 if m.addr else 0, float(m.weight)]
+                    for m in ra.group.members],
+        "group_hash": bytes(ra.group.group_hash),
+        "transcript": bytes(transcript_blob),
+        "frames": [bytes(frames[ci]) for ci in range(n_chunks)],
+    }, use_bin_type=True)
+
+
+def _chunk_slices_for(n: int, chunk_elems: int):
+    from dalle_tpu.swarm.allreduce import _chunk_slices
+    return _chunk_slices(n, chunk_elems)
+
+
+class _ProofMember:
+    __slots__ = ("peer_id", "addr", "weight")
+
+    def __init__(self, peer_id: str, addr: str, weight: float):
+        self.peer_id = peer_id
+        self.addr = addr
+        self.weight = weight
+
+
+class _ProofGroup:
+    """The minimal AveragingGroup stand-in the replay machinery reads
+    (members / size / group_hash) — rebuilt from proof evidence."""
+
+    def __init__(self, members, group_hash: bytes):
+        self.members = members
+        self.group_hash = group_hash
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+
+class ProofVerifier:
+    """Independent re-verification of proof-carrying receipts.
+
+    One per peer, configured with the verifier's OWN round context
+    (codec/pin/screen/clamp — the run-config-homogeneity contract; an
+    issuer-supplied context would let a hostile issuer frame honest
+    owners). ``__call__`` is the :class:`~dalle_tpu.swarm.health
+    .StrikeGossip` hook: True iff the evidence independently proves
+    the accused owner served a part its own signed transcript cannot
+    reproduce. Every False is a REJECTION — the receipt folds with at
+    most the r13 capped influence (or, for the gossip's all-or-nothing
+    proof rule, not at all); it never convicts.
+    """
+
+    #: how far the receipt's (ledger-clock) epoch may sit from the
+    #: evidence's round epoch: audits run asynchronously and the
+    #: issuer stamps the receipt with its LEDGER clock at conviction
+    #: time, so the two legitimately skew by up to the AuditWorker's
+    #: whole pending ring (MAX_PENDING rounds) plus a little gossip
+    #: lag — but an OLD proof re-wrapped under a fresh receipt epoch
+    #: (the replay attack that would re-convict a long-reformed peer
+    #: forever) lands far outside this slack and is rejected
+    EPOCH_SLACK = 10  # AuditWorker.MAX_PENDING (8) + gossip lag
+
+    def __init__(self, run_prefix: str, *, frac: float,
+                 chunk_elems: int, codec: Optional[int] = None,
+                 adaptive_threshold: int = 0, screen=None,
+                 max_peer_weight: Optional[float] = None,
+                 gather_codec: Optional[int] = None,
+                 pinned: Optional[int] = None,
+                 phase_overrides: Optional[Dict[str, dict]] = None):
+        self.run_prefix = run_prefix
+        self.frac = frac
+        self.chunk_elems = chunk_elems
+        self.codec = codec
+        self.adaptive_threshold = adaptive_threshold
+        self.screen = screen
+        self.max_peer_weight = max_peer_weight
+        self.gather_codec = gather_codec
+        self.pinned = pinned
+        #: phase -> {codec/gather_codec/pinned/screen/...} replay-knob
+        #: overrides: the auxiliary phases (PowerSGD factors, state
+        #: averaging) run the same butterfly under DIFFERENT codec
+        #: config, and a proof must be judged under the config its
+        #: phase runs with (an always-reject here would only fail safe,
+        #: but would blind this peer to aux-phase proofs)
+        self.phase_overrides = dict(phase_overrides or {})
+        self.verified = 0       # observability counters
+        self.rejected = 0
+
+    def _knob(self, phase: str, name: str):
+        over = self.phase_overrides.get(phase)
+        if over is not None and name in over:
+            return over[name]
+        return getattr(self, name)
+
+    def _reject(self, why: str) -> Optional[str]:
+        self.rejected += 1
+        logger.warning("proof receipt rejected: %s", why)
+        return None
+
+    def __call__(self, proof: bytes, accused: str,
+                 epoch: int) -> Optional[str]:
+        """The verified evidence's round PREFIX on success (truthy —
+        the gossip folds it into the proven-strike dedup ref so
+        per-phase convictions stay distinguishable), None on any
+        rejection."""
+        import msgpack
+
+        from dalle_tpu.swarm.allreduce import _parse, _sign_ctx
+        try:
+            obj = msgpack.unpackb(bytes(proof), raw=False)
+            prefix = str(obj["prefix"])
+            p_epoch = int(obj["epoch"])
+            part = int(obj["part"])
+            part_elems = int(obj["part_elems"])
+            members = [_ProofMember(str(pid), "o" if int(flag) else "",
+                                    float(w))
+                       for pid, flag, w in obj["members"]]
+            group_hash = bytes(obj["group_hash"])
+            blob = bytes(obj["transcript"])
+            frames = [bytes(f) for f in obj["frames"]]
+        # the proof plane is attacker-writable; malformed evidence is
+        # exactly "unverifiable"
+        # graftlint: disable=silent-except
+        except Exception:  # noqa: BLE001 - any parse failure = reject
+            return self._reject("malformed evidence")
+        # the proof must name THIS run: the receipt context already
+        # binds the run prefix, and the audit prefix must be the run
+        # itself or one of its phase prefixes (grads / powersgd factor
+        # / state averaging)
+        if not (prefix == self.run_prefix
+                or prefix.startswith(self.run_prefix + "_")):
+            return self._reject(f"foreign round prefix {prefix!r}")
+        if abs(p_epoch - epoch) > self.EPOCH_SLACK:
+            # stale/replayed evidence: a receipt re-dated to a live
+            # epoch must not resurrect an old round's proof (the
+            # slack covers the async audit's legitimate clock skew)
+            return self._reject("evidence epoch far from receipt epoch")
+        if part_elems <= 0 or not members:
+            return self._reject("degenerate round context")
+        # plausibility bounds BEFORE any sized allocation: the proof
+        # plane is attacker-writable, and the claimed part size must
+        # be payable by the evidence itself (even the densest codec
+        # spends >= half a byte per element on its gather frames; the
+        # receipt is capped at PROOF_MAX_BYTES) — without this, a tiny
+        # receipt claiming part_elems ~ 1e13 would have the gossip
+        # worker attempt a multi-TB np.empty per poll
+        from dalle_tpu.swarm.health import PROOF_MAX_BYTES
+        if part_elems > 2 * PROOF_MAX_BYTES or len(members) > 4096:
+            return self._reject("implausible round context")
+        # roster authentication: the group hash bound into every signed
+        # frame header commits to the member ids — the ONE formula
+        # matchmaking defines (it reads only peer_id, so the proof
+        # members satisfy it)
+        from dalle_tpu.swarm.matchmaking import group_hash_of
+        if group_hash_of(members) != group_hash:
+            return self._reject("roster does not hash to the group")
+        owners = [m for m in members if m.addr]
+        if not 0 <= part < len(owners):
+            return self._reject("no such part")
+        if owners[part].peer_id != accused:
+            return self._reject("accused is not the part owner")
+        if part not in challenged_parts(prefix, p_epoch, len(owners),
+                                        self.frac):
+            # an unchallenged owner owed nobody a transcript: a
+            # "proof" about one is a fabrication attempt by
+            # construction
+            return self._reject("part was never challenged")
+        tr = open_transcript(blob, prefix, p_epoch, part,
+                             owners[part].peer_id)
+        if tr is None:
+            return self._reject("transcript does not verify")
+        group = _ProofGroup(members, group_hash)
+        owner_index = next(i for i, m in enumerate(members)
+                           if m.peer_id == accused)
+        chunks = _chunk_slices_for(part_elems, self.chunk_elems)
+        if len(frames) != len(chunks):
+            return self._reject("gather frame count != part chunking")
+        gather_ctx = _sign_ctx(prefix, p_epoch, "gather")
+        served = np.empty(part_elems, np.float32)
+        observed: Dict[int, int] = {}
+        seen: Set[int] = set()
+        for raw in frames:
+            # evidence frames are judged accept-any (pinned=None): they
+            # are what the issuer APPLIED, and the replay re-encodes
+            # with their observed codecs — an unpinned mixed-codec
+            # owner's proof must verify too
+            parsed = _parse(raw, group, chunks, gather_ctx)
+            if parsed is None or parsed[0] != "ok":
+                return self._reject("gather frame does not verify")
+            _status, sender, _w, ci, data = parsed
+            if sender != owner_index:
+                # a frame the accused never signed (or another part's
+                # owner): transcript-frame mismatch
+                return self._reject("gather frame not owner-signed")
+            if ci in seen:
+                return self._reject("duplicate gather chunk")
+            clo, chi = chunks[ci]
+            served[clo:chi] = data
+            seen.add(ci)
+            from dalle_tpu.swarm.allreduce import _HDR
+            observed[ci] = _HDR.unpack_from(raw)[6]
+        if len(seen) != len(chunks):
+            return self._reject("incomplete gather frame set")
+        phase = phase_of_prefix(prefix)
+        res = replay_transcript(
+            tr, group=group, prefix=prefix, epoch=p_epoch, part=part,
+            part_elems=part_elems, chunk_elems=self.chunk_elems,
+            codec=self._knob(phase, "codec"),
+            adaptive_threshold=self.adaptive_threshold,
+            screen=self._knob(phase, "screen"),
+            max_peer_weight=self.max_peer_weight,
+            gather_codec=self._knob(phase, "gather_codec"),
+            pinned=self._knob(phase, "pinned"),
+            observed_codecs=observed)
+        if not res.ok:
+            # an inconsistent transcript under MY config is
+            # inconclusive from outside the round (config drift and
+            # issuer lies about roster weights both land here):
+            # conviction needs the unambiguous signed contradiction
+            return self._reject(f"replay not conclusive ({res.why})")
+        if res.values.tobytes() == served.tobytes():
+            return self._reject("served bytes match the replay "
+                                "(no contradiction)")
+        self.verified += 1
+        return prefix
+
+
 # -- the audit pass (auditor side) -----------------------------------------
 
-def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
+def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1,
+                repair=None) -> dict:
     """Audit every challenged part this peer fully gathered: fetch the
     owner's transcript, replay it, bit-compare, and strike. Also runs
     the sender-side omission check for parts this peer's own
     contribution was transport-acked into. Returns an observability
     report; strikes land in ``ledger`` (gossipable reasons queue
     receipts there automatically).
+
+    ``repair`` (optional :class:`~dalle_tpu.swarm.repair.RepairPlane`)
+    arms the correction path: a ``replayed-bytes-mismatch`` conviction
+    — the one class whose replay SUCCEEDED, so the honest part bytes
+    were recomputed bit-exactly — queues ``honest - served`` for the
+    optimizer to apply (pre-step assign when it beats the apply,
+    bounded-staleness compensation after; swarm/repair.py). The same
+    class attaches the owner-signed transcript + gather frames to its
+    ledger strike as PROOF evidence, so the gossiped receipt convicts
+    at any verifying peer without local corroboration.
 
     The replay judges owners by the ROUND'S recorded context
     (``ra.screen``/``ra.max_peer_weight``/codec — captured by
@@ -733,7 +1075,9 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
     todo = [p for p in sorted(ra.audited)
             if p != ra.my_part and p in ra.gathered]
 
-    def audit_one(p: int) -> Tuple[int, str, str, Dict[int, str]]:
+    def audit_one(p: int) -> Tuple[int, str, str, Dict[int, str],
+                                   Optional[np.ndarray],
+                                   Optional[bytes]]:
         owner = ra.owners[p]
         blob = fetch_transcript(dht, owner.addr, ra.prefix, ra.epoch, p,
                                 ra.policy, group_key=ra.group.group_key)
@@ -741,7 +1085,7 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
                               owner.peer_id)
               if blob is not None else None)
         if tr is None:
-            return p, "unserved", "", {}
+            return p, "unserved", "", {}, None, None
         res = replay_transcript(
             tr, group=ra.group, prefix=ra.prefix, epoch=ra.epoch,
             part=p, part_elems=ra.part_sizes[p],
@@ -751,14 +1095,19 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
             gather_codec=ra.gather_codec, pinned=ra.pinned,
             observed_codecs=ra.gather_codecs.get(p))
         if not res.ok:
-            return p, "failed", res.why, res.screen_drops
+            return p, "failed", res.why, res.screen_drops, None, None
         if res.values.tobytes() != ra.gathered[p].tobytes():
-            return p, "failed", "replayed-bytes-mismatch", res.screen_drops
+            # the one conviction class that carries its own honest
+            # reconstruction (the replay succeeded) AND is third-party
+            # provable (every input is owner-signed): values feed the
+            # repair plane, the transcript blob feeds the proof receipt
+            return (p, "failed", "replayed-bytes-mismatch",
+                    res.screen_drops, res.values, blob)
         # sender-side omission check: my delivery must be accounted for
         if (p in ra.scatter_ok and my_index not in tr["frames"]
                 and my_index not in tr["drops"]):
-            return p, "omitted", "", res.screen_drops
-        return p, "ok", "", res.screen_drops
+            return p, "omitted", "", res.screen_drops, None, None
+        return p, "ok", "", res.screen_drops, None, None
 
     if jobs > 1 and len(todo) > 1:
         with concurrent.futures.ThreadPoolExecutor(
@@ -770,7 +1119,7 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
     else:
         outcomes = [audit_one(p) for p in todo]
 
-    for p, status, why, screen_drops in outcomes:
+    for p, status, why, screen_drops, honest, blob in outcomes:
         owner_pid = ra.owners[p].peer_id
         entry = {"part": p, "owner": owner_pid, "why": why,
                  "screen_drops": {int(k): v
@@ -786,8 +1135,26 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1) -> dict:
                 "transcript (epoch %d) — audit-timeout strike",
                 p, owner_pid[:16], ra.epoch)
         elif status == "failed":
+            evidence = None
+            if honest is not None and blob is not None:
+                evidence = build_proof_evidence(ra, p, blob)
+                if repair is not None and repair.accept_prefix in (
+                        None, ra.prefix):
+                    # the copies are built only for a plane that will
+                    # take them, and "repaired" reports what the plane
+                    # actually ACCEPTED (an overflow drop is not a
+                    # repair)
+                    from dalle_tpu.swarm.repair import RepairAction
+                    entry["repaired"] = repair.submit(RepairAction(
+                        prefix=ra.prefix, epoch=ra.epoch, part=p,
+                        owner=owner_pid, lo=ra.part_lo(p),
+                        served=np.array(ra.gathered[p], np.float32,
+                                        copy=True),
+                        honest=np.array(honest, np.float32,
+                                        copy=True)))
             if ledger is not None:
-                ledger.strike(owner_pid, AUDIT_FAIL_REASON)
+                ledger.strike(owner_pid, AUDIT_FAIL_REASON,
+                              evidence=evidence)
             report["failed"].append(entry)
             logger.warning(
                 "audit: part %d owner %s FAILED replay (%s, epoch %d) — "
@@ -819,33 +1186,61 @@ class AuditWorker(threading.Thread):
     #: backlogged worker drops the OLDEST round (its transcripts are
     #: expiring anyway) rather than growing without bound
     MAX_PENDING = 8
+    #: default BYTE bound on the retained-round repair ring: the
+    #: pending RoundAudits hold signed frames + gathered part copies,
+    #: so at flagship part sizes a slow audit behind MAX_PENDING rounds
+    #: could pin gigabytes of host RAM — evict oldest-first by bytes
+    #: too (CollabConfig.audit_ring_bytes overrides)
+    MAX_BYTES = 256 << 20
 
     def __init__(self, dht, ledger, *, period: float = 0.5,
-                 jobs: int = 1):
+                 jobs: int = 1, repair=None,
+                 max_bytes: int = MAX_BYTES):
         super().__init__(daemon=True, name="audit-worker")
         self.dht = dht
         self.ledger = ledger
         self.period = period
         self.jobs = jobs
+        self.repair = repair
+        self.max_bytes = max_bytes
         self._stop_event = threading.Event()
         self._lock = threading.Lock()
         self._pending: deque = deque()
+        self._pending_bytes = 0
         self.audited = 0            # observability counters
         self.failures = 0
         self.omissions = 0
         self.unserved = 0
+        self.ring_evictions = 0
         self.last_report: Optional[dict] = None
 
     def submit(self, ra: RoundAudit) -> None:
         if ra is None or not ra.begun:
             return
+        nbytes = ra.retained_bytes()
         with self._lock:
-            if len(self._pending) >= self.MAX_PENDING:
+            # a SINGLE round over the whole byte budget is admitted
+            # without evicting the backlog (flushing every pending
+            # audit could never make room anyway — the bound is
+            # knowingly exceeded by exactly one round, and dropping
+            # the NEW round instead would let a flagship-size part
+            # evade auditing entirely)
+            budget = (self.max_bytes if nbytes <= self.max_bytes
+                      else self._pending_bytes + nbytes)
+            while self._pending and (
+                    len(self._pending) >= self.MAX_PENDING
+                    or self._pending_bytes + nbytes > budget):
                 dropped = self._pending.popleft()
+                self._pending_bytes -= dropped.retained_bytes()
+                self.ring_evictions += 1
                 logger.warning(
-                    "audit worker backlogged: dropping epoch %d audit",
+                    "audit ring backlogged (%d rounds / %d bytes "
+                    "retained): dropping epoch %d audit oldest-first",
+                    len(self._pending) + 1,
+                    self._pending_bytes + dropped.retained_bytes(),
                     dropped.epoch)
             self._pending.append(ra)
+            self._pending_bytes += nbytes
 
     def step(self) -> int:
         """Drain and audit every pending round synchronously; returns
@@ -856,8 +1251,9 @@ class AuditWorker(threading.Thread):
                 if not self._pending:
                     return n
                 ra = self._pending.popleft()
+                self._pending_bytes -= ra.retained_bytes()
             rep = audit_round(self.dht, ra, self.ledger,
-                              jobs=self.jobs)
+                              jobs=self.jobs, repair=self.repair)
             with self._lock:
                 self.audited += len(rep["audited"])
                 self.failures += len(rep["failed"])

@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from dalle_tpu.swarm.audit import AVERAGING_PHASES, phase_of_prefix
+
 logger = logging.getLogger(__name__)
 
 #: ops a FaultRule may target. "send"/"fetch" are addressed (peer
@@ -77,6 +79,17 @@ SENDER_BYZANTINE_KINDS = ("sign_flip", "scale", "garbage",
                           "weight_inflate")
 OWNER_BYZANTINE_KINDS = ("wrong_gather_part", "omit_sender")
 BYZANTINE_KINDS = SENDER_BYZANTINE_KINDS + OWNER_BYZANTINE_KINDS
+
+#: averaging phases a byzantine op may scope itself to. Every phase of
+#: the protocol runs the same butterfly (and, since r16, the same
+#: audit), but their prefixes differ — the mapping is protocol
+#: knowledge and lives with the audit (swarm/audit.py:
+#: AVERAGING_PHASES / phase_of_prefix, re-exported here); this
+#: test-time layer only consumes it. ``phase=None`` matches every
+#: phase (the pre-r16 semantics). The seams in ``run_allreduce`` pass
+#: the round prefix; ops filter on the derived phase so one plan can
+#: attack the gradient, factor and state rounds independently.
+BYZANTINE_PHASES = AVERAGING_PHASES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,19 +194,27 @@ class ByzantineOp:
       only defense with standing to catch it).
 
     The first active op of the relevant seam wins (FaultRule
-    precedence semantics, per seam).
+    precedence semantics, per seam). ``phase`` scopes the op to one
+    averaging phase ("grads", "powersgd", "state" —
+    :func:`phase_of_prefix` maps round prefixes); None fires on every
+    phase (the pre-r16 semantics).
     """
 
     kind: str
     factor: float = 10.0
     start_epoch: int = 0
     end_epoch: Optional[int] = None
+    phase: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in BYZANTINE_KINDS:
             raise ValueError(
                 f"unknown byzantine kind {self.kind!r}; expected one of "
                 f"{BYZANTINE_KINDS}")
+        if self.phase is not None and self.phase not in BYZANTINE_PHASES:
+            raise ValueError(
+                f"unknown byzantine phase {self.phase!r}; expected one "
+                f"of {BYZANTINE_PHASES} or null")
         if not math.isfinite(self.factor):
             raise ValueError("byzantine factor must be finite")
         if self.kind == "weight_inflate" and self.factor <= 0:
@@ -296,7 +317,9 @@ class FaultPlan:
                 factor=float(z.get("factor", 10.0)),
                 start_epoch=int(z.get("start_epoch", 0)),
                 end_epoch=(None if z.get("end_epoch") is None
-                           else int(z["end_epoch"]))))
+                           else int(z["end_epoch"])),
+                phase=(None if z.get("phase") is None
+                       else str(z["phase"]))))
         crash = obj.get("crash_at_epoch")
         return cls(seed=int(obj.get("seed", 0)), rules=tuple(rules),
                    blackouts=blackouts,
@@ -377,19 +400,31 @@ class ChaosDHT:
             return True
         return False
 
+    @staticmethod
+    def _byz_key(op: ByzantineOp) -> str:
+        """Injected-counter key: phase-suffixed for phase-scoped ops
+        (aux-phase oracles key on these), the bare r13/r14 key for
+        unscoped and grads-scoped ops (back-compat)."""
+        if op.phase in (None, "grads"):
+            return f"byz_{op.kind}"
+        return f"byz_{op.kind}:{op.phase}"
+
     def byzantine_op(self, epoch: int,
-                     kinds: Tuple[str, ...] = BYZANTINE_KINDS
-                     ) -> Optional[ByzantineOp]:
+                     kinds: Tuple[str, ...] = BYZANTINE_KINDS,
+                     phase: str = "grads") -> Optional[ByzantineOp]:
         """The first byzantine clause of one of ``kinds`` active at
-        ``epoch``, or None. The sender seam and the owner seam filter
-        to their own kinds, so one plan can carry both attack
-        classes."""
+        ``epoch`` whose phase scope covers ``phase``, or None. The
+        sender seam and the owner seam filter to their own kinds, so
+        one plan can carry both attack classes (and per-phase
+        variants)."""
         for op in self.plan.byzantine:
-            if op.kind in kinds and op.active(epoch):
+            if (op.kind in kinds and op.active(epoch)
+                    and op.phase in (None, phase)):
                 return op
         return None
 
-    def tamper_contribution(self, epoch: int, tensors, weight: float):
+    def tamper_contribution(self, epoch: int, tensors, weight: float,
+                            prefix: str = ""):
         """The SENDER byzantine injection seam, called by
         ``run_allreduce`` BEFORE flatten and signing: returns
         (tensors, frame_weight) — possibly rewritten — so the wire
@@ -399,11 +434,12 @@ class ChaosDHT:
         plan with no byzantine clauses (or none active this epoch)
         returns the inputs untouched, so an inert wrapper stays
         bit-transparent."""
-        op = self.byzantine_op(epoch, SENDER_BYZANTINE_KINDS)
+        op = self.byzantine_op(epoch, SENDER_BYZANTINE_KINDS,
+                               phase_of_prefix(prefix))
         if op is None:
             return tensors, weight
         import numpy as np
-        self._count(f"byz_{op.kind}")
+        self._count(self._byz_key(op))
         logger.warning("chaos: byzantine %s active at epoch %d "
                        "(factor=%r)", op.kind, epoch, op.factor)
         if op.kind == "weight_inflate":
@@ -422,33 +458,40 @@ class ChaosDHT:
         return [rng.standard_normal(np.shape(t)).astype(np.float32)
                 * np.float32(abs(op.factor)) for t in tensors], weight
 
-    def tamper_gather_part(self, epoch: int, part: int, values):
+    def tamper_gather_part(self, epoch: int, part: int, values,
+                           prefix: str = ""):
         """The OWNER byzantine seam, called by ``run_allreduce`` after
         the honest average (and after the audit transcript is
         recorded): an active ``wrong_gather_part`` op perturbs the
         part this owner is about to serve by ``+factor`` per element —
         a plausible, finite, validly-signed wrong part that no
-        input-side defense can see. Inert plans return ``values``
+        input-side defense can see. Fires on the phase the round
+        prefix names (grads / powersgd factor / state averaging) when
+        the op is phase-scoped. Inert plans return ``values``
         untouched (bit-transparency)."""
-        op = self.byzantine_op(epoch, ("wrong_gather_part",))
+        op = self.byzantine_op(epoch, ("wrong_gather_part",),
+                               phase_of_prefix(prefix))
         if op is None:
             return values
         import numpy as np
-        self._count("byz_wrong_gather_part")
+        self._count(self._byz_key(op))
         logger.warning("chaos: wrong_gather_part active at epoch %d "
-                       "(part %d, +%r)", epoch, part, op.factor)
+                       "(part %d, phase %s, +%r)", epoch, part,
+                       op.phase or "any", op.factor)
         return np.asarray(values, np.float32) + np.float32(op.factor)
 
-    def omit_sender_target(self, epoch: int, candidate_pids):
+    def omit_sender_target(self, epoch: int, candidate_pids,
+                           prefix: str = ""):
         """The OWNER omission seam: an active ``omit_sender`` op names
         the lowest-peer-id candidate (deterministic given the roster)
         whose delivered contribution this owner silently discards —
         no ban, no transcript entry. None when inert."""
-        op = self.byzantine_op(epoch, ("omit_sender",))
+        op = self.byzantine_op(epoch, ("omit_sender",),
+                               phase_of_prefix(prefix))
         if op is None or not candidate_pids:
             return None
         victim = min(candidate_pids)
-        self._count("byz_omit_sender")
+        self._count(self._byz_key(op))
         logger.warning("chaos: omit_sender active at epoch %d "
                        "(victim %s)", epoch, victim[:16])
         return victim

@@ -12,7 +12,7 @@ strikes decay after a few epochs, and repeat offenders are down-ranked
 (``matchmaking._read_candidates``) and ignored by the progress
 aggregation (``progress.ProgressTracker``) until their strikes age out.
 
-Two evidence planes:
+Three evidence planes:
 
 - **Local strikes** are this node's own verdicts. They can cross the
   penalty threshold on their own — the node SAW the offense.
@@ -30,6 +30,18 @@ Two evidence planes:
   is down-ranked swarm-wide within ~2 epochs instead of per-victim —
   and a fresh joiner inherits the swarm's evidence instead of paying
   its own ban timeouts to rediscover it.
+- **Verified proofs** (r16): an ``owner-audit-fail`` receipt may embed
+  its EVIDENCE — the accused owner's signed transcript plus its
+  signed gather frames (swarm/audit.build_proof_evidence). A reader
+  with a :class:`~dalle_tpu.swarm.audit.ProofVerifier` armed replays
+  the evidence itself; a verified proof is no longer an accusation but
+  a demonstrated contradiction in the OFFENDER'S OWN signatures, so it
+  scores the full penalty threshold (``proven_strike``) with no local
+  corroboration. Verification is all-or-nothing: an unverifiable
+  proof is dropped without ledger effect (not even the capped
+  accusation — attaching bogus evidence is self-discrediting), which
+  keeps the Sybil argument intact: influence beyond the r13 caps is
+  only ever granted to evidence the reader checked independently.
 
 Only ATTRIBUTABLE reasons gossip (:data:`GOSSIP_REASONS`): a receipt
 is a signed accusation, and the issuer must have held proof (a valid
@@ -97,6 +109,15 @@ GOSSIP_REASONS = frozenset({
 _MAX_EVENTS = 4096
 _MAX_SEEN = 8192
 
+#: largest evidence bundle a receipt will embed. Proof-carrying
+#: receipts (swarm/audit.py build_proof_evidence) ship the owner-signed
+#: transcript + gather frames inline so any peer can replay them;
+#: beyond this bound (flagship-scale parts) the receipt degrades to the
+#: plain r13 capped accusation — the conviction still lands through
+#: local corroboration, just not by proof alone. Sized under the
+#: native 64 MiB frame cap with headroom for the DHT record plane.
+PROOF_MAX_BYTES = 4 << 20
+
 
 class PeerHealthLedger:
     """Decaying per-peer strike counts, local + bounded remote.
@@ -128,17 +149,28 @@ class PeerHealthLedger:
         self._strikes: Dict[str, List[Tuple[int, float]]] = {}
         # peer_id -> issuer_id -> [(epoch, weight), ...]
         self._remote: Dict[str, Dict[str, List[Tuple[int, float]]]] = {}
-        # (epoch, peer, reason) local gossipable verdicts awaiting
-        # publication (StrikeGossip drains this)
-        self._events: List[Tuple[int, str, str]] = []
+        # peer_id -> {dedup ref: epoch} of VERIFIED proof convictions
+        # (independently replayed evidence — swarm/audit.ProofVerifier).
+        # A live proof contributes the full penalty threshold to the
+        # score: a verified proof convicts with no local corroboration,
+        # the upgrade from the r13 capped-accusation plane.
+        self._proven: Dict[str, Dict[str, int]] = {}
+        # (epoch, peer, reason, evidence) local gossipable verdicts
+        # awaiting publication (StrikeGossip drains this); evidence is
+        # the optional proof bundle an owner-audit-fail conviction
+        # attaches (None for every other reason)
+        self._events: List[Tuple[int, str, str, Optional[bytes]]] = []
 
     # -- writes ------------------------------------------------------------
 
     def strike(self, peer_id: str, reason: str = "",
-               weight: float = 0.0) -> None:
+               weight: float = 0.0,
+               evidence: Optional[bytes] = None) -> None:
         """Record one LOCAL offense. ``weight`` 0 looks the reason up in
         STRIKE_WEIGHTS (unknown reasons count 1.0). Attributable
-        reasons (GOSSIP_REASONS) also queue a gossip event."""
+        reasons (GOSSIP_REASONS) also queue a gossip event; ``evidence``
+        (a proof bundle from the aggregation audit) rides the event so
+        the published receipt carries the proof."""
         w = weight or STRIKE_WEIGHTS.get(reason, 1.0)
         with self._lock:
             if (peer_id not in self._strikes
@@ -146,7 +178,8 @@ class PeerHealthLedger:
                 return  # bound memory against an id-churning flood
             self._strikes.setdefault(peer_id, []).append((self._epoch, w))
             if reason in GOSSIP_REASONS and len(self._events) < _MAX_EVENTS:
-                self._events.append((self._epoch, peer_id, reason))
+                self._events.append((self._epoch, peer_id, reason,
+                                     evidence))
 
     def remote_strike(self, issuer_id: str, peer_id: str, reason: str,
                       epoch: int, weight: float = 0.0) -> None:
@@ -170,6 +203,34 @@ class PeerHealthLedger:
             rec = issuers.setdefault(issuer_id, [])
             if len(rec) < _MAX_EVENTS:
                 rec.append((e, w))
+
+    def proven_strike(self, peer_id: str, reason: str, epoch: int,
+                      ref: str) -> bool:
+        """Fold one VERIFIED proof conviction: the caller (StrikeGossip
+        with a :class:`~dalle_tpu.swarm.audit.ProofVerifier` armed)
+        independently replayed the receipt's evidence and confirmed the
+        contradiction. A live proof scores the full penalty threshold —
+        conviction with no local corroboration — which is safe exactly
+        because verification is all-or-nothing: an unverifiable proof
+        never reaches here (it folds at most as a plain capped
+        receipt, or not at all). ``ref`` dedups re-wrapped copies of
+        the same evidence (peer/reason/epoch/phase), so a Sybil flock
+        re-publishing one proof gains nothing; the proof decays with
+        the ttl window like every strike. Returns True iff recorded."""
+        with self._lock:
+            e = min(int(epoch), self._epoch)
+            if e <= self._epoch - self.ttl_epochs:
+                return False  # stale evidence: aged out on arrival
+            if (peer_id not in self._proven
+                    and len(self._proven) >= self.max_peers):
+                return False
+            refs = self._proven.setdefault(peer_id, {})
+            if ref in refs:
+                return False  # replayed proof: idempotent
+            if len(refs) >= _MAX_EVENTS:
+                return False
+            refs[ref] = e
+            return True
 
     def advance_epoch(self, epoch: int) -> None:
         """Move the decay clock forward (never backward) and prune
@@ -195,15 +256,23 @@ class PeerHealthLedger:
                         del issuers[iid]
                 if not issuers:
                     del self._remote[pid]
+            for pid in list(self._proven):
+                live_refs = {r: e for r, e in self._proven[pid].items()
+                             if e > floor}
+                if live_refs:
+                    self._proven[pid] = live_refs
+                else:
+                    del self._proven[pid]
 
-    def drain_events(self) -> List[Tuple[int, str, str]]:
+    def drain_events(self) -> List[Tuple[int, str, str, Optional[bytes]]]:
         """Pop the queued gossipable verdicts (StrikeGossip publishes
-        them as signed receipts)."""
+        them as signed receipts, proof evidence attached when the
+        verdict carried one)."""
         with self._lock:
             out, self._events = self._events, []
             return out
 
-    def requeue_events(self, events: List[Tuple[int, str, str]]) -> None:
+    def requeue_events(self, events) -> None:
         """Put drained-but-unpublished verdicts back (a failed store —
         transient DHT outage, blackout — must retry next period, not
         silently lose the receipt). Bounded like the queue itself."""
@@ -228,13 +297,24 @@ class PeerHealthLedger:
             total += min(live, self.max_issuer_influence)
         return min(total, self.max_remote_influence)
 
+    def _proven_score(self, peer_id: str, floor: int) -> float:
+        """The penalty threshold while ANY verified proof is live —
+        a proof convicts outright; stacking proofs adds nothing (one
+        contradiction already proves dishonesty)."""
+        refs = self._proven.get(peer_id)
+        if refs and any(e > floor for e in refs.values()):
+            return self.penalty_threshold
+        return 0.0
+
     def score(self, peer_id: str) -> float:
         """Live (un-decayed) strike weight for a peer: local evidence
-        plus capped remote evidence."""
+        plus capped remote evidence, plus the full penalty threshold
+        while a verified proof conviction is live."""
         with self._lock:
             floor = self._epoch - self.ttl_epochs
             return (self._local_score(peer_id, floor)
-                    + self._remote_score(peer_id, floor))
+                    + self._remote_score(peer_id, floor)
+                    + self._proven_score(peer_id, floor))
 
     def remote_score(self, peer_id: str) -> float:
         """The (capped) remote-receipt component of ``score`` alone —
@@ -242,6 +322,24 @@ class PeerHealthLedger:
         with self._lock:
             floor = self._epoch - self.ttl_epochs
             return self._remote_score(peer_id, floor)
+
+    def local_score(self, peer_id: str) -> float:
+        """This node's OWN live evidence alone — the soak's proof
+        oracle asserts a peer with zero local evidence still convicts
+        through a verified proof."""
+        with self._lock:
+            floor = self._epoch - self.ttl_epochs
+            return self._local_score(peer_id, floor)
+
+    def proof_convictions(self, peer_id: str) -> Dict[str, int]:
+        """{dedup ref: epoch} of live verified-proof convictions
+        against a peer — observability for the repair soak's
+        no-local-corroboration oracle."""
+        with self._lock:
+            floor = self._epoch - self.ttl_epochs
+            return {r: e for r, e
+                    in self._proven.get(peer_id, {}).items()
+                    if e > floor}
 
     def penalized(self, peer_id: str) -> bool:
         return self.score(peer_id) >= self.penalty_threshold
@@ -252,9 +350,11 @@ class PeerHealthLedger:
         with self._lock:
             floor = self._epoch - self.ttl_epochs
             out = {}
-            for pid in set(self._strikes) | set(self._remote):
+            for pid in (set(self._strikes) | set(self._remote)
+                        | set(self._proven)):
                 s = (self._local_score(pid, floor)
-                     + self._remote_score(pid, floor))
+                     + self._remote_score(pid, floor)
+                     + self._proven_score(pid, floor))
                 if s > 0:
                     out[pid] = s
             return out
@@ -274,26 +374,32 @@ def strike_key(prefix: str) -> str:
 
 
 def make_receipt(identity, prefix: str, peer_id: str, reason: str,
-                 epoch: int) -> bytes:
+                 epoch: int, proof: Optional[bytes] = None) -> bytes:
     """An Ed25519-signed (peer, reason, epoch) verdict from
     ``identity``. The issuer IS the signing key — receipts carry no
-    separate issuer field to forge."""
+    separate issuer field to forge. ``proof`` (optional) embeds an
+    evidence bundle (swarm/audit.build_proof_evidence) under the same
+    signature: a verifying reader can then replay the conviction
+    independently instead of trusting the issuer's word."""
     import msgpack
 
     from dalle_tpu.swarm.identity import signed_frame
-    payload = msgpack.packb(
-        {"peer": peer_id, "reason": reason, "epoch": int(epoch)},
-        use_bin_type=True)
+    obj = {"peer": peer_id, "reason": reason, "epoch": int(epoch)}
+    if proof is not None:
+        obj["proof"] = bytes(proof)
+    payload = msgpack.packb(obj, use_bin_type=True)
     return signed_frame(identity, _receipt_ctx(prefix), b"", payload)
 
 
-def open_receipt(raw: bytes, prefix: str
-                 ) -> Optional[Tuple[str, str, str, int]]:
-    """(issuer_id, peer_id, reason, epoch) iff ``raw`` is a validly
-    signed receipt with a well-formed, gossipable payload; None
-    otherwise. STRICT on content: unknown reasons and malformed ids
-    are rejected outright — the strike plane is attacker-writable and
-    a verifier must never fold a claim it cannot price."""
+def open_receipt_full(raw: bytes, prefix: str
+                      ) -> Optional[Tuple[str, str, str, int,
+                                          Optional[bytes]]]:
+    """(issuer_id, peer_id, reason, epoch, proof_or_None) iff ``raw``
+    is a validly signed receipt with a well-formed, gossipable
+    payload; None otherwise. STRICT on content: unknown reasons and
+    malformed ids are rejected outright — the strike plane is
+    attacker-writable and a verifier must never fold a claim it
+    cannot price."""
     import msgpack
 
     from dalle_tpu.swarm.identity import open_frame
@@ -307,6 +413,14 @@ def open_receipt(raw: bytes, prefix: str
         peer = str(obj["peer"])
         reason = str(obj["reason"])
         epoch = int(obj["epoch"])
+        proof = obj.get("proof")
+        if proof is not None:
+            # type BEFORE size: bytes(2**34) on an int-typed field
+            # would allocate attacker-chosen memory before any check
+            if not isinstance(proof, (bytes, bytearray)) \
+                    or len(proof) > PROOF_MAX_BYTES:
+                return None  # malformed/oversized evidence
+            proof = bytes(proof)
     # rejecting unparseable receipts IS the verifier contract (hostile
     # writers expected on this plane); logging per record would hand a
     # flood a log-spam amplifier
@@ -317,7 +431,14 @@ def open_receipt(raw: bytes, prefix: str
         return None
     if len(peer) != 64 or any(c not in "0123456789abcdef" for c in peer):
         return None  # peer ids are hex sha256 digests
-    return issuer, peer, reason, epoch
+    return issuer, peer, reason, epoch, proof
+
+
+def open_receipt(raw: bytes, prefix: str
+                 ) -> Optional[Tuple[str, str, str, int]]:
+    """The r13 view of :func:`open_receipt_full` (proof dropped)."""
+    full = open_receipt_full(raw, prefix)
+    return None if full is None else full[:4]
 
 
 class StrikeGossip(threading.Thread):
@@ -343,7 +464,7 @@ class StrikeGossip(threading.Thread):
 
     def __init__(self, dht, ledger: PeerHealthLedger, prefix: str,
                  period: float = 5.0, receipt_ttl: float = 180.0,
-                 max_fold_per_poll: int = 512):
+                 max_fold_per_poll: int = 512, verifier=None):
         super().__init__(daemon=True, name="strike-gossip")
         self.dht = dht
         self.ledger = ledger
@@ -351,24 +472,51 @@ class StrikeGossip(threading.Thread):
         self.period = period
         self.receipt_ttl = receipt_ttl
         self.max_fold_per_poll = max_fold_per_poll
+        #: optional proof verifier (swarm/audit.ProofVerifier): with it
+        #: armed, a proof-carrying receipt is re-verified by REPLAYING
+        #: its evidence — verified ⇒ a proven conviction (full penalty
+        #: weight, no local corroboration needed), unverifiable ⇒
+        #: DROPPED outright (all-or-nothing: a receipt whose attached
+        #: evidence fails its own check earns its issuer nothing, not
+        #: even the capped accusation — attaching bogus proof is
+        #: self-discrediting). Without a verifier, proof receipts fold
+        #: exactly like plain r13 receipts (capped influence).
+        self.verifier = verifier
         self._stop_event = threading.Event()
-        self._seen: set = set()     # (issuer, peer, reason, epoch)
+        self._seen: set = set()     # (issuer, peer, reason, epoch, ref)
         self.published = 0          # observability counters
         self.folded = 0
+        self.proofs_published = 0
+        self.proofs_convicted = 0
+        self.proofs_rejected = 0
 
     # -- one synchronous round (tests / soak drive this directly) ---------
 
     def publish_once(self) -> int:
+        import hashlib as _hashlib
+
         from dalle_tpu.swarm.dht import get_dht_time
         n = 0
         events = self.ledger.drain_events()
-        failed: List[Tuple[int, str, str]] = []
-        for i, (epoch, peer, reason) in enumerate(events):
+        failed: List[Tuple[int, str, str, Optional[bytes]]] = []
+        for i, (epoch, peer, reason, evidence) in enumerate(events):
             if peer == self.dht.peer_id:
                 continue  # self-verdicts are local bookkeeping only
+            proof = (evidence if evidence is not None
+                     and len(evidence) <= PROOF_MAX_BYTES else None)
+            if evidence is not None and proof is None:
+                logger.warning(
+                    "strike evidence too large to embed (%d > %d "
+                    "bytes): receipt degrades to the capped "
+                    "accusation", len(evidence), PROOF_MAX_BYTES)
             receipt = make_receipt(self.dht.identity, self.prefix,
-                                   peer, reason, epoch)
+                                   peer, reason, epoch, proof=proof)
             sub = f"{self.dht.peer_id}.{peer}.{reason}.{epoch}"
+            if proof is not None:
+                # distinct evidence (e.g. two phase convictions in one
+                # epoch) must not collide on the dedup subkey
+                sub += "." + _hashlib.sha256(proof).hexdigest()[:8]
+                self.proofs_published += 1
             try:
                 ok = self.dht.store(strike_key(self.prefix), sub, receipt,
                                     expiration_time=get_dht_time()
@@ -390,13 +538,14 @@ class StrikeGossip(threading.Thread):
             else:
                 # a False store (outage, blackout rule) retries next
                 # period — a one-shot offense's receipt must not vanish
-                failed.append((epoch, peer, reason))
+                failed.append((epoch, peer, reason, evidence))
         if failed:
             self.ledger.requeue_events(failed)
         self.published += n
         return n
 
     def fold_once(self) -> int:
+        import hashlib as _hashlib
         entries = self.dht.get(strike_key(self.prefix)) or {}
         n = 0
         for _subkey, item in entries.items():
@@ -404,23 +553,41 @@ class StrikeGossip(threading.Thread):
                 break  # bounded work per poll under a receipt flood
             if not isinstance(item.value, (bytes, bytearray)):
                 continue
-            opened = open_receipt(item.value, self.prefix)
+            opened = open_receipt_full(item.value, self.prefix)
             if opened is None:
                 continue
-            issuer, peer, reason, epoch = opened
+            issuer, peer, reason, epoch, proof = opened
             if issuer == self.dht.peer_id:
                 continue  # our own verdicts are already local strikes
             if peer == self.dht.peer_id:
                 continue  # never fold accusations against self
             if peer == issuer:
                 continue  # self-confessions carry no information
-            mark = (issuer, peer, reason, epoch)
+            ref = ("" if proof is None
+                   else _hashlib.sha256(proof).hexdigest()[:16])
+            mark = (issuer, peer, reason, epoch, ref)
             if mark in self._seen:
                 continue
             if len(self._seen) >= _MAX_SEEN:
                 self._seen.clear()  # re-folds are idempotent-ish: the
                 # per-issuer influence cap bounds any double count
             self._seen.add(mark)
+            if proof is not None and self.verifier is not None:
+                # all-or-nothing: a verified proof convicts outright
+                # (no local corroboration needed); an unverifiable one
+                # is dropped WITHOUT ledger effect — forged, stale,
+                # mismatched or unchallenged evidence earns its issuer
+                # nothing, not even the capped accusation
+                verified_prefix = self.verifier(proof, peer, epoch)
+                if verified_prefix:
+                    self.proofs_convicted += 1
+                    self.ledger.proven_strike(
+                        peer, reason, epoch,
+                        ref=f"{reason}:{verified_prefix}:{ref}")
+                    n += 1
+                else:
+                    self.proofs_rejected += 1
+                continue
             self.ledger.remote_strike(issuer, peer, reason, epoch)
             n += 1
         self.folded += n

@@ -24,7 +24,15 @@ from dalle_tpu.swarm.identity import Identity
 
 
 class LocalMetrics(pydantic.BaseModel, extra="forbid"):
-    """One peer's per-epoch report (reference ``utils.py:15-21``)."""
+    """One peer's per-epoch report (reference ``utils.py:15-21``).
+
+    The robustness counters (r16) surface what was previously log-only
+    — cumulative per peer, from ``CollaborativeOptimizer
+    .robustness_snapshot()``: audited parts, audit convictions
+    (fail + omit verdicts), repairs applied by the round-repair plane,
+    repair-ring byte-bound evictions, and the r15 error-feedback
+    lost-residual windows. They default to 0 so pre-r16 records stay
+    valid."""
 
     peer_id: str
     epoch: int
@@ -32,6 +40,11 @@ class LocalMetrics(pydantic.BaseModel, extra="forbid"):
     samples_accumulated: int
     loss: float
     mini_steps: int
+    parts_audited: int = 0
+    audit_convictions: int = 0
+    repairs_applied: int = 0
+    repair_ring_evictions: int = 0
+    ef_lost_rounds: int = 0
 
 
 def metrics_key(experiment_prefix: str) -> str:

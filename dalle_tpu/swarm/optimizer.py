@@ -183,15 +183,35 @@ class CollaborativeOptimizer:
             # completed rounds' RoundAudit retention off the training
             # thread — fetches challenged owners' transcripts, replays
             # the averages, bit-compares, and strikes (a replay
-            # mismatch gossips through the receipt plane above).
-            # Reaped by shutdown() before the DHT goes down.
+            # mismatch gossips through the receipt plane above, with
+            # the proof evidence attached). The retained-round ring is
+            # byte-bounded (cfg.audit_ring_bytes). Round repair
+            # (swarm/repair.py): replayed-bytes-mismatch convictions
+            # queue their honest-minus-served correction on the repair
+            # plane; _apply_averaged drains it into the next gradient
+            # application. Reaped by shutdown() before the DHT goes
+            # down.
             self._auditor = None
             self._audit_policy = None
+            self._repair = None
             if getattr(cfg, "audit_gather", False):
                 from dalle_tpu.swarm.audit import AuditPolicy, AuditWorker
                 self._audit_policy = AuditPolicy(
                     frac=cfg.audit_frac, ttl=cfg.audit_ttl)
-                self._auditor = AuditWorker(dht, self.ledger)
+                if getattr(cfg, "repair_convicted", False) \
+                        and jax.process_count() == 1:
+                    # single-process peers only: a multi-host slice
+                    # would need every correction broadcast to stay in
+                    # lockstep (followers run no auditor to agree
+                    # with), and a plane nothing drains would just
+                    # retain part-sized copies — don't create one
+                    from dalle_tpu.swarm.repair import RepairPlane
+                    self._repair = RepairPlane(
+                        accept_prefix=f"{cfg.run_id}_grads")
+                self._auditor = AuditWorker(
+                    dht, self.ledger, repair=self._repair,
+                    max_bytes=getattr(cfg, "audit_ring_bytes",
+                                      AuditWorker.MAX_BYTES))
                 self._auditor.start()
         else:
             self.ledger = None
@@ -200,6 +220,7 @@ class CollaborativeOptimizer:
             self._max_peer_weight = None
             self._auditor = None
             self._audit_policy = None
+            self._repair = None
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
         # Wire-codec execution backend (swarm/device_codec.py): "device"
@@ -267,6 +288,33 @@ class CollaborativeOptimizer:
         else:
             self._ef_scatter = None
             self._ef_gather = None
+        # Proof-carrying receipts (swarm/audit.ProofVerifier): with the
+        # verifier armed, a gossiped owner-audit-fail receipt carrying
+        # evidence is re-verified by REPLAYING it under THIS peer's
+        # round config — verified proofs convict with no local
+        # corroboration (health.proven_strike), unverifiable ones are
+        # dropped without ledger effect. Attached after codec
+        # resolution: the verifier judges by the same codec/pin/screen/
+        # clamp this peer's own rounds run under (the run-config-
+        # homogeneity contract the r14 audit already documents).
+        if self._gossip is not None and self._audit_policy is not None:
+            from dalle_tpu.swarm.allreduce import CHUNK_ELEMS
+            from dalle_tpu.swarm.audit import ProofVerifier
+            self._gossip.verifier = ProofVerifier(
+                cfg.run_id, frac=self._audit_policy.frac,
+                chunk_elems=CHUNK_ELEMS, codec=self._grad_codec,
+                adaptive_threshold=cfg.size_adaptive_threshold,
+                screen=self._screen,
+                max_peer_weight=self._max_peer_weight,
+                gather_codec=self._gather_codec,
+                pinned=self._grad_codec if self._pin_codec else None,
+                phase_overrides={
+                    # the aux phases run their own codec config — a
+                    # proof from them must be judged under it
+                    "powersgd": {"gather_codec": None, "pinned": None},
+                    "state": {"codec": self._state_codec,
+                              "gather_codec": None, "pinned": None},
+                })
         self._grad_acc = None
         self._accumulate = jax.jit(
             lambda acc, g, s: jax.tree.map(
@@ -425,16 +473,22 @@ class CollaborativeOptimizer:
         return (self.cfg.delay_optimizer_step and self.role.swarm_enabled
                 and process_count() == 1)
 
-    def _new_round_audit(self, epoch: int):
-        """A fresh per-round audit container for the main gradient
-        all-reduce, or None when auditing is off. PowerSGD factor
-        rounds and state averaging run unaudited for now: their
-        prefixes differ per phase and their value is bounded by the
-        audited gradient path (documented in CHAOS.md)."""
+    def _new_round_audit(self, epoch: int, phase_suffix: str = "grads"):
+        """A fresh per-round audit container, or None when auditing is
+        off. ``phase_suffix`` names the averaging phase's prefix leg:
+        the main gradient rounds ("grads"), the PowerSGD factor rounds
+        ("grads_p"/"grads_q") and the periodic state averaging
+        ("state") each ride the same butterfly and, since r16, the
+        same challenge/transcript/replay machinery under their own
+        prefix (the r14 per-phase gap CHAOS.md documented). Aux-phase
+        auditing is gated by ``cfg.audit_aux_phases``."""
         if self._auditor is None:
             return None
+        if phase_suffix != "grads" and not getattr(
+                self.cfg, "audit_aux_phases", False):
+            return None
         from dalle_tpu.swarm.audit import RoundAudit
-        return RoundAudit(f"{self.cfg.run_id}_grads", epoch,
+        return RoundAudit(f"{self.cfg.run_id}_{phase_suffix}", epoch,
                           self._audit_policy)
 
     def _launch_round(self) -> None:
@@ -568,6 +622,7 @@ class CollaborativeOptimizer:
             **pending.timings, **self._apply_timings,
             "overlapped_steps": pending.overlapped_steps,
             "hidden_s": round(pending.hidden_s, 4),
+            "robust": self.robustness_snapshot(),
         }
         logger.info(
             "overlapped global step -> epoch %d (group=%d, %d grad steps "
@@ -719,6 +774,7 @@ class CollaborativeOptimizer:
             "allreduce_s": round(t_reduce - t_match - max(
                 0.0, pull_s - (t_pull - t0)), 4),
             **self._apply_timings,
+            "robust": self.robustness_snapshot(),
         }
         logger.info("global step -> epoch %d (%.2fs, group=%s, %s)",
                     self.local_epoch, time.monotonic() - t0,
@@ -771,6 +827,15 @@ class CollaborativeOptimizer:
             ok, out = 1, None
             if coordinator:
                 rep: dict = {}
+                # the factor rounds are audited like any butterfly
+                # round (r16): a challenged factor-part owner serves a
+                # transcript under the phase prefix, and a conviction
+                # gossips a proof-carrying receipt. No repair — factor
+                # corrections live in projection space; a corrupted
+                # factor round's blast radius is this epoch's
+                # reconstruction, bounded like IncompleteRound's.
+                ra = self._new_round_audit(self.local_epoch,
+                                           f"grads_{phase}")
                 out = run_allreduce(
                     self.dht, group,
                     f"{self.cfg.run_id}_grads_{phase}",
@@ -780,7 +845,10 @@ class CollaborativeOptimizer:
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
                     report=rep, codec_backend=self._codec_backend,
                     ledger=self.ledger, screen=self._screen,
-                    max_peer_weight=self._max_peer_weight)
+                    max_peer_weight=self._max_peer_weight,
+                    audit=ra)
+                if ra is not None:
+                    self._auditor.submit(ra)
                 if not rep.get("complete", False):
                     ok = 0
             if sharded:
@@ -815,6 +883,21 @@ class CollaborativeOptimizer:
         accumulator holds the NEXT epoch's gradients collected during the
         round — it must survive the reconcile."""
         t0 = time.monotonic()
+        from dalle_tpu.parallel.multihost import process_count
+        if (self._repair is not None and self._repair.pending()
+                and process_count() == 1):
+            # Round repair (swarm/repair.py): drain queued corrections
+            # into the vector this step applies. A correction whose
+            # round is THIS application's round still finds the served
+            # bytes in place and is assigned exactly (bit-identical to
+            # an honest round); one that missed its round rides this
+            # later step as a bounded-staleness compensation. Single-
+            # process peers only — a multi-host slice would need the
+            # correction broadcast to stay in lockstep, and its
+            # followers run no auditor to agree with.
+            averaged = [np.array(a, np.float32, copy=True)
+                        for a in averaged]
+            self._repair.apply(averaged)
         grads_tree = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(a) for a in averaged])
         self.state = self.apply_step(self.state, grads_tree)
@@ -838,6 +921,42 @@ class CollaborativeOptimizer:
 
         for cb in self.on_after_global_step:
             cb()
+
+    def robustness_snapshot(self) -> dict:
+        """The silent robustness counters, surfaced (r16): audit
+        volume and verdicts, repairs applied (exact vs stale), repair-
+        ring evictions, proof-receipt traffic, and the r15 error-
+        feedback lost-residual windows — everything that was log-only
+        before. Rides the per-step round report (``last_timings
+        ["robust"]``) and the swarm metrics record (training loop)."""
+        out = {
+            "parts_audited": 0, "audit_fail": 0, "audit_omit": 0,
+            "audit_unserved": 0, "ring_evictions": 0,
+            "repairs_applied": 0, "repairs_exact": 0,
+            "repairs_pending": 0,
+            "proofs_published": 0, "proofs_convicted": 0,
+            "proofs_rejected": 0,
+            "ef_lost_rounds": 0,
+        }
+        if self._auditor is not None:
+            out["parts_audited"] = self._auditor.audited
+            out["audit_fail"] = self._auditor.failures
+            out["audit_omit"] = self._auditor.omissions
+            out["audit_unserved"] = self._auditor.unserved
+            out["ring_evictions"] = self._auditor.ring_evictions
+        if self._repair is not None:
+            snap = self._repair.snapshot()
+            out["repairs_applied"] = snap["applied"]
+            out["repairs_exact"] = snap["applied_exact"]
+            out["repairs_pending"] = snap["pending"]
+        if self._gossip is not None:
+            out["proofs_published"] = self._gossip.proofs_published
+            out["proofs_convicted"] = self._gossip.proofs_convicted
+            out["proofs_rejected"] = self._gossip.proofs_rejected
+        for ef in (self._ef_scatter, self._ef_gather):
+            if ef is not None:
+                out["ef_lost_rounds"] += ef.lost_rounds
+        return out
 
     # -- drift control / recovery ----------------------------------------
 
@@ -909,6 +1028,14 @@ class CollaborativeOptimizer:
             if group is not None and group.size > 1:
                 if floats is None:
                     leaves, float_idx, floats = float_leaves()
+                # state averaging is audited under its own prefix
+                # (r16): a hostile owner serving a wrong averaged
+                # STATE part — the one attack that poisons params
+                # directly, bypassing every gradient defense — now
+                # faces the same transcript/replay conviction, and
+                # the proof receipt convicts peers that skipped this
+                # averaging round entirely
+                ra = self._new_round_audit(self.local_epoch, "state")
                 averaged = run_allreduce(
                     self.dht, group, f"{self.cfg.run_id}_state",
                     self.local_epoch, floats, weight=1.0,
@@ -917,7 +1044,10 @@ class CollaborativeOptimizer:
                     adaptive_threshold=self.cfg.size_adaptive_threshold,
                     codec_backend=self._codec_backend,
                     ledger=self.ledger, screen=self._screen,
-                    max_peer_weight=self._max_peer_weight)
+                    max_peer_weight=self._max_peer_weight,
+                    audit=ra)
+                if ra is not None:
+                    self._auditor.submit(ra)
         if not broadcast_decision(0 if averaged is None else 1):
             return
         if floats is None:  # follower of a slice whose coordinator averaged

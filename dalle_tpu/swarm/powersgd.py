@@ -57,6 +57,19 @@ choices guarantee the first by construction under elastic membership:
 Tensors too small to win from rank-r factorization travel uncompressed
 through the same all-reduce rounds (appended to the Q phase).
 
+Trust (r16): the factor rounds ride the same butterfly as the gradient
+rounds and, with ``CollabConfig.audit_aux_phases``, the same verified-
+aggregation machinery under their own prefixes (``{run}_grads_p`` /
+``_q``) — a hostile factor-part owner serving wrong averaged-P bytes
+(which every peer would then orthogonalize into a corrupted shared
+basis) is convicted by transcript replay exactly like a gradient-part
+owner, and the conviction gossips as a proof-carrying receipt
+(swarm/audit.py, CHAOS.md "Round repair"). Factor rounds are audited
+but not REPAIRED: a correction lives in projection space and cannot be
+scattered into the gradient accumulator; the blast radius of one wrong
+factor round is this epoch's reconstruction — the same bound the
+:class:`IncompleteRound` fallback already accepts.
+
 Compression: a (m x n) tensor costs r*(m+n) floats on the wire instead of
 m*n — at the flagship's 1024x1024 blocks and rank 4 that is 128x less
 gradient traffic per round, at the cost of a rank-r approximation whose

@@ -207,6 +207,7 @@ def train_loop(task: TrainingTask,
                         collab.tracker.performance_ema.samples_per_second))
                 reports.append(report)
                 if did_global and publish_metrics_records and coordinator:
+                    robust = collab.robustness_snapshot()
                     publish_metrics(
                         task.dht, task.peer_cfg.experiment_prefix,
                         LocalMetrics(
@@ -215,7 +216,13 @@ def train_loop(task: TrainingTask,
                             samples_per_second=report.samples_per_second,
                             samples_accumulated=0,
                             loss=report.loss,
-                            mini_steps=report.mini_steps),
+                            mini_steps=report.mini_steps,
+                            parts_audited=robust["parts_audited"],
+                            audit_convictions=(robust["audit_fail"]
+                                               + robust["audit_omit"]),
+                            repairs_applied=robust["repairs_applied"],
+                            repair_ring_evictions=robust["ring_evictions"],
+                            ef_lost_rounds=robust["ef_lost_rounds"]),
                         expiration=task.collab_cfg.metrics_expiration)
                 logger.info(
                     "epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",

@@ -63,31 +63,45 @@ Results land in BYZANTINE_SOAK.json. The fast tier-1 variant and the
 slow-marked full soak live in tests/test_screening.py.
 
 **Hostile-owner mode** (``--hostile-owner``, CHAOS.md "Verified
-aggregation"): the same harness pointed at the aggregation's OUTPUT
-trust model. Every peer arms the full defense stack PLUS the audit
-layer (swarm/audit.py, frac=1.0: every part challenged every round,
-audited synchronously each epoch so conviction latency is measured in
-epochs). THREE passes share one seeded schedule:
+aggregation" + "Round repair"): the same harness pointed at the
+aggregation's OUTPUT trust model — and, since r16, at its REPAIR.
+Every peer arms the full defense stack PLUS the audit layer
+(swarm/audit.py, frac=1.0: every part challenged every round, audited
+synchronously each epoch so conviction latency is measured in epochs),
+the round-repair plane (swarm/repair.py) and proof-verifying gossip.
+Two peers additionally run per-epoch AUX rounds — a PowerSGD-factor
+stand-in (prefix ``…_p``) and a state-averaging round (``…_state``) —
+each audited under its own prefix. FOUR passes share one seeded
+schedule:
 
-- a **control** pass (attacks stripped, audits ON) — the
-  false-positive oracle: ZERO strikes of any kind (audit strikes
-  included) and bit-exact convergence to the analytic reference, i.e.
-  audit-enabled honest rounds are byte-identical to the r13 rounds;
-- the **attack** pass — one ``wrong_gather_part`` owner (screens and
-  averages honestly, serves a wrong part) and one ``omit_sender``
-  owner (silently discards the lowest-peer-id sender's delivered
-  contribution). Oracles: every honest peer's replay audit convicts
-  the wrong-part owner within <= 2 epochs of the attack starting,
-  with the ``owner-audit-fail`` strike in its ledger AND gossiped
-  remote receipts corroborating; the omitted victim's ledger gains
-  the ``owner-audit-omit`` strike within <= 2 epochs; both attack
-  seams actually fired (injected counters);
+- a **control** pass (attacks stripped; audits + repair + aux ON) —
+  the false-positive oracle: ZERO strikes of any kind, ZERO repairs,
+  and bit-exact convergence to the analytic reference — i.e.
+  repair-enabled honest rounds are byte-identical to the r15 rounds;
+- the **attack** pass — one ``wrong_gather_part`` owner and one
+  ``omit_sender`` owner in the gradient rounds (the r14 pair), plus
+  phase-scoped ``wrong_gather_part`` ops on the two aux phases.
+  Oracles: every honest peer's replay audit convicts the wrong-part
+  owner within <= 2 epochs WITH a verified proof receipt
+  corroborating, REPAIRS the wrong part (>= 1 repair each) and ends
+  bit-exact on the honest-only analytic reference; the omitted
+  victim's ledger gains ``owner-audit-omit`` within <= 2 epochs; the
+  aux-phase attackers are each convicted in every honest ledger via a
+  proof-carrying receipt — with at least one peer convicting while it
+  held no local evidence of its own (proof alone convicts); every
+  attack seam actually fired (phase-scoped injected counters);
+- a **nofix** pass (attacks on; audits ON, repair OFF, aux off) — the
+  r15 reference: detection without correction, so convicted honest
+  survivors DIVERGE from the analytic reference — the regression the
+  repair plane exists to close, kept as the divergence oracle (and
+  the pin that repair OFF is byte-identical to r15);
 - a **transparency** pass (attacks stripped, audits OFF) — the
   audits-disabled pin: rounds behave byte-identically to the
   pre-audit protocol (bit-exact analytic convergence, zero strikes).
 
-Results land in HOSTILE_OWNER_SOAK.json. The fast tier-1 variant and
-the slow-marked full soak live in tests/test_audit.py.
+Results land in HOSTILE_OWNER_SOAK.json. The fast tier-1 variant (the
+r16 "repair soak") and the slow-marked full soak live in
+tests/test_audit.py.
 
 Usage::
 
@@ -227,7 +241,9 @@ class SoakPeer:
                  gossip: bool = False,
                  audit_policy: Optional[AuditPolicy] = None,
                  wire_codec: int = compression.NONE,
-                 ef: bool = False):
+                 ef: bool = False,
+                 repair: bool = False,
+                 aux_rounds: Optional[List[str]] = None):
         self.name = name
         self.node = node
         self.dht = ChaosDHT(node, plan) if plan.enabled else node
@@ -262,13 +278,49 @@ class SoakPeer:
         # measure against
         self.screen = screen
         self.max_peer_weight = max_peer_weight
-        self.gossip = (StrikeGossip(self.dht, self.ledger, prefix)
+        # proof-carrying receipts (r16): with audits armed, the gossip
+        # worker re-verifies proof evidence by REPLAY under this peer's
+        # own round config — a verified proof convicts with no local
+        # corroboration (the aux-phase oracle), an unverifiable one is
+        # dropped without ledger effect
+        verifier = None
+        if gossip and audit_policy is not None:
+            from dalle_tpu.swarm.allreduce import CHUNK_ELEMS
+            from dalle_tpu.swarm.audit import ProofVerifier
+            verifier = ProofVerifier(
+                prefix, frac=audit_policy.frac,
+                chunk_elems=CHUNK_ELEMS, codec=wire_codec,
+                screen=screen, max_peer_weight=max_peer_weight,
+                pinned=(wire_codec if wire_codec != compression.NONE
+                        else None))
+        self.gossip = (StrikeGossip(self.dht, self.ledger, prefix,
+                                    verifier=verifier)
                        if gossip else None)
+        # round repair (r16): the audit's honest reconstruction patches
+        # this peer's averaged vector BEFORE the state applies it (the
+        # pre-step, bit-exact landing site); OFF keeps the r15
+        # detection-only bytes
+        self.repair_plane = None
+        if repair:
+            from dalle_tpu.swarm.repair import RepairPlane
+            self.repair_plane = RepairPlane(accept_prefix=prefix)
+        # aux averaging phases (r16): suffixes of extra per-epoch
+        # butterfly rounds this peer joins — "p" (the PowerSGD factor
+        # stand-in) and "state" (state averaging), each audited under
+        # its own prefix; the averaged result is discarded (the rounds
+        # exist to exercise the per-phase audit + proof plane)
+        self.aux_rounds = list(aux_rounds or [])
         # first epoch each offender showed up in this ledger, split by
-        # evidence plane (score = any; remote = gossiped receipts) —
-        # the byzantine soak's "struck within <= 2 epochs" oracle
+        # evidence plane (score = any; remote = gossiped receipts;
+        # proof = verified-proof convictions) — the soaks' "struck
+        # within <= 2 epochs" oracles. local_at_first_proof snapshots
+        # this node's OWN evidence at the moment the proof convicted:
+        # 0.0 there is the "no local corroboration" oracle.
         self.first_strike: Dict[str, int] = {}
         self.first_remote: Dict[str, int] = {}
+        self.first_proof: Dict[str, int] = {}
+        self.proof_refs: Dict[str, List[str]] = {}
+        self.local_at_first_proof: Dict[str, float] = {}
         # hostile-owner mode: the verified-aggregation layer, run
         # SYNCHRONOUSLY after each round so conviction latency is
         # deterministic relative to the epoch clock the oracles use
@@ -336,7 +388,8 @@ class SoakPeer:
                     averaged = grads
                 if ra is not None and ra.begun:
                     try:
-                        rep = audit_round(self.dht, ra, self.ledger)
+                        rep = audit_round(self.dht, ra, self.ledger,
+                                          repair=self.repair_plane)
                         for cls, key in (("failed", "fail"),
                                          ("omitted", "omit"),
                                          ("unserved", "unserved")):
@@ -346,6 +399,23 @@ class SoakPeer:
                     except Exception as e:  # noqa: BLE001 - degraded
                         self.errors.append(
                             f"audit at epoch {self.epoch}: {e!r}")
+                # aux averaging phases (PowerSGD factor stand-in +
+                # state averaging), each under its own audited prefix;
+                # results are discarded — the rounds exist so the
+                # per-phase audit and the proof-receipt plane run for
+                # real. No repair: corrections outside the gradient
+                # plane are detection-only by design.
+                for suffix in self.aux_rounds:
+                    self._aux_round(suffix)
+                # round repair: drain the audit's corrections into the
+                # averaged vector BEFORE it reaches the state — the
+                # pre-step landing site, bit-exact by assignment
+                if self.repair_plane is not None:
+                    try:
+                        self.repair_plane.apply([averaged])
+                    except Exception as e:  # noqa: BLE001 - degraded
+                        self.errors.append(
+                            f"repair at epoch {self.epoch}: {e!r}")
                 self.ledger.advance_epoch(self.epoch)
                 if self.gossip is not None:
                     try:
@@ -358,10 +428,29 @@ class SoakPeer:
                     if (pid not in self.first_remote
                             and self.ledger.remote_score(pid) > 0):
                         self.first_remote[pid] = self.epoch
+                self._track_proofs()
                 with self.lock:
                     self.state = self.state + averaged
                     self.epoch += 1
                 self.epoch_log.append(self.epoch)
+            # post-target gossip linger: the aux pairs run ~2x the
+            # per-epoch wall, so their proof receipts can publish
+            # after a fast peer already hit its target — keep folding
+            # briefly so every ledger converges before teardown. Only
+            # when this peer has ANY evidence in play (an honest
+            # control pass skips it outright).
+            if (self.gossip is not None and not self.died
+                    and self.epoch >= self.target
+                    and self.ledger.snapshot()):
+                linger = min(time.monotonic() + 5.0, self.deadline)
+                while time.monotonic() < linger:
+                    try:
+                        self.gossip.step()
+                    except Exception as e:  # noqa: BLE001 - degraded
+                        self.errors.append(f"linger gossip: {e!r}")
+                        break
+                    self._track_proofs()
+                    time.sleep(0.4)
         finally:
             if self.died:
                 # abrupt process death: stop serving and tear the
@@ -371,6 +460,69 @@ class SoakPeer:
                 self.node.shutdown()
             # survivors keep their StateServer up past the loop (a late
             # joiner must still find a server); finish() tears it down
+
+    def _track_proofs(self) -> None:
+        """Record first-proof epochs, their dedup refs (which carry
+        the verified evidence's phase prefix), and this peer's own
+        local evidence AT the moment the proof convicted — the
+        no-local-corroboration oracle's inputs."""
+        for pid in list(self.ledger.snapshot()):
+            refs = self.ledger.proof_convictions(pid)
+            if not refs:
+                continue
+            if pid not in self.first_proof:
+                self.first_proof[pid] = self.epoch
+                self.local_at_first_proof[pid] = \
+                    self.ledger.local_score(pid)
+            seen = self.proof_refs.setdefault(pid, [])
+            for r in refs:
+                if r not in seen:
+                    seen.append(r)
+
+    def _aux_round(self, suffix: str) -> None:
+        """One auxiliary averaging round under ``{prefix}_{suffix}``
+        (the "p" factor phase / "state" averaging), audited
+        synchronously. Only the peers configured with the suffix
+        announce there, so the pair forms a 2-member butterfly whose
+        challenged owners serve transcripts like any round; a chaos
+        plan's phase-scoped ``wrong_gather_part`` op fires at this
+        owner seam and nowhere else. Failures degrade (the aux round
+        is side-channel: the main state never touches it)."""
+        aux_prefix = f"{self.prefix}_{suffix}"
+        ra = (RoundAudit(aux_prefix, self.epoch, self.audit_policy)
+              if self.audit_policy is not None else None)
+        try:
+            g = make_group(self.dht, aux_prefix, epoch=self.epoch,
+                           weight=1.0, matchmaking_time=self.mt,
+                           min_group_size=2, ledger=self.ledger)
+            if g is None or g.size <= 1:
+                return  # the partner is on another epoch: idle round
+            run_allreduce(
+                self.dht, g, aux_prefix, self.epoch,
+                [grads_for_epoch(self.epoch,
+                                 full_scale=self.full_scale)],
+                weight=1.0, allreduce_timeout=self.at,
+                sender_timeout=min(2.0, self.at / 3),
+                codec=self.wire_codec, ledger=self.ledger,
+                screen=self.screen,
+                max_peer_weight=self.max_peer_weight, audit=ra,
+                pin_codec=self.wire_codec != compression.NONE)
+        except Exception as e:  # noqa: BLE001 - degraded aux round
+            self.errors.append(
+                f"aux {suffix} at epoch {self.epoch}: {e!r}")
+            return
+        if ra is not None and ra.begun:
+            try:
+                rep = audit_round(self.dht, ra, self.ledger)
+                for cls, key in (("failed", "fail"),
+                                 ("omitted", "omit"),
+                                 ("unserved", "unserved")):
+                    for entry in rep[cls]:
+                        self.audit_events[key].setdefault(
+                            entry["owner"], self.epoch)
+            except Exception as e:  # noqa: BLE001 - degraded
+                self.errors.append(
+                    f"aux {suffix} audit at epoch {self.epoch}: {e!r}")
 
     def finish(self) -> None:
         """Join the loop and tear down whatever the death path didn't."""
@@ -391,8 +543,16 @@ class SoakPeer:
                     "strikes": self.ledger.snapshot(),
                     "first_strike": dict(self.first_strike),
                     "first_remote": dict(self.first_remote),
+                    "first_proof": dict(self.first_proof),
+                    "proof_refs": {k: list(v) for k, v
+                                   in self.proof_refs.items()},
+                    "local_at_first_proof": dict(
+                        self.local_at_first_proof),
                     "audit_events": {k: dict(v) for k, v
                                      in self.audit_events.items()},
+                    "repairs": (self.repair_plane.snapshot()
+                                if self.repair_plane is not None
+                                else {}),
                     "peer_id": self.node.peer_id,
                     "injected": dict(getattr(self.dht, "injected", {}))}
 
@@ -693,33 +853,72 @@ def run_byzantine(args) -> dict:
 
 
 def build_hostile_schedule(seed: int, n_peers: int, epochs: int) -> dict:
-    """Seeded hostile-owner assignment: one ``wrong_gather_part`` and
-    one ``omit_sender`` attacker, distinct peers, active from epoch 0.
+    """Seeded hostile-owner assignment. Gradient phase: one
+    ``wrong_gather_part`` and one ``omit_sender`` attacker, distinct
+    peers, active from epoch 0 (the r14 shape). Aux phases (r16): the
+    same two hostile peers each also attack one auxiliary averaging
+    phase — the ``omit`` peer serves wrong PowerSGD-factor parts
+    (phase "powersgd", round suffix "p"), the ``wrong`` peer serves
+    wrong state-averaging parts (phase "state") — each paired with a
+    deterministic honest PARTNER that joins those per-phase rounds,
+    audits them, and publishes the proof-carrying receipt every other
+    honest peer convicts from with no local corroboration.
     Deterministic in the seed, recorded in the report."""
     rng = random.Random(seed ^ 0xA0D17)
     wrong, omit = rng.sample(range(n_peers), 2)
-    return {"seed": seed, "epochs": epochs,
-            "attacks": [
-                {"peer": wrong, "kind": "wrong_gather_part",
-                 "factor": 10.0, "start_epoch": 0},
-                {"peer": omit, "kind": "omit_sender", "factor": 1.0,
-                 "start_epoch": 0}]}
+    honest = [i for i in range(n_peers) if i not in (wrong, omit)]
+    attacks = [
+        {"peer": wrong, "kind": "wrong_gather_part",
+         "factor": 10.0, "start_epoch": 0, "phase": "grads"},
+        {"peer": omit, "kind": "omit_sender", "factor": 1.0,
+         "start_epoch": 0, "phase": "grads"}]
+    aux = {}
+    if honest:
+        # aux pairs need an honest partner each; a 2-peer roster (both
+        # peers attackers) keeps the pre-r16 grads-only schedule
+        h = random.Random(seed ^ 0x9E16)
+        if len(honest) >= 2:
+            psgd_partner, state_partner = h.sample(honest, 2)
+        else:
+            psgd_partner = state_partner = honest[0]
+        attacks += [
+            {"peer": omit, "kind": "wrong_gather_part",
+             "factor": 10.0, "start_epoch": 0, "phase": "powersgd"},
+            {"peer": wrong, "kind": "wrong_gather_part",
+             "factor": 10.0, "start_epoch": 0, "phase": "state"}]
+        aux = {"p": {"attacker": omit, "partner": psgd_partner,
+                     "phase": "powersgd"},
+               "state": {"attacker": wrong, "partner": state_partner,
+                         "phase": "state"}}
+    return {"seed": seed, "epochs": epochs, "attacks": attacks,
+            "aux": aux}
 
 
 def _hostile_pass(args, schedule: dict, attacks_on: bool,
                   audits_on: bool, violations: List[str],
-                  tag: str) -> List[Dict]:
+                  tag: str, repair_on: bool = False,
+                  aux_on: bool = False) -> List[Dict]:
     """One full swarm run of the hostile-owner schedule. Every peer
     arms screen + clamp + gossip; ``audits_on`` additionally arms the
     verified-aggregation layer (frac=1.0 — every part challenged every
-    round). Liveness violations land in ``violations``."""
+    round); ``repair_on`` arms the round-repair plane (pre-step
+    corrections); ``aux_on`` runs the per-phase auxiliary rounds (the
+    PowerSGD-factor stand-in + state averaging) for the schedule's
+    attacker/partner pairs. Liveness violations land in
+    ``violations``."""
     prefix = f"ho{args.seed}{tag}"
     by_peer = {}
     if attacks_on:
         for a in schedule["attacks"]:
             by_peer.setdefault(a["peer"], []).append(ByzantineOp(
                 kind=a["kind"], factor=a["factor"],
-                start_epoch=a["start_epoch"]))
+                start_epoch=a["start_epoch"],
+                phase=a.get("phase")))
+    aux_by_peer: Dict[int, List[str]] = {}
+    if aux_on:
+        for suffix, pair in schedule.get("aux", {}).items():
+            aux_by_peer.setdefault(pair["attacker"], []).append(suffix)
+            aux_by_peer.setdefault(pair["partner"], []).append(suffix)
     policy = AuditPolicy(frac=1.0, ttl=max(60.0, 4 * args.deadline
                                            / max(1, args.epochs)),
                          fetch_timeout=2.0, fetch_retries=3) \
@@ -740,7 +939,9 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
                  screen=GradientScreen(ScreenPolicy()),
                  max_peer_weight=100.0, gossip=True,
                  audit_policy=policy,
-                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef)
+                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef,
+                 repair=repair_on and audits_on,
+                 aux_rounds=aux_by_peer.get(i))
         for i, node in enumerate(nodes)]
     for p in peers:
         p.start()
@@ -757,8 +958,10 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
         r = p.result(killed=False)
         r["attacker"] = i in attacker_idx
         r["attack_kind"] = next(
-            (a["kind"] for a in schedule["attacks"] if a["peer"] == i),
+            (a["kind"] for a in schedule["attacks"] if a["peer"] == i
+             and a.get("phase") in (None, "grads")),
             None) if attacks_on else None
+        r["aux_rounds"] = aux_by_peer.get(i, [])
         results.append(r)
         if r["final_epoch"] < args.epochs:
             violations.append(
@@ -768,12 +971,31 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
 
 
 def run_hostile(args) -> dict:
-    """The hostile-owner gate: control pass (audits ON, attacks off —
-    the false-positive AND bit-exactness oracle), attack pass (one
-    wrong_gather_part + one omit_sender owner), and a transparency
-    pass (audits OFF, attacks off — the pre-audit byte-identity pin),
-    all over one seeded schedule. See the module docstring for the
-    oracles."""
+    """The hostile-owner + repair gate, FOUR passes over one seeded
+    schedule:
+
+    - **control** (attacks off, audits + repair + aux phases ON) —
+      the false-positive oracle: zero strikes, zero audit verdicts,
+      ZERO repairs, bit-exact convergence (repair-enabled honest
+      rounds are byte-identical to the r15 rounds);
+    - **attack** (audits + repair + aux ON) — conviction oracles as
+      r14 (wrong-part owner failed/struck everywhere <= 2 epochs, the
+      omitted victim convicts) PLUS: every honest member that
+      convicted the wrong-part owner REPAIRED (>= 1 repair) and ends
+      bit-exact on the honest-only analytic reference; the wrong-part
+      conviction corroborates via verified PROOF receipts; the two
+      aux-phase owner attacks (PowerSGD factor round, state
+      averaging) are each convicted in every honest ledger via a
+      proof-carrying receipt — peers outside those rounds hold ZERO
+      local evidence at proof time (conviction with no local
+      corroboration);
+    - **nofix** (attacks on, audits ON, repair OFF, aux off) — the
+      r15 reference: detection without correction, so every honest
+      member that gathered a wrong part DIVERGES from the analytic
+      reference (the regression this PR exists to fix, kept as the
+      divergence oracle — repair OFF is byte-identical to r15);
+    - **transparency** (attacks off, audits OFF, repair OFF) — the
+      pre-audit byte-identity pin, unchanged from r14."""
     schedule = build_hostile_schedule(args.seed, args.peers, args.epochs)
     t0 = time.monotonic()
     threads_before = set(threading.enumerate())
@@ -785,9 +1007,9 @@ def run_hostile(args) -> dict:
 
     control = _hostile_pass(args, schedule, attacks_on=False,
                             audits_on=True, violations=violations,
-                            tag="ctl")
+                            tag="ctl", repair_on=True, aux_on=True)
     # -- control oracles: zero strikes (audit false positives included),
-    # audit-enabled honest rounds bit-exact to the r13 reference -------
+    # ZERO repairs, repair-enabled honest rounds bit-exact ---------------
     for r in control:
         if r["first_strike"]:
             violations.append(
@@ -797,14 +1019,19 @@ def run_hostile(args) -> dict:
             violations.append(
                 f"[ctl] {r['name']} recorded audit verdicts on an "
                 f"honest swarm: {r['audit_events']}")
+        if r["repairs"].get("applied", 0) or r["repairs"].get(
+                "submitted", 0):
+            violations.append(
+                f"[ctl] {r['name']} repaired an honest swarm: "
+                f"{r['repairs']}")
         if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
             violations.append(
                 f"[ctl] {r['name']} fingerprint {r['fingerprint']} != "
-                f"analytic {want} — audits changed the bytes")
+                f"analytic {want} — audits/repair changed the bytes")
 
     attack = _hostile_pass(args, schedule, attacks_on=True,
                            audits_on=True, violations=violations,
-                           tag="atk")
+                           tag="atk", repair_on=True, aux_on=True)
     # -- attack oracles ----------------------------------------------------
     by_kind = {r["attack_kind"]: r for r in attack if r["attacker"]}
     wrong_pid = by_kind["wrong_gather_part"]["peer_id"]
@@ -815,11 +1042,26 @@ def run_hostile(args) -> dict:
         violations.append("[atk] wrong_gather_part never fired")
     if not by_kind["omit_sender"]["injected"].get("byz_omit_sender"):
         violations.append("[atk] omit_sender never fired")
-    for r in attack:
+    # the aux-phase owner seams must have fired too (phase-scoped
+    # injected counters) — aux pairs exist whenever the roster has an
+    # honest partner to pair with (build_hostile_schedule)
+    run_aux = bool(schedule["aux"])
+    if run_aux and not by_kind["omit_sender"]["injected"] \
+            .get("byz_wrong_gather_part:powersgd"):
+        violations.append(
+            "[atk] powersgd-phase wrong_gather_part never fired")
+    if run_aux and not by_kind["wrong_gather_part"]["injected"] \
+            .get("byz_wrong_gather_part:state"):
+        violations.append(
+            "[atk] state-phase wrong_gather_part never fired")
+    aux_prefix = {"p": f"ho{args.seed}atk_p",
+                  "state": f"ho{args.seed}atk_state"}
+    for i2, r in enumerate(attack):
         if r["attacker"]:
             continue
         # every honest member's replay audit convicts the wrong-part
-        # owner, locally AND with gossiped-receipt corroboration
+        # owner, locally AND with verified-proof corroboration (the
+        # r13 capped receipts are superseded by proofs here)
         seen = r["audit_events"]["fail"].get(wrong_pid)
         if seen is None or seen > attack_start + 2:
             violations.append(
@@ -830,11 +1072,75 @@ def run_hostile(args) -> dict:
             violations.append(
                 f"[atk] {r['name']} never struck the wrong-part owner "
                 f"within 2 epochs (first: {struck})")
-        remote = r["first_remote"].get(wrong_pid)
-        if remote is None or remote > attack_start + 2:
+        proof = r["first_proof"].get(wrong_pid)
+        if proof is None or proof > attack_start + 2:
             violations.append(
-                f"[atk] {r['name']} has no gossiped receipt against "
-                f"the wrong-part owner within 2 epochs (first: {remote})")
+                f"[atk] {r['name']} holds no verified proof against "
+                f"the wrong-part owner within 2 epochs (first: {proof})")
+        # THE repair oracle: convicted ⇒ corrected — every honest
+        # member repaired at least once and tracks the honest-only
+        # analytic reference bit-exactly (where the nofix pass below
+        # diverges)
+        if not r["repairs"].get("applied", 0):
+            violations.append(
+                f"[atk] {r['name']} convicted the wrong-part owner "
+                f"but applied no repair: {r['repairs']}")
+        if r["final_epoch"] >= args.epochs and r["fingerprint"] != want:
+            violations.append(
+                f"[atk] repaired {r['name']} fingerprint "
+                f"{r['fingerprint']} != analytic {want} — the repair "
+                "did not restore the honest trajectory")
+        # aux-phase convictions arrive as verified proofs naming the
+        # phase prefix in their dedup ref; peers OUTSIDE the pair had
+        # no way to corroborate locally. The pair PARTNER is the
+        # prover: it convicts locally, publishes the proof, and never
+        # folds its own receipt — the refs at every OTHER peer are
+        # what demonstrate its publication
+        for suffix, offender in ((("p", omit_pid), ("state", wrong_pid))
+                                 if run_aux else ()):
+            pair = schedule["aux"][suffix]
+            if i2 == pair["partner"]:
+                continue
+            refs = r["proof_refs"].get(offender, [])
+            if not any(f":{aux_prefix[suffix]}:" in ref
+                       for ref in refs):
+                violations.append(
+                    f"[atk] {r['name']} holds no verified "
+                    f"{suffix}-phase proof against {offender[:16]} "
+                    f"(refs: {refs})")
+    # conviction with NO local corroboration: honest peers outside the
+    # powersgd pair (and not the omit victim) convict the psgd
+    # attacker purely from the verified proof. Incidental TIMEOUT
+    # strikes are legitimate local noise on a loaded box (the aux
+    # attacker runs ~2x the epoch wall, so main rounds time out on it)
+    # — the oracle therefore requires every clean peer to
+    # proof-convict, and AT LEAST ONE to do so while its own local
+    # evidence was still below the conviction threshold (the
+    # pure-proof witness).
+    threshold = 3.0  # PeerHealthLedger.penalty_threshold default
+    if run_aux:
+        aux_participants = {schedule["aux"]["p"]["partner"],
+                            schedule["aux"]["p"]["attacker"]}
+        clean = [r for i2, r in enumerate(attack)
+                 if not r["attacker"] and i2 not in aux_participants
+                 and not r["audit_events"]["omit"].get(omit_pid)]
+        if not clean:
+            violations.append(
+                "[atk] no honest peer outside the powersgd pair to "
+                "run the no-local-corroboration oracle on")
+        witnesses = 0
+        for r in clean:
+            local = r["local_at_first_proof"].get(omit_pid)
+            if local is None:
+                violations.append(
+                    f"[atk] {r['name']} (outside the powersgd pair) "
+                    f"never proof-convicted the psgd attacker")
+            elif local < threshold:
+                witnesses += 1
+        if clean and not witnesses:
+            violations.append(
+                "[atk] every clean peer was already locally convicted "
+                "at proof time — no pure-proof conviction witnessed")
     # the omitted victim (deterministically the lowest-peer-id sender
     # into the omitting owner's part) convicts through the omission
     # audit — only the victim has standing, so the oracle names it
@@ -846,6 +1152,27 @@ def run_hostile(args) -> dict:
         violations.append(
             f"[atk] omitted victim {victim['name']} never convicted "
             f"the omitting owner within 2 epochs (first: {omitted})")
+
+    nofix = _hostile_pass(args, schedule, attacks_on=True,
+                          audits_on=True, violations=violations,
+                          tag="nofx", repair_on=False, aux_on=False)
+    # -- nofix oracles: repair OFF is the r15 protocol — detection
+    # without correction, so a convicted wrong part STAYS in the
+    # state and every honest gatherer diverges from the reference ----
+    for r in nofix:
+        if r["attacker"]:
+            continue
+        if r["repairs"]:
+            violations.append(
+                f"[nofx] {r['name']} has a repair plane with repair "
+                f"off: {r['repairs']}")
+        convicted = r["audit_events"]["fail"].get(wrong_pid) is not None
+        if (convicted and r["final_epoch"] >= args.epochs
+                and r["fingerprint"] == want):
+            violations.append(
+                f"[nofx] {r['name']} matches the analytic reference "
+                "with repair OFF — the divergence this PR repairs "
+                "did not reproduce (oracle broken?)")
 
     transparency = _hostile_pass(args, schedule, attacks_on=False,
                                  audits_on=False,
@@ -874,7 +1201,7 @@ def run_hostile(args) -> dict:
                        "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
-            "control": control, "attack": attack,
+            "control": control, "attack": attack, "nofix": nofix,
             "transparency": transparency,
             "violations": violations, "pass": not violations}
 
@@ -946,17 +1273,19 @@ def main(argv=None) -> int:
     ok = report["pass"]
     if args.hostile_owner:
         print(f"hostile-owner soak: {'PASS' if ok else 'FAIL'} in "
-              f"{report['elapsed_s']}s — {args.peers} peers x 3 passes, "
+              f"{report['elapsed_s']}s — {args.peers} peers x 4 passes, "
               f"attacks="
-              f"{[a['kind'] for a in report['schedule']['attacks']]}")
-        for tag in ("control", "attack", "transparency"):
+              f"{[(a['kind'], a.get('phase')) for a in report['schedule']['attacks']]}")
+        for tag in ("control", "attack", "nofix", "transparency"):
             for r in report[tag]:
                 audits = {k: len(v) for k, v in r["audit_events"].items()
                           if v}
-                print(f"  [{tag[:3]}] {r['name']:>8}: epoch "
+                print(f"  [{tag[:4]}] {r['name']:>8}: epoch "
                       f"{r['final_epoch']} fp={r['fingerprint']} "
                       f"attacker={r.get('attacker', False)} "
                       f"audit_events={audits} "
+                      f"repairs={r['repairs'].get('applied', 0)} "
+                      f"proofs={len(r['proof_refs'])} "
                       f"first_strike={r['first_strike']}")
     elif args.byzantine:
         print(f"byzantine soak: {'PASS' if ok else 'FAIL'} in "

@@ -871,19 +871,34 @@ class TestHostileOwnerSoak:
         b = build_hostile_schedule(seed=4, n_peers=5, epochs=3)
         c = build_hostile_schedule(seed=5, n_peers=5, epochs=3)
         assert a == b and a != c
-        kinds = sorted(x["kind"] for x in a["attacks"])
-        assert kinds == ["omit_sender", "wrong_gather_part"]
-        assert len({x["peer"] for x in a["attacks"]}) == 2
+        grads = [x for x in a["attacks"] if x["phase"] == "grads"]
+        assert sorted(x["kind"] for x in grads) \
+            == ["omit_sender", "wrong_gather_part"]
+        assert len({x["peer"] for x in grads}) == 2
+        # r16: the same two hostile peers each also attack one aux
+        # averaging phase, paired with distinct honest partners
+        aux = a["aux"]
+        assert set(aux) == {"p", "state"}
+        attackers = {x["peer"] for x in grads}
+        for pair in aux.values():
+            assert pair["attacker"] in attackers
+            assert pair["partner"] not in attackers
+        assert aux["p"]["partner"] != aux["state"]["partner"]
+        phases = sorted(x["phase"] for x in a["attacks"])
+        assert phases == ["grads", "grads", "powersgd", "state"]
 
     def test_fast_soak(self, tmp_path):
-        """Tier-1 hostile-owner gate: 5 peers, one wrong_gather_part +
-        one omit_sender owner, control + attack + transparency passes
-        over one schedule. The script's own oracles assert zero
-        control strikes with bit-exact convergence (audit-enabled
-        honest rounds == the r13 reference), swarm-wide conviction of
-        the wrong-part owner within <= 2 epochs with gossiped-receipt
-        corroboration, the omitted victim's conviction, and
-        audits-disabled byte identity."""
+        """Tier-1 hostile-owner + REPAIR gate (the r16 repair soak):
+        5 peers, FOUR passes over one schedule — control (audits +
+        repair + aux phases on: zero strikes, ZERO repairs, bit-exact),
+        attack (wrong-part conviction triggers repair and repaired
+        survivors match the honest-only analytic reference; the
+        PowerSGD-factor and state-averaging owner attacks each convict
+        in every honest ledger via a verified proof-carrying receipt,
+        at peers holding zero local evidence), nofix (repair OFF == the
+        r15 protocol: convicted survivors DIVERGE — the regression
+        repair exists to fix), and transparency (audits off == the
+        pre-audit protocol)."""
         from scripts.churn_soak import main
         out = tmp_path / "HOSTILE_OWNER_SOAK.json"
         rc = main(["--hostile-owner", "--peers", "5", "--epochs", "3",
@@ -894,10 +909,19 @@ class TestHostileOwnerSoak:
         report = json.loads(out.read_text())
         assert report["pass"] is True and report["violations"] == []
         assert all(not r["first_strike"] for r in report["control"])
+        assert all(not r["repairs"].get("applied", 0)
+                   for r in report["control"])
         assert all(not any(r["audit_events"].values())
                    for r in report["transparency"])
         honest = [r for r in report["attack"] if not r["attacker"]]
         assert len(honest) == 3
+        # convicted ⇒ corrected: every honest member repaired
+        assert all(r["repairs"]["applied"] >= 1 for r in honest)
+        # and the nofix pass reproduces the r15 divergence the repair
+        # closes (honest fingerprints differ from the attack pass's)
+        nofix_honest = [r for r in report["nofix"] if not r["attacker"]]
+        assert {r["fingerprint"] for r in nofix_honest} \
+            != {r["fingerprint"] for r in honest}
 
     @pytest.mark.slow
     def test_full_soak(self, tmp_path):

@@ -1,0 +1,558 @@
+"""Round repair (swarm/repair.py) + proof-carrying receipts (r16).
+
+Covers the correction plane (exact pre-step assign vs bounded-staleness
+compensation, flat-layout scatter across leaves, prefix scoping and
+overflow bounds), the byte-bounded retained-round ring, the
+conviction-to-correction path over a real socket round, and the
+proof-receipt plane end to end — including the full rejection taxonomy
+(forged evidence, stale/replayed epochs, transcript–frame mismatch,
+proofs for unchallenged rounds), each rejected WITHOUT ledger effect.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from dalle_tpu.swarm import compression
+from dalle_tpu.swarm.allreduce import CHUNK_ELEMS, run_allreduce
+from dalle_tpu.swarm.audit import (AuditPolicy, AuditWorker,
+                                   ProofVerifier, RoundAudit,
+                                   audit_round, challenged_parts)
+from dalle_tpu.swarm.chaos import (BYZANTINE_PHASES, ByzantineOp,
+                                   ChaosDHT, FaultPlan,
+                                   phase_of_prefix)
+from dalle_tpu.swarm.dht import DHT
+from dalle_tpu.swarm.health import (PROOF_MAX_BYTES, PeerHealthLedger,
+                                    StrikeGossip, make_receipt,
+                                    open_receipt, open_receipt_full)
+from dalle_tpu.swarm.identity import Identity
+from dalle_tpu.swarm.matchmaking import make_group
+from dalle_tpu.swarm.repair import (RepairAction, RepairPlane,
+                                    apply_flat_correction)
+from dalle_tpu.swarm.screening import GradientScreen, ScreenPolicy
+
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PrivateKey
+except ImportError:
+    from dalle_tpu.swarm._fallback_crypto import Ed25519PrivateKey
+
+
+def _action(lo=0, served=None, honest=None, prefix="run_grads",
+            epoch=0, part=0):
+    served = np.asarray(served if served is not None
+                        else [1.0, 2.0, 3.0], np.float32)
+    honest = np.asarray(honest if honest is not None
+                        else [1.0, 1.0, 1.0], np.float32)
+    return RepairAction(prefix=prefix, epoch=epoch, part=part,
+                        owner="ab" * 32, lo=lo, served=served,
+                        honest=honest)
+
+
+class TestApplyFlatCorrection:
+    def test_exact_assign_when_served_bytes_in_place(self):
+        arr = np.asarray([0.0, 1.0, 2.0, 3.0, 9.0], np.float32)
+        a = _action(lo=1, served=[1.0, 2.0, 3.0],
+                    honest=[7.0, 8.0, 9.0])
+        assert apply_flat_correction([arr], a) is True
+        assert arr.tolist() == [0.0, 7.0, 8.0, 9.0, 9.0]
+        # idempotent: honest bytes are no longer the served bytes, so
+        # the second application degrades to += (honest - served) —
+        # callers drain actions exactly once; this pins the predicate
+        assert apply_flat_correction([arr], a) is False
+
+    def test_stale_compensation_adds_the_correction(self):
+        # the window now holds a LATER vector: compensation adds
+        arr = np.asarray([10.0, 20.0, 30.0], np.float32)
+        a = _action(lo=0, served=[1.0, 2.0, 3.0],
+                    honest=[2.0, 4.0, 6.0])
+        assert apply_flat_correction([arr], a) is False
+        assert arr.tolist() == [11.0, 22.0, 33.0]
+
+    def test_scatter_across_leaf_boundaries(self):
+        x = np.zeros((2, 2), np.float32)   # flat [0, 4)
+        y = np.zeros(3, np.float32)        # flat [4, 7)
+        a = _action(lo=3, served=[0.0, 0.0, 0.0],
+                    honest=[5.0, 6.0, 7.0])
+        assert apply_flat_correction([x, y], a) is True
+        assert x.reshape(-1).tolist() == [0.0, 0.0, 0.0, 5.0]
+        assert y.tolist() == [6.0, 7.0, 0.0]
+
+    def test_alien_layout_is_dropped_not_guessed(self):
+        arr = np.zeros(2, np.float32)
+        a = _action(lo=0, served=[0.0, 0.0, 0.0],
+                    honest=[1.0, 1.0, 1.0])  # window overruns target
+        assert apply_flat_correction([arr], a) is None
+        assert arr.tolist() == [0.0, 0.0]  # untouched
+        # and the plane must not count it as a repair (the soak's
+        # convicted => corrected oracle keys on "applied")
+        plane = RepairPlane()
+        plane.submit(a)
+        assert plane.apply([arr]) == 0
+        snap = plane.snapshot()
+        assert snap["applied"] == 0 and snap["dropped_alien"] == 1
+
+
+class TestRepairPlane:
+    def test_submit_drain_and_counters(self):
+        plane = RepairPlane(accept_prefix="run_grads")
+        assert plane.submit(_action()) is True
+        assert plane.submit(_action(prefix="run_state")) is False
+        assert plane.pending() == 1
+        snap = plane.snapshot()
+        assert snap["submitted"] == 1 and snap["skipped_prefix"] == 1
+        target = np.asarray([1.0, 2.0, 3.0], np.float32)
+        assert plane.apply([target]) == 1
+        assert target.tolist() == [1.0, 1.0, 1.0]
+        snap = plane.snapshot()
+        assert snap["applied"] == 1 and snap["applied_exact"] == 1
+        assert snap["pending"] == 0
+
+    def test_stale_landing_counted(self):
+        plane = RepairPlane()
+        plane.submit(_action(served=[1.0, 2.0, 3.0],
+                             honest=[2.0, 3.0, 4.0]))
+        target = np.asarray([5.0, 5.0, 5.0], np.float32)
+        plane.apply([target])
+        assert target.tolist() == [6.0, 6.0, 6.0]
+        snap = plane.snapshot()
+        assert snap["applied_stale"] == 1 and snap["applied_exact"] == 0
+
+    def test_overflow_drops_oldest(self):
+        plane = RepairPlane(max_actions=2)
+        for e in range(3):
+            plane.submit(_action(epoch=e))
+        assert plane.pending() == 2
+        actions = plane.drain()
+        assert [a.epoch for a in actions] == [1, 2]
+        assert plane.snapshot()["dropped_overflow"] == 1
+
+
+class TestRepairRing:
+    @staticmethod
+    def _ra(epoch, nbytes):
+        ra = RoundAudit("ring", epoch)
+        ra.begun = True
+        ra.evidence[0] = b"x" * nbytes
+        return ra
+
+    def test_retained_bytes_counts_all_planes(self):
+        ra = RoundAudit("rb", 0)
+        ra.frames = {1: {0: b"abcd"}}
+        ra.evidence = {2: b"ee"}
+        ra.self_frames = [b"fff"]
+        ra.gathered = {0: np.zeros(4, np.float32)}
+        ra.gather_frames = {0: {0: b"gg"}}
+        assert ra.retained_bytes() == 4 + 2 + 3 + 16 + 2
+
+    def test_byte_bound_evicts_oldest_first(self):
+        w = AuditWorker(None, None, max_bytes=100)
+        for e in range(3):
+            w.submit(self._ra(e, 40))
+        with w._lock:
+            epochs = [r.epoch for r in w._pending]
+        assert epochs == [1, 2]          # epoch 0 evicted by bytes
+        assert w.ring_evictions == 1
+
+    def test_count_bound_still_applies(self):
+        w = AuditWorker(None, None, max_bytes=1 << 30)
+        for e in range(AuditWorker.MAX_PENDING + 2):
+            w.submit(self._ra(e, 1))
+        with w._lock:
+            epochs = [r.epoch for r in w._pending]
+        assert len(epochs) == AuditWorker.MAX_PENDING
+        assert epochs[0] == 2
+        assert w.ring_evictions == 2
+
+    def test_step_releases_bytes(self):
+        w = AuditWorker(None, None, max_bytes=100)
+        ra = self._ra(0, 40)
+        ra.begun = False  # never begun: submit ignores
+        w.submit(ra)
+        with w._lock:
+            assert w._pending_bytes == 0
+
+    def test_single_over_budget_round_does_not_flush_the_ring(self):
+        # one round bigger than the whole budget is admitted WITHOUT
+        # evicting the backlog (flushing it could never make room;
+        # dropping the new round would let a flagship-size part evade
+        # auditing)
+        w = AuditWorker(None, None, max_bytes=100)
+        w.submit(self._ra(0, 40))
+        w.submit(self._ra(1, 40))
+        w.submit(self._ra(2, 500))
+        with w._lock:
+            epochs = [r.epoch for r in w._pending]
+        assert epochs == [0, 1, 2]
+        assert w.ring_evictions == 0
+
+
+class TestPhaseScopedOps:
+    def test_phase_of_prefix(self):
+        assert phase_of_prefix("run_grads") == "grads"
+        assert phase_of_prefix("run_grads_p") == "powersgd"
+        assert phase_of_prefix("run_grads_q") == "powersgd"
+        assert phase_of_prefix("run_state") == "state"
+        assert phase_of_prefix("") == "grads"
+
+    def test_strict_parse_rejects_unknown_phase(self):
+        with pytest.raises(ValueError):
+            ByzantineOp(kind="wrong_gather_part", phase="gradz")
+        plan = FaultPlan.from_dict(
+            {"byzantine": [{"kind": "wrong_gather_part",
+                            "phase": "state"}]})
+        assert plan.byzantine[0].phase == "state"
+        with pytest.raises(ValueError):
+            FaultPlan.from_dict(
+                {"byzantine": [{"kind": "scale", "phaze": "state"}]})
+
+    def test_owner_seam_filters_by_phase(self):
+        stub = types.SimpleNamespace(peer_id="aa" * 32)
+        chaos = ChaosDHT(stub, FaultPlan(byzantine=(
+            ByzantineOp(kind="wrong_gather_part", factor=10.0,
+                        phase="state"),)))
+        v = np.zeros(4, np.float32)
+        out = chaos.tamper_gather_part(0, 0, v, prefix="run_grads")
+        assert out.tolist() == [0.0] * 4     # grads round: inert
+        out = chaos.tamper_gather_part(0, 0, v, prefix="run_state")
+        assert out.tolist() == [10.0] * 4    # state round: fires
+        assert chaos.injected == {"byz_wrong_gather_part:state": 1}
+        # unscoped ops keep the r14 any-phase + bare-counter behavior
+        chaos2 = ChaosDHT(stub, FaultPlan(byzantine=(
+            ByzantineOp(kind="wrong_gather_part", factor=1.0),)))
+        chaos2.tamper_gather_part(0, 0, v, prefix="run_grads_p")
+        assert chaos2.injected == {"byz_wrong_gather_part": 1}
+        assert set(BYZANTINE_PHASES) == {"grads", "powersgd", "state"}
+
+
+# -- live-socket rounds: conviction -> correction + proof evidence ---------
+
+def _det_swarm(n, base=71):
+    nodes = []
+    for i in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([base + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=2.0))
+    return nodes
+
+
+def _run_threads(fns, timeout=60):
+    results = [None] * len(fns)
+    errors = []
+
+    def wrap(i, fn):
+        try:
+            results[i] = fn()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    if errors:
+        raise errors[0]
+    return results
+
+
+@pytest.fixture(scope="module")
+def wrong_owner_round():
+    """One 5-peer socket round with a wrong_gather_part owner, audited
+    at every member — the shared substrate for the repair and proof
+    tests. Yields (nodes, pids, bad_i, outs, ras, ledgers, screen)."""
+    nodes = _det_swarm(5)
+    pids = [nd.peer_id for nd in nodes]
+    bad_i = 2
+    dhts = list(nodes)
+    dhts[bad_i] = ChaosDHT(nodes[bad_i], FaultPlan(
+        seed=3, byzantine=(ByzantineOp(kind="wrong_gather_part",
+                                       factor=10.0),)))
+    screen = GradientScreen(ScreenPolicy())
+    policy = AuditPolicy(frac=1.0, fetch_timeout=2.0)
+    ledgers = [PeerHealthLedger() for _ in range(5)]
+    ras = [RoundAudit("rp", 0, policy) for _ in range(5)]
+    rng = np.random.RandomState(9)
+    base = rng.randint(-8, 9, size=400).astype(np.float32)
+    tensors = [[base + i] for i in range(5)]
+
+    def peer(i):
+        g = make_group(dhts[i], "rp", epoch=0, weight=1.0,
+                       matchmaking_time=2.0, min_group_size=5)
+        assert g is not None and g.size == 5
+        return run_allreduce(
+            dhts[i], g, "rp", 0, tensors[i], weight=1.0,
+            allreduce_timeout=8.0, sender_timeout=1.5,
+            codec=compression.NONE, ledger=ledgers[i], screen=screen,
+            max_peer_weight=100.0, audit=ras[i])
+
+    try:
+        outs = _run_threads([lambda i=i: peer(i) for i in range(5)])
+        yield nodes, pids, bad_i, outs, ras, ledgers, screen, tensors
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+
+
+class TestConvictionRepairs:
+    def test_conviction_queues_the_exact_correction(
+            self, wrong_owner_round):
+        nodes, pids, bad_i, outs, ras, ledgers, screen, tensors = \
+            wrong_owner_round
+        i = 0  # any honest member
+        plane = RepairPlane(accept_prefix="rp")
+        ledger = PeerHealthLedger()
+        rep = audit_round(nodes[i], ras[i], ledger, repair=plane)
+        bad_part = next(k for k, m in enumerate(ras[i].owners)
+                        if m.peer_id == pids[bad_i])
+        assert [f["part"] for f in rep["failed"]] == [bad_part]
+        assert rep["failed"][0]["why"] == "replayed-bytes-mismatch"
+        assert rep["failed"][0].get("repaired") is True
+        assert plane.pending() == 1
+        # applying the correction onto the member's averaged output
+        # restores the honest-only analytic average BIT-EXACTLY: the
+        # served bytes are still in place, so the repair ASSIGNS the
+        # replayed honest bytes over them
+        out = [np.array(a, np.float32, copy=True) for a in outs[i]]
+        assert plane.apply(out) == 1
+        assert plane.snapshot()["applied_exact"] == 1
+        honest = np.mean([t[0] for t in tensors], axis=0,
+                         dtype=np.float32).astype(np.float32)
+        lo = ras[i].part_lo(bad_part)
+        hi = lo + ras[i].part_sizes[bad_part]
+        assert out[0].reshape(-1)[lo:hi].tobytes() \
+            == honest[lo:hi].tobytes()
+
+    def test_unrepairable_conviction_classes_stay_detection_only(self):
+        # a transcript that is ITSELF the lie yields no honest
+        # reconstruction: audit_one returns no values, so nothing is
+        # submitted — replay-fail classes keep r15 semantics. Pinned
+        # at the plane level: only replayed-bytes-mismatch entries
+        # carry "repaired".
+        plane = RepairPlane(accept_prefix="nope")
+        assert plane.pending() == 0
+        assert plane.apply([np.zeros(3, np.float32)]) == 0
+
+
+def _evidence_from(ras, ledgers, nodes, i, bad_i, pids):
+    """Run the audit at member ``i`` and pop its proof-carrying event."""
+    ledger = PeerHealthLedger()
+    audit_round(nodes[i], ras[i], ledger)
+    events = ledger.drain_events()
+    assert len(events) == 1
+    epoch, peer, reason, evidence = events[0]
+    assert peer == pids[bad_i] and reason == "owner-audit-fail"
+    assert evidence is not None
+    return epoch, peer, reason, evidence
+
+
+def _verifier(screen, **kw):
+    args = dict(frac=1.0, chunk_elems=CHUNK_ELEMS,
+                codec=compression.NONE, screen=screen,
+                max_peer_weight=100.0)
+    args.update(kw)
+    return ProofVerifier("rp", **args)
+
+
+class TestProofReceipts:
+    def test_verified_proof_convicts_without_local_evidence(
+            self, wrong_owner_round):
+        nodes, pids, bad_i, outs, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 0, bad_i, pids)
+        v = _verifier(screen)
+        assert v(evidence, peer, epoch) == "rp"
+        assert v.verified == 1
+        # fold through a third party's gossip: an outsider that never
+        # joined the round convicts purely from the proof
+        issuer = Identity.generate()
+        receipt = make_receipt(issuer, "rp", peer, reason, epoch,
+                               proof=evidence)
+        outsider = PeerHealthLedger()
+        gossip = StrikeGossip(
+            types.SimpleNamespace(
+                peer_id="cc" * 32, identity=Identity.generate(),
+                get=lambda key, latest=True: {
+                    "s1": types.SimpleNamespace(value=receipt)}),
+            outsider, "rp", verifier=_verifier(screen))
+        assert gossip.fold_once() == 1
+        assert gossip.proofs_convicted == 1
+        assert outsider.local_score(peer) == 0.0
+        assert outsider.penalized(peer) is True
+        refs = outsider.proof_convictions(peer)
+        assert len(refs) == 1 and all(":rp:" in r for r in refs)
+        # replayed receipt: idempotent (the _seen mark dedups), and a
+        # re-wrapped copy by ANOTHER issuer dedups at the proven ref
+        assert gossip.fold_once() == 0
+        receipt2 = make_receipt(Identity.generate(), "rp", peer,
+                                reason, epoch, proof=evidence)
+        gossip.dht.get = lambda key, latest=True: {
+            "s2": types.SimpleNamespace(value=receipt2)}
+        gossip.fold_once()
+        assert len(outsider.proof_convictions(peer)) == 1
+
+    def test_plain_receipt_keeps_capped_influence(
+            self, wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        peer = pids[bad_i]
+        receipt = make_receipt(Identity.generate(), "rp", peer,
+                               "owner-audit-fail", 0)  # no proof
+        led = PeerHealthLedger()
+        gossip = StrikeGossip(
+            types.SimpleNamespace(
+                peer_id="cc" * 32, identity=Identity.generate(),
+                get=lambda key, latest=True: {
+                    "s": types.SimpleNamespace(value=receipt)}),
+            led, "rp", verifier=_verifier(screen))
+        gossip.fold_once()
+        # r13 semantics: an accusation without proof never convicts
+        assert led.score(peer) <= led.max_remote_influence
+        assert led.penalized(peer) is False
+        assert not led.proof_convictions(peer)
+
+    # -- the rejection taxonomy: each rejected WITHOUT ledger effect ----
+
+    def _fold_one(self, screen, receipt, verifier=None):
+        led = PeerHealthLedger()
+        gossip = StrikeGossip(
+            types.SimpleNamespace(
+                peer_id="cc" * 32, identity=Identity.generate(),
+                get=lambda key, latest=True: {
+                    "s": types.SimpleNamespace(value=receipt)}),
+            led, "rp", verifier=verifier or _verifier(screen))
+        gossip.fold_once()
+        return led, gossip
+
+    def test_forged_evidence_rejected(self, wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 1, bad_i, pids)
+        import msgpack
+        obj = msgpack.unpackb(evidence, raw=False)
+        # flip one byte inside the owner-signed transcript
+        tr = bytearray(obj["transcript"])
+        tr[len(tr) // 2] ^= 0x40
+        obj["transcript"] = bytes(tr)
+        forged = msgpack.packb(obj, use_bin_type=True)
+        receipt = make_receipt(Identity.generate(), "rp", peer,
+                               reason, epoch, proof=forged)
+        led, gossip = self._fold_one(screen, receipt)
+        assert gossip.proofs_rejected == 1
+        assert led.snapshot() == {}  # no ledger effect at all
+
+    def test_stale_replayed_epoch_rejected(self, wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 3, bad_i, pids)
+        # old evidence re-wrapped under a far-future receipt epoch:
+        # the replay attack that would re-convict forever
+        receipt = make_receipt(
+            Identity.generate(), "rp", peer, reason,
+            epoch + ProofVerifier.EPOCH_SLACK + 5, proof=evidence)
+        led, gossip = self._fold_one(screen, receipt)
+        assert gossip.proofs_rejected == 1
+        assert led.snapshot() == {}
+
+    def test_transcript_frame_mismatch_rejected(self,
+                                                wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 4, bad_i, pids)
+        import msgpack
+        obj = msgpack.unpackb(evidence, raw=False)
+        # pair the accused owner's transcript with gather frames from
+        # a DIFFERENT (honest) part: every frame is validly signed,
+        # but by the wrong owner — the contradiction is fabricated
+        honest_part = next(
+            p for p, m in enumerate(ras[4].owners)
+            if m.peer_id != pids[bad_i] and p in ras[4].gather_frames)
+        frames = ras[4].gather_frames[honest_part]
+        obj["frames"] = [frames[ci] for ci in sorted(frames)]
+        mixed = msgpack.packb(obj, use_bin_type=True)
+        receipt = make_receipt(Identity.generate(), "rp", peer,
+                               reason, epoch, proof=mixed)
+        led, gossip = self._fold_one(screen, receipt)
+        assert gossip.proofs_rejected == 1
+        assert led.snapshot() == {}
+
+    def test_unchallenged_round_rejected(self, wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 0, bad_i, pids)
+        # a verifier whose challenge set never named this part: the
+        # owner owed nobody a transcript, so a "proof" about it is a
+        # fabrication attempt by construction
+        v = _verifier(screen, frac=0.0)
+        assert v(evidence, peer, epoch) is None
+        receipt = make_receipt(Identity.generate(), "rp", peer,
+                               reason, epoch, proof=evidence)
+        led, gossip = self._fold_one(screen, receipt, verifier=v)
+        assert gossip.proofs_rejected == 1
+        assert led.snapshot() == {}
+
+    def test_wrong_accused_and_foreign_prefix_rejected(
+            self, wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 1, bad_i, pids)
+        v = _verifier(screen)
+        honest_pid = next(p for p in pids if p != peer)
+        assert v(evidence, honest_pid, epoch) is None  # not the owner
+        v2 = ProofVerifier("otherrun", frac=1.0,
+                           chunk_elems=CHUNK_ELEMS,
+                           codec=compression.NONE, screen=screen,
+                           max_peer_weight=100.0)
+        assert v2(evidence, peer, epoch) is None  # foreign round
+
+    def test_oversized_proof_never_parses(self):
+        ident = Identity.generate()
+        big = b"z" * (PROOF_MAX_BYTES + 1)
+        raw = make_receipt(ident, "rp", "cd" * 32,
+                           "owner-audit-fail", 1, proof=big)
+        assert open_receipt_full(raw, "rp") is None
+
+    def test_proof_receipt_readable_by_r13_open(self,
+                                                wrong_owner_round):
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 3, bad_i, pids)
+        ident = Identity.generate()
+        raw = make_receipt(ident, "rp", peer, reason, epoch,
+                           proof=evidence)
+        opened = open_receipt(raw, "rp")
+        assert opened is not None and opened[1] == peer
+
+    def test_proven_conviction_decays_with_the_window(self):
+        led = PeerHealthLedger(ttl_epochs=3)
+        assert led.proven_strike("cd" * 32, "owner-audit-fail", 0,
+                                 ref="r1") is True
+        assert led.penalized("cd" * 32) is True
+        led.advance_epoch(10)
+        assert led.penalized("cd" * 32) is False
+        assert not led.proof_convictions("cd" * 32)
+        # aged-out evidence is rejected on arrival too
+        assert led.proven_strike("cd" * 32, "owner-audit-fail", 0,
+                                 ref="r2") is False
+
+
+class TestChallengeUnchanged:
+    def test_challenge_is_prefix_scoped(self):
+        # per-phase prefixes get independent challenge sets — the aux
+        # phases' audits never collide with the gradient rounds'
+        a = challenged_parts("run_grads", 5, 64, 0.3)
+        b = challenged_parts("run_grads_p", 5, 64, 0.3)
+        c = challenged_parts("run_state", 5, 64, 0.3)
+        assert a != b or b != c

@@ -558,6 +558,62 @@ class Auditor(threading.Thread):
             self.join(timeout=join_timeout)
 """,
     ),
+    # Round-repair + proof-receipt shapes (swarm/repair.py +
+    # audit.ProofVerifier via health.StrikeGossip): corrections fan out
+    # through pools in plausible refactors, and the evidence replay
+    # runs on the gossip worker against the native DHT — pin the
+    # hazardous variant of each shape so the real code can never
+    # regress into them unnoticed.
+    (
+        "unchecked-pool-future",
+        "dalle_tpu/swarm/fake_repair.py",
+        """
+import concurrent.futures
+def apply_corrections(plane, targets, patch):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(patch, t, a)
+                for t, a in zip(targets, plane.drain())]
+        concurrent.futures.wait(futs)   # a repair that FAILED to land
+        # (the whole point of the plane) vanishes in an unread Future
+""",
+        """
+import concurrent.futures
+def apply_corrections(plane, targets, patch):
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(patch, t, a)
+                for t, a in zip(targets, plane.drain())]
+        return sum(1 for f in futs if f.result())   # every landing read
+""",
+    ),
+    (
+        "thread-daemon-join",
+        "dalle_tpu/swarm/fake_proof_worker.py",
+        """
+import threading
+class ProofFolder(threading.Thread):
+    def __init__(self, dht, verifier):
+        super().__init__()           # non-daemon, and stop() below
+        self.dht = dht               # never joins: an in-flight
+        self.verifier = verifier     # evidence replay races the
+        self._stop = threading.Event()   # native DHT teardown
+    def stop(self):
+        self._stop.set()
+""",
+        """
+import threading
+class ProofFolder(threading.Thread):
+    def __init__(self, dht, verifier):
+        super().__init__(daemon=True, name="proof-folder")
+        self.dht = dht
+        self.verifier = verifier
+        self._stop = threading.Event()
+    def stop(self, join_timeout=10.0):
+        self._stop.set()
+        if join_timeout is not None and self.is_alive() \\
+                and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
+""",
+    ),
     (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
