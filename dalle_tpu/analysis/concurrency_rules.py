@@ -29,6 +29,15 @@ _BLOCKING_CALLS = {
     "requests.head", "requests.request",
     "urllib.request.urlopen",
 }
+#: direct file/OS write calls — blocking I/O wherever they appear
+_IO_CALLS = {"open", "io.open", "os.open", "os.write", "os.fsync",
+             "os.fdatasync"}
+#: constructors whose bound name is a file/socket handle for the
+#: attribute-call half of blocking-io-under-lock
+_IO_HANDLE_CTORS = {"socket.socket", "socket.create_connection"}
+#: attribute calls that block when the receiver is a file/socket handle
+_IO_ATTR_CALLS = {"write", "writelines", "flush", "sendall", "send",
+                  "recv", "fsync"}
 
 
 # -- silent-except --------------------------------------------------------
@@ -291,6 +300,156 @@ def mixed_lock_writes(ctx: FileContext) -> Iterable[Finding]:
                     f"{cls.name} but written here without it — every "
                     "write to a lock-guarded attribute must hold the "
                     "lock"))
+    return [f for f in out if f is not None]
+
+
+# -- blocking-io-under-lock -----------------------------------------------
+
+def _lock_bound_names(tree: ast.AST) -> Set[str]:
+    """Every dotted name assigned from a threading.Lock/RLock/Condition
+    constructor anywhere in the file — ``self._lock``, ``self._cv``,
+    module-level ``_LOCK``, function-local ``lk``. Whole-file by
+    design: a lock attribute initialized in ``__init__`` must be
+    recognized inside every method that takes it."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee and callee.split(".")[-1] in _LOCK_CTORS:
+                for t in node.targets:
+                    d = dotted_name(t)
+                    if d is not None:
+                        out.add(d)
+    return out
+
+
+def _io_handle_names(fn: ast.AST) -> Set[str]:
+    """Names bound from ``open(...)`` / socket constructors inside this
+    function (plain assignment or ``with ... as f``) — the receivers
+    whose ``.write()``/``.sendall()`` the lock rule treats as I/O."""
+    out: Set[str] = set()
+
+    def is_io_ctor(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        callee = dotted_name(value.func) or ""
+        return callee in _IO_CALLS or callee in _IO_HANDLE_CTORS
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and is_io_ctor(node.value):
+            for t in node.targets:
+                d = dotted_name(t)
+                if d is not None:
+                    out.add(d)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if is_io_ctor(item.context_expr) \
+                        and item.optional_vars is not None:
+                    d = dotted_name(item.optional_vars)
+                    if d is not None:
+                        out.add(d)
+    return out
+
+
+def _blocking_io_callee(node: ast.Call,
+                        handles: Set[str]) -> Optional[str]:
+    """The offending callee name iff this call is blocking I/O: a known
+    blocking/module call (time.sleep, subprocess, sync HTTP), a direct
+    file open/OS write, or a write-ish attribute call on a handle bound
+    from open()/socket() in the same function."""
+    callee = dotted_name(node.func)
+    if callee is not None and (
+            callee in _BLOCKING_CALLS or callee in _IO_CALLS
+            or callee.startswith("subprocess.")):
+        return callee
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _IO_ATTR_CALLS:
+        recv = dotted_name(node.func.value)
+        if recv is not None and recv in handles:
+            return f"{recv}.{node.func.attr}"
+    return None
+
+
+@rule(
+    "blocking-io-under-lock", "concurrency",
+    "File/socket write, open(), time.sleep or another blocking call"
+    " while holding a threading lock: every other thread contending for"
+    " that lock stalls for the I/O's duration — on the engine/metrics"
+    " locks that is the whole serving loop, on the swarm locks a round."
+    " The exact shape a hot-path JSONL sink invites: encode and buffer"
+    " under the lock if you must, swap the buffer out, and WRITE outside"
+    " it (obs/trace.py flush() is the idiom).", severity="warning")
+def blocking_io_under_lock(ctx: FileContext) -> Iterable[Finding]:
+    lock_names = _lock_bound_names(ctx.tree)
+    if not lock_names:
+        return []
+    out: List[Optional[Finding]] = []
+
+    def body_calls(node: ast.AST):
+        """Calls in ``node``, NOT descending into nested function/
+        lambda definitions: a def nested under a lock runs at its
+        call site, which may hold nothing."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from body_calls(child)
+
+    def scan(stmt: ast.stmt, in_lock: bool, handles: Set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested def: its body runs at call time, not here
+        if isinstance(stmt, ast.With):
+            # items enter left to right, so in the single-header form
+            # `with self._lock, open(p) as f:` the open() runs WITH the
+            # lock held — track lock acquisition item by item and check
+            # every context expr evaluated after one (or under an outer
+            # lock): the header's own open() is the blocking call
+            locked_now = in_lock
+            for item in stmt.items:
+                if locked_now and isinstance(item.context_expr,
+                                             ast.Call):
+                    callee = _blocking_io_callee(item.context_expr,
+                                                 handles)
+                    if callee is not None:
+                        out.append(ctx.finding(
+                            "blocking-io-under-lock",
+                            item.context_expr,
+                            f"{callee}() while a lock is held — "
+                            "move the I/O outside the lock"))
+                if (dotted_name(item.context_expr) or "") in lock_names:
+                    locked_now = True
+            for s in stmt.body:
+                scan(s, locked_now, handles)
+            return
+        if in_lock:
+            for call in body_calls(stmt):
+                callee = _blocking_io_callee(call, handles)
+                if callee is not None:
+                    out.append(ctx.finding(
+                        "blocking-io-under-lock", call,
+                        f"{callee}() while a lock is held — every "
+                        "thread contending for the lock stalls for "
+                        "the I/O; swap data out under the lock and "
+                        "write outside it"))
+            # compound statements still carry nested With-lock blocks
+        for field in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, field, None) or []:
+                if not in_lock:
+                    scan(s, in_lock, handles)
+        for handler in getattr(stmt, "handlers", None) or []:
+            for s in handler.body:
+                if not in_lock:
+                    scan(s, in_lock, handles)
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        handles = _io_handle_names(fn)
+        for stmt in fn.body:
+            scan(stmt, False, handles)
     return [f for f in out if f is not None]
 
 

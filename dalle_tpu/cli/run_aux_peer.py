@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "(reference pulls every 2, arguments.py:150)")
     parser.add_argument("--metrics-file", type=str, default=None,
                         help="append one JSON line per refresh round")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve the swarm-wide aggregate as "
+                             "Prometheus text on this port's /metrics "
+                             "(dalle_tpu/obs exposition; 0 = ephemeral)")
     parser.add_argument("--archive-remote", type=str, default=None,
                         help="also upload each archived checkpoint to this "
                              "destination: a directory / file:// URL, a "
@@ -68,11 +72,24 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+_ROBUST_SUM_FIELDS = (
+    "parts_audited", "audit_convictions", "repairs_applied",
+    "repair_ring_evictions", "ef_lost_rounds", "proofs_published",
+    "proofs_convicted", "proofs_rejected")
+
+
 def aggregate(metrics):
-    """Swarm-wide stats from per-peer reports (run_aux_peer.py:119-144)."""
+    """Swarm-wide stats from per-peer reports (run_aux_peer.py:119-144).
+
+    The robustness counters (r16) are cumulative per peer, so the
+    swarm-wide view is their sum over every live record — including the
+    proof-plane counters (proofs published / convicted / rejected),
+    which ``robustness_snapshot()`` computed locally since r16 but
+    which only reach the DHT now that ``LocalMetrics`` carries them."""
     if not metrics:
         return {"alive_peers": 0, "epoch": -1, "sum_sps": 0.0,
-                "mean_loss": None, "sum_mini_steps": 0}
+                "mean_loss": None, "sum_mini_steps": 0,
+                **{f: 0 for f in _ROBUST_SUM_FIELDS}}
     epoch = max(m.epoch for m in metrics)
     current = [m for m in metrics if m.epoch == epoch]
     return {
@@ -81,6 +98,8 @@ def aggregate(metrics):
         "sum_sps": sum(m.samples_per_second for m in metrics),
         "mean_loss": sum(m.loss for m in current) / len(current),
         "sum_mini_steps": sum(m.mini_steps for m in current),
+        **{f: sum(getattr(m, f) for m in metrics)
+           for f in _ROBUST_SUM_FIELDS},
     }
 
 
@@ -147,6 +166,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     wandb_run = maybe_wandb_run(args.wandb_project,
                                 f"aux-{peer.experiment_prefix}")
 
+    # /metrics exposition (dalle_tpu/obs): the aux peer is the swarm's
+    # natural scrape target — it already aggregates every trainer's
+    # signed record each refresh round; the registry source reads the
+    # latest aggregate, so a scrape never blocks on the DHT
+    latest_stats: dict = {}
+    metrics_server = metrics_thread = None
+    if args.metrics_port is not None:
+        from dalle_tpu.obs.exposition import (MetricsRegistry,
+                                              aggregate_source,
+                                              start_metrics_server)
+        registry = MetricsRegistry()
+        registry.register("aux", aggregate_source(lambda: latest_stats))
+        metrics_server, metrics_thread = start_metrics_server(
+            registry, port=args.metrics_port)
+        logger.info("serving Prometheus /metrics on port %d",
+                    metrics_server.server_address[1])
+
     last_archived = -1
     rounds = 0
     assistant = None
@@ -164,6 +200,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 time.sleep(aux.refresh_period)
                 stats = aggregate(fetch_metrics(
                     task.dht, peer.experiment_prefix))
+                latest_stats = stats
                 logger.info(
                     "round %d: epoch=%s alive=%d sum_sps=%.1f mean_loss=%s",
                     rounds, stats["epoch"], stats["alive_peers"],
@@ -206,6 +243,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             uploader.close()
         if wandb_run is not None:
             wandb_run.finish()
+        if metrics_server is not None:
+            metrics_server.shutdown()
+            metrics_server.server_close()
+            metrics_thread.join(timeout=5)
     return 0
 
 

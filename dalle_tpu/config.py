@@ -446,6 +446,17 @@ class CollabConfig:
     # default) leaves the transport untouched; every swarm entry point
     # exposes it as --chaos-plan.
     chaos_plan: Optional[str] = None
+    # Flight recorder (dalle_tpu/obs, OBSERVABILITY.md): append this
+    # peer's round-lifecycle spans (matchmaking → allreduce phases →
+    # apply → state averaging, plus state-transfer streams) as JSONL
+    # rows whose trace ids are protocol ids — merge files from
+    # several peers with scripts/trace_report.py for the cross-peer
+    # round timeline. None (the default) records nothing and the
+    # round paths stay byte-identical to the uninstrumented protocol.
+    trace_file: Optional[str] = None
+    # Byte cap on the in-memory flight ring behind the tracer (the
+    # last-N-rounds dump a failure artifact wants).
+    trace_ring_kb: int = 256
 
 
 @dataclass(frozen=True)
@@ -553,6 +564,13 @@ class ServingConfig:
     http_port: int = 8080
     # Seconds between metrics JSONL snapshot rows (0 disables).
     metrics_interval_s: float = 5.0
+    # Flight recorder (dalle_tpu/obs, OBSERVABILITY.md): append the
+    # engine's request-lifecycle spans (submit → admit → first_code →
+    # harvest → pixels → complete, trace id = the request id) plus
+    # chunk-cadence spans as JSONL. None (the default) records
+    # nothing; the engine loop pays one `is None` test.
+    trace_file: Optional[str] = None
+    trace_ring_kb: int = 256
 
     def validate(self) -> None:
         if self.n_slots < 1:

@@ -1,0 +1,17 @@
+"""Observability substrate: span tracing, flight recorder, exposition.
+
+Zero-dependency (stdlib only) and free when disabled — see
+``obs/trace.py`` for the span/ring layer, ``obs/exposition.py`` for
+the unified Prometheus registry, and OBSERVABILITY.md for the span
+schema, trace-id correlation rules, and the /metrics name inventory.
+"""
+
+from dalle_tpu.obs.trace import (BUCKETS_S, NULL_SPAN,  # noqa: F401
+                                 Tracer, configure, default_tracer,
+                                 load_jsonl, merge_rows, span)
+from dalle_tpu.obs.exposition import (CONTENT_TYPE,  # noqa: F401
+                                      MetricsRegistry,
+                                      aggregate_source, parse_text,
+                                      serving_source,
+                                      start_metrics_server,
+                                      swarm_source, tracer_source)

@@ -320,9 +320,23 @@ class DecodeEngine:
                  sampling: SamplingConfig = SamplingConfig(),
                  pixel_pipeline=None,
                  metrics: Optional[ServingMetrics] = None,
-                 chaos: Optional[ServeChaos] = None):
+                 chaos: Optional[ServeChaos] = None,
+                 tracer=None):
         serving = serving or ServingConfig()
         serving.validate()
+        # Flight recorder (dalle_tpu/obs, OBSERVABILITY.md): request-
+        # lifecycle events (submit → admit → first_code → harvest →
+        # pixels → complete; trace id = the request id) plus chunk-
+        # cadence spans. None (the default, unless the config names a
+        # trace_file) records nothing — every seam below pays one
+        # `is None` test, so the recorder-off loop is the r9 loop
+        # byte-for-byte (transparency pinned by tests/test_obs.py).
+        self._tracer = tracer
+        if self._tracer is None and getattr(serving, "trace_file", None):
+            from dalle_tpu.obs.trace import Tracer
+            self._tracer = Tracer(
+                peer="engine", sink_path=serving.trace_file,
+                ring_bytes=getattr(serving, "trace_ring_kb", 256) * 1024)
         self._params = params
         self._cfg = cfg
         self._serving = serving
@@ -352,6 +366,7 @@ class DecodeEngine:
             # submit/admit and complete/fail must share one ledger
             pixel_pipeline.bind_metrics(self.metrics)
             pixel_pipeline.bind_chaos(self._chaos)
+            pixel_pipeline.bind_tracer(self._tracer)
         self._state = EngineState(
             cache=init_cache(cfg, s),
             pos=jnp.full((s,), total, jnp.int32),
@@ -479,7 +494,18 @@ class DecodeEngine:
                     2 * len(self._handles))
             self._handles[rid] = handle
             self.metrics.record_submit(rid, lane)
+            # timestamp INSIDE the lock (one clock read), record
+            # outside it: the engine thread can admit the moment
+            # notify() lands, and the per-peer t0 order submit < admit
+            # is the timeline contract
+            t_submit = (time.monotonic() if self._tracer is not None
+                        else 0.0)
             self._cv.notify()
+        if self._tracer is not None:
+            # outside _cv: the recorder must never extend the queue
+            # lock's hold time (and never nest under it)
+            self._tracer.add("serving", "submit", f"req:{rid}",
+                             t_submit, 0.0, lane=lane)
         return handle
 
     def _predict_completion_locked(self, lane: str) -> Optional[float]:
@@ -613,6 +639,13 @@ class DecodeEngine:
         return self._chaos
 
     @property
+    def tracer(self):
+        """The engine's flight recorder (None when tracing is off) —
+        the front-end's /metrics exposition reads phase histograms
+        through here."""
+        return self._tracer
+
+    @property
     def alive(self) -> bool:
         """Liveness: the engine can still make progress — its thread is
         running, or it has not been started yet. False once the loop
@@ -696,6 +729,9 @@ class DecodeEngine:
             self._pos_host[slot] = 0
             if not pending.synthetic:
                 self.metrics.record_admit(pending.rid)
+                if self._tracer is not None:
+                    self._tracer.event("serving", "admit",
+                                       f"req:{pending.rid}", slot=slot)
 
     def _after_chunk(self, live_slots: List[int], queue_depth: int,
                      mirror_current: bool = False) -> List[int]:
@@ -717,6 +753,9 @@ class DecodeEngine:
                     and self._pos_host[slot] > text_len:
                 pending.first_code_seen = True
                 self.metrics.record_first_code(pending.rid)
+                if self._tracer is not None and not pending.synthetic:
+                    self._tracer.event("serving", "first_code",
+                                       f"req:{pending.rid}")
             if self._pos_host[slot] >= total:
                 finished.append(slot)
         return finished
@@ -733,6 +772,9 @@ class DecodeEngine:
                 # decode service sample for the shed predictor (host
                 # clocks only — the admit timestamp is already local)
                 self.metrics.note_service(pending.rid)
+                if self._tracer is not None:
+                    self._tracer.event("serving", "harvest",
+                                       f"req:{pending.rid}", slot=slot)
             # slice BEFORE clearing the slot: if the slice dispatch
             # raises, the pending is still reachable from _slots for
             # the crash-path cancel sweep (first-claim-wins dedupes the
@@ -781,6 +823,9 @@ class DecodeEngine:
                 {"codes": codes,
                  **self.metrics.record_complete(pending.rid,
                                                 deadline_ok=deadline_ok)})
+            if self._tracer is not None:
+                self._tracer.event("serving", "complete",
+                                   f"req:{pending.rid}")
         else:
             logger.debug("request %d resolved elsewhere before "
                          "harvest landed", pending.rid)
@@ -1009,8 +1054,20 @@ class DecodeEngine:
             # the device computes while the host turns rows into
             # responses — one chunk always in flight, zero blocking
             # syncs on this path
-            self._state = _chunk_fn(self._cfg, self._chunk, visible)(
-                self._params, self._state)
+            if self._tracer is None:
+                self._state = _chunk_fn(self._cfg, self._chunk, visible)(
+                    self._params, self._state)
+            else:
+                # the span measures the DISPATCH wall (the loop is
+                # zero-sync; device wall shows up as backpressure on a
+                # later dispatch) — the host-cadence number the r9
+                # bench tracks
+                t_chunk = time.monotonic()
+                self._state = _chunk_fn(self._cfg, self._chunk, visible)(
+                    self._params, self._state)
+                self._tracer.add("serving", "chunk", "engine", t_chunk,
+                                 time.monotonic() - t_chunk,
+                                 live=len(live_slots), visible=visible)
             self._drain_harvests()
             if sync:
                 # r8-style: block on the pull BEFORE any bookkeeping, so
@@ -1023,6 +1080,10 @@ class DecodeEngine:
             if sync:
                 self._drain_harvests()
             self.metrics.maybe_flush()
+            if self._tracer is not None:
+                self._tracer.maybe_flush()
         # loop exited with completions possibly still in flight (their
         # decode DID finish) — land them before the cancel sweep
         self._drain_harvests()
+        if self._tracer is not None:
+            self._tracer.flush()

@@ -66,6 +66,7 @@ class PixelPipeline:
         self._degraded_fn = degraded_fn
         self._metrics = metrics
         self._chaos = chaos
+        self._tracer = None
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._thread = threading.Thread(target=self._run,
                                         name="pixel-worker", daemon=True)
@@ -83,6 +84,13 @@ class PixelPipeline:
         process — DecodeEngine calls this, mirroring bind_metrics)."""
         if self._chaos is None:
             self._chaos = chaos
+
+    def bind_tracer(self, tracer) -> None:
+        """Adopt the engine's flight recorder (obs/trace.py) so the
+        pixel stage's spans land in the same per-request timeline as
+        the engine's admit/harvest events. None = tracing off."""
+        if self._tracer is None:
+            self._tracer = tracer
 
     def submit(self, handle, rid: int, codes: np.ndarray,
                degraded: bool = False,
@@ -129,7 +137,14 @@ class PixelPipeline:
             try:
                 if self._chaos is not None:
                     self._chaos.on_pixel(rid)
-                extra = fn(codes)
+                if self._tracer is None:
+                    extra = fn(codes)
+                else:
+                    t0 = time.monotonic()
+                    extra = fn(codes)
+                    self._tracer.add("serving", "pixels", f"req:{rid}",
+                                     t0, time.monotonic() - t0,
+                                     degraded=degraded)
             except Exception as e:  # noqa: BLE001 - a pixel-stage
                 # failure (ChaosInjectedError included) must fail THAT
                 # request, never kill the worker the engine relies on
@@ -148,3 +163,5 @@ class PixelPipeline:
                                                  deadline_ok=deadline_ok)
                    if self._metrics else {})
             handle._deliver({"codes": codes, **extra, **row})
+            if self._tracer is not None:
+                self._tracer.event("serving", "complete", f"req:{rid}")

@@ -15,6 +15,9 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
   reranks) with its TTFT / latency / queue-wait accounting.
 - ``GET /stats``  — the metrics snapshot + live queue depth (per lane),
   shed / brownout / cancel counters and goodput.
+- ``GET /metrics`` — the same ledger as Prometheus text format, plus
+  span-derived per-phase latency histograms when the flight recorder
+  is on (``dalle_tpu/obs``, OBSERVABILITY.md names every metric).
 - ``GET /healthz`` — LIVENESS only: is the engine thread able to make
   progress. Flips false on a crashed/stopped engine so an orchestrator
   restarts the pod; it says nothing about load.
@@ -66,11 +69,26 @@ class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True   # connection threads must not block exit
 
     def __init__(self, address, engine, tokenizer=None,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0, registry=None):
         super().__init__(address, _Handler)
         self.engine = engine
         self.tokenizer = tokenizer
         self.request_timeout_s = request_timeout_s
+        # /metrics: the unified Prometheus exposition (dalle_tpu/obs,
+        # OBSERVABILITY.md). The default registry unifies the serving
+        # ledger (the SAME snapshot /stats serves — the two endpoints
+        # agree by construction) with the engine's span-derived phase
+        # histograms when tracing is on. Callers may pass their own
+        # registry to add sources (e.g. a co-tenant trainer's).
+        if registry is None:
+            from dalle_tpu.obs.exposition import (MetricsRegistry,
+                                                  serving_source,
+                                                  tracer_source)
+            registry = MetricsRegistry()
+            registry.register("serving", serving_source(engine))
+            if getattr(engine, "tracer", None) is not None:
+                registry.register("trace", tracer_source(engine.tracer))
+        self.registry = registry
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -116,6 +134,12 @@ class _Handler(BaseHTTPRequestHandler):
             })
         elif self.path == "/stats":
             self._reply(200, engine.stats())
+        elif self.path == "/metrics":
+            # Prometheus text exposition (obs/exposition.py): the
+            # serving ledger + span-derived phase histograms, scrapable
+            # by anything that speaks the text format
+            from dalle_tpu.obs.exposition import write_metrics_response
+            write_metrics_response(self, self.server.registry)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -31,8 +31,11 @@ class LocalMetrics(pydantic.BaseModel, extra="forbid"):
     .robustness_snapshot()``: audited parts, audit convictions
     (fail + omit verdicts), repairs applied by the round-repair plane,
     repair-ring byte-bound evictions, and the r15 error-feedback
-    lost-residual windows. They default to 0 so pre-r16 records stay
-    valid."""
+    lost-residual windows. The r16 proof-plane counters (proof-carrying
+    receipts published / convicted-from / rejected by this peer's
+    verifier) ride too — ``robustness_snapshot()`` always computed
+    them, but they never reached the DHT before. Every counter
+    defaults to 0 so pre-r16 records stay valid."""
 
     peer_id: str
     epoch: int
@@ -45,6 +48,9 @@ class LocalMetrics(pydantic.BaseModel, extra="forbid"):
     repairs_applied: int = 0
     repair_ring_evictions: int = 0
     ef_lost_rounds: int = 0
+    proofs_published: int = 0
+    proofs_convicted: int = 0
+    proofs_rejected: int = 0
 
 
 def metrics_key(experiment_prefix: str) -> str:

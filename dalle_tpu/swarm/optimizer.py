@@ -136,6 +136,20 @@ class CollaborativeOptimizer:
         # membership the way the reference's HF authorizer gates the swarm
         # (huggingface_auth.py:46-193, wired at task.py:95-99).
         self.authorizer = authorizer
+        # Flight recorder (dalle_tpu/obs, OBSERVABILITY.md): the round
+        # lifecycle's existing timing seams become spans whose trace id
+        # is the PROTOCOL round id ({run_id}:grads:{epoch}), so several
+        # peers' JSONL files merge into one cross-peer round timeline
+        # with no clock sync. None (the default) records nothing and
+        # every round path stays byte-identical — each seam pays one
+        # `is None` test (transparency pinned by tests/test_obs.py).
+        self.tracer = None
+        if getattr(cfg, "trace_file", None):
+            from dalle_tpu.obs.trace import Tracer
+            self.tracer = Tracer(
+                peer=(dht.peer_id[:12] if dht is not None else "local"),
+                sink_path=cfg.trace_file,
+                ring_bytes=getattr(cfg, "trace_ring_kb", 256) * 1024)
         self.local_epoch = 0
         self.local_samples = 0
         # Multi-host slices (parallel/multihost.py): exactly one process —
@@ -334,7 +348,8 @@ class CollaborativeOptimizer:
                     codec=self._state_codec,
                     adaptive_threshold=cfg.size_adaptive_threshold,
                     epoch_fn=lambda: self.local_epoch,
-                    stream_timeout=cfg.averaging_timeout).start()
+                    stream_timeout=cfg.averaging_timeout,
+                    tracer=self.tracer).start()
             else:
                 # the snapshot runs on a server thread that cannot join
                 # the cross-process all-gather a sharded state needs;
@@ -491,6 +506,32 @@ class CollaborativeOptimizer:
         return RoundAudit(f"{self.cfg.run_id}_{phase_suffix}", epoch,
                           self._audit_policy)
 
+    def _round_trace(self, epoch: int) -> str:
+        """The PROTOCOL round id (shared by every member of the round)
+        — the cross-peer correlation key for this epoch's spans."""
+        return f"{self.cfg.run_id}:grads:{epoch}"
+
+    def _trace_allreduce(self, trace: str, t_start: float, t_end: float,
+                         rep: Optional[dict], group_size: int) -> None:
+        """Convert a completed exchange's measured walls into spans —
+        the allreduce envelope plus the wire report's per-protocol-phase
+        walls (``report["phases"]``), re-timing nothing. Sub-phase start
+        times are chained estimates (the report records durations in
+        protocol order); the durations are the measurements."""
+        tr = self.tracer
+        if tr is None:
+            return
+        attrs = {"group": group_size}
+        if rep is not None and "complete" in rep:
+            attrs["complete"] = bool(rep["complete"])
+        tr.add("swarm", "allreduce", trace, t_start, t_end - t_start,
+               **attrs)
+        t = t_start
+        for name, dur in ((rep or {}).get("phases") or {}).items():
+            phase = "ar_" + (name[:-2] if name.endswith("_s") else name)
+            tr.add("swarm", phase, trace, t, dur)
+            t += dur
+
     def _launch_round(self) -> None:
         """Hand the gradient accumulator to a background wire thread and
         start a fresh buffer; the epoch advances when the round's result
@@ -524,6 +565,11 @@ class CollaborativeOptimizer:
                 encrypt=self.cfg.encrypt_data_plane, ledger=self.ledger)
             t_match = time.monotonic()
             pending.timings["matchmaking_s"] = round(t_match - t0, 4)
+            if self.tracer is not None:
+                self.tracer.add(
+                    "swarm", "matchmaking", self._round_trace(
+                        pending.epoch), t0, t_match - t0,
+                    group=group.size if group is not None else 1)
             if group is not None and group.size > 1:
                 budget = min(self.cfg.allreduce_timeout,
                              max(1.0, self.cfg.averaging_timeout
@@ -554,6 +600,10 @@ class CollaborativeOptimizer:
                     pending.timings["grad_pull_s"] = round(
                         time.monotonic() - t_pull, 4)
                     ra = self._new_round_audit(pending.epoch)
+                    # the report dict is write-only wire telemetry;
+                    # requested only when the tracer consumes it so the
+                    # recorder-off call is literally the historic one
+                    rep = {} if self.tracer is not None else None
                     averaged = run_allreduce(
                         self.dht, group, f"{self.cfg.run_id}_grads",
                         pending.epoch, grads_local, weight=pending.weight,
@@ -565,9 +615,12 @@ class CollaborativeOptimizer:
                         audit=ra, gather_codec=self._gather_codec,
                         ef_scatter=self._ef_scatter,
                         ef_gather=self._ef_gather,
-                        pin_codec=self._pin_codec)
+                        pin_codec=self._pin_codec, report=rep)
                     if ra is not None:
                         self._auditor.submit(ra)
+                    self._trace_allreduce(
+                        self._round_trace(pending.epoch), t_match,
+                        time.monotonic(), rep, group.size)
                 pending.result = averaged
                 pending.timings["allreduce_s"] = round(
                     time.monotonic() - t_match, 4)
@@ -703,6 +756,11 @@ class CollaborativeOptimizer:
             client_mode=self.client_mode, authorizer=self.authorizer,
             encrypt=self.cfg.encrypt_data_plane, ledger=self.ledger)
         t_match = time.monotonic()
+        if self.tracer is not None:
+            self.tracer.add(
+                "swarm", "matchmaking", self._round_trace(
+                    self.local_epoch), t_pull, t_match - t_pull,
+                group=group.size if group is not None else 1)
         exchanging = group is not None and group.size > 1
         mode = (self._X_POWERSGD if self._powersgd is not None else
                 self._X_ALLREDUCE) if exchanging else self._X_ALONE
@@ -732,6 +790,8 @@ class CollaborativeOptimizer:
                     epoch=self.local_epoch)
             else:
                 ra = self._new_round_audit(self.local_epoch)
+                rep = {} if self.tracer is not None else None
+                t_ar = time.monotonic()
                 averaged = run_allreduce(
                     self.dht, group, f"{self.cfg.run_id}_grads",
                     self.local_epoch, grads_local, weight=weight,
@@ -743,9 +803,12 @@ class CollaborativeOptimizer:
                     audit=ra, gather_codec=self._gather_codec,
                     ef_scatter=self._ef_scatter,
                     ef_gather=self._ef_gather,
-                    pin_codec=self._pin_codec)
+                    pin_codec=self._pin_codec, report=rep)
                 if ra is not None:
                     self._auditor.submit(ra)
+                self._trace_allreduce(
+                    self._round_trace(self.local_epoch), t_ar,
+                    time.monotonic(), rep, group.size)
         else:
             # alone this epoch: with a deferred pull the grads never left
             # the device — they flow straight into the jitted apply
@@ -904,6 +967,7 @@ class CollaborativeOptimizer:
         jax.block_until_ready(jax.tree_util.tree_leaves(self.state.params)[0])
         t_applied = time.monotonic()
 
+        epoch0 = self.local_epoch
         self.local_epoch += 1
         if not preserve_accumulator:
             self.local_samples = 0
@@ -918,6 +982,14 @@ class CollaborativeOptimizer:
             "apply_s": round(t_applied - t0, 4),
             "state_avg_s": round(time.monotonic() - t_applied, 4),
         }
+        if self.tracer is not None:
+            trace = self._round_trace(epoch0)
+            self.tracer.add("swarm", "apply", trace, t0,
+                            self._apply_timings["apply_s"])
+            if self._apply_timings["state_avg_s"] > 0:
+                self.tracer.add("swarm", "state_avg", trace, t_applied,
+                                self._apply_timings["state_avg_s"])
+            self.tracer.maybe_flush()
 
         for cb in self.on_after_global_step:
             cb()
@@ -1093,7 +1165,8 @@ class CollaborativeOptimizer:
         if self.role.swarm_enabled:
             result = load_state_from_peers(
                 self.dht, self.cfg.run_id, min_epoch=min_epoch,
-                timeout=timeout or self.cfg.averaging_timeout)
+                timeout=timeout or self.cfg.averaging_timeout,
+                tracer=self.tracer)
             if result is None:
                 logger.warning("load_state_from_peers: nobody answered")
             else:
@@ -1157,6 +1230,9 @@ class CollaborativeOptimizer:
             # a destroyed native node is a use-after-free
             self._auditor.stop()
             self._auditor = None
+        if self.tracer is not None:
+            # the trace from a crashed run is the artifact you want most
+            self.tracer.flush()
 
     def __enter__(self) -> "CollaborativeOptimizer":
         return self

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from dalle_tpu.obs.trace import span as obs_span
 from dalle_tpu.swarm import compression
 from dalle_tpu.swarm.dht import DHT, get_dht_time
 from dalle_tpu.swarm.identity import Identity, open_frame, signed_frame
@@ -173,10 +174,16 @@ class StateServer:
                  compression.SIZE_ADAPTIVE_THRESHOLD,
                  max_concurrent_streams: int = 2,
                  epoch_fn: Optional[Callable[[], int]] = None,
-                 stream_timeout: float = 60.0):
+                 stream_timeout: float = 60.0,
+                 tracer=None):
         self.dht = dht
         self.prefix = prefix
         self.provider = provider
+        # flight recorder (dalle_tpu/obs): each served stream is one
+        # span under the request's nonce-derived trace id — the SAME id
+        # the requesting peer's state_fetch span carries, so the two
+        # sides of a transfer correlate across peers with no clock sync
+        self.tracer = tracer
         # wall budget for ONE outbound state stream (floored at
         # _FRAME_BUDGET_S per frame so huge states stay servable);
         # per-frame send timeouts are derived from what remains of it,
@@ -262,15 +269,20 @@ class StateServer:
     def _stream(self, reply_addr: str, nonce: bytes,
                 req_kx: bytes = b"") -> None:
         try:
-            epoch, arrays = self.provider()
-            blob = serialize_state(epoch, arrays, self.codec,
-                                   self.adaptive_threshold)
-            if reply_addr:
-                self._send_chunks(reply_addr, nonce, blob, req_kx)
-            else:
-                # client-mode requester (no listener): park the chunks in
-                # this server's mailbox for the requester to pull
-                self._post_chunks(nonce, blob, req_kx)
+            with obs_span(self.tracer, "swarm", "state_serve",
+                      _xfer_trace(self.prefix, nonce),
+                      to=reply_addr or "<mailbox>") as sp:
+                epoch, arrays = self.provider()
+                blob = serialize_state(epoch, arrays, self.codec,
+                                       self.adaptive_threshold)
+                sp.set(epoch=epoch, bytes=len(blob))
+                if reply_addr:
+                    self._send_chunks(reply_addr, nonce, blob, req_kx)
+                else:
+                    # client-mode requester (no listener): park the
+                    # chunks in this server's mailbox for the requester
+                    # to pull
+                    self._post_chunks(nonce, blob, req_kx)
         except Exception:  # noqa: BLE001 - peer vanished mid-stream
             # the requester retries another server; this side still says
             # which download died so operators can correlate
@@ -321,6 +333,13 @@ class StateServer:
                 return
 
 
+def _xfer_trace(prefix: str, nonce: bytes) -> str:
+    """The protocol trace id of one state-transfer stream: derived from
+    the request nonce, so the requester's ``state_fetch`` span and the
+    server's ``state_serve`` span share it across peers."""
+    return f"{prefix}:xfer:{nonce.hex()[:12]}"
+
+
 def _advertised_servers(dht: DHT, prefix: str
                         ) -> List[Tuple[int, str, str]]:
     """Live (advertised_epoch, addr, peer_id) records, freshest first."""
@@ -340,7 +359,8 @@ def _advertised_servers(dht: DHT, prefix: str
 
 def load_state_from_peers(dht: DHT, prefix: str,
                           min_epoch: int = 0,
-                          timeout: float = 60.0
+                          timeout: float = 60.0,
+                          tracer=None
                           ) -> Optional[Tuple[int, List[np.ndarray]]]:
     """Download (epoch, arrays) from the freshest advertised state server.
 
@@ -413,35 +433,49 @@ def load_state_from_peers(dht: DHT, prefix: str,
             req = msgpack.packb({"addr": reply_addr, "nonce": nonce,
                                  "kx": dht.kx.public_bytes},
                                 use_bin_type=True)
-            if not dht.send(addr, _req_tag(prefix, pid), req,
-                            timeout=min(10.0, remaining)):
-                fail_counts[pid] = fail_counts.get(pid, 0) + 1
-                continue
-            if not reply_addr:
-                blob = _pull_chunks(dht, prefix, addr, nonce,
-                                    deadline, pid, stall_timeout=stall)
-            else:
-                blob = _collect_chunks(dht, _rsp_tag(prefix, nonce),
-                                       deadline, prefix, nonce,
-                                       pid, stall_timeout=stall)
-            if blob is None:
-                fail_counts[pid] = fail_counts.get(pid, 0) + 1
-                logger.info(
-                    "state stream from %s failed/stalled mid-transfer: "
-                    "trying a different server", pid[:16])
-                continue
-            try:
-                result = deserialize_state(blob)
-            except Exception:  # noqa: BLE001 - corrupt stream
-                fail_counts[pid] = fail_counts.get(pid, 0) + 1
-                logger.warning("corrupt state stream from %s (advertised "
-                               "epoch %d): trying the next server", pid,
-                               advertised, exc_info=True)
-                continue
-            if result[0] >= min_epoch:
-                return result
-            if best is None or result[0] > best[0]:
-                best = result
+            # flight recorder: one span per download ATTEMPT under the
+            # nonce-derived trace id the server's state_serve span
+            # shares (obs/trace.py; ``continue``/``return`` both close
+            # the span normally)
+            with obs_span(tracer, "swarm", "state_fetch",
+                      _xfer_trace(prefix, nonce), server=pid[:16],
+                      advertised=advertised) as sp:
+                if not dht.send(addr, _req_tag(prefix, pid), req,
+                                timeout=min(10.0, remaining)):
+                    fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                    sp.set(ok=False, why="request-send")
+                    continue
+                if not reply_addr:
+                    blob = _pull_chunks(dht, prefix, addr, nonce,
+                                        deadline, pid,
+                                        stall_timeout=stall)
+                else:
+                    blob = _collect_chunks(dht, _rsp_tag(prefix, nonce),
+                                           deadline, prefix, nonce,
+                                           pid, stall_timeout=stall)
+                if blob is None:
+                    fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                    sp.set(ok=False, why="stream")
+                    logger.info(
+                        "state stream from %s failed/stalled "
+                        "mid-transfer: trying a different server",
+                        pid[:16])
+                    continue
+                try:
+                    result = deserialize_state(blob)
+                except Exception:  # noqa: BLE001 - corrupt stream
+                    fail_counts[pid] = fail_counts.get(pid, 0) + 1
+                    sp.set(ok=False, why="corrupt")
+                    logger.warning(
+                        "corrupt state stream from %s (advertised "
+                        "epoch %d): trying the next server", pid,
+                        advertised, exc_info=True)
+                    continue
+                sp.set(ok=True, bytes=len(blob), epoch=result[0])
+                if result[0] >= min_epoch:
+                    return result
+                if best is None or result[0] > best[0]:
+                    best = result
         if best is not None and not any(
                 adv >= min_epoch and fail_counts.get(pid, 0) == 0
                 for adv, _a, pid in servers):

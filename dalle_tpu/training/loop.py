@@ -222,7 +222,10 @@ def train_loop(task: TrainingTask,
                                                + robust["audit_omit"]),
                             repairs_applied=robust["repairs_applied"],
                             repair_ring_evictions=robust["ring_evictions"],
-                            ef_lost_rounds=robust["ef_lost_rounds"]),
+                            ef_lost_rounds=robust["ef_lost_rounds"],
+                            proofs_published=robust["proofs_published"],
+                            proofs_convicted=robust["proofs_convicted"],
+                            proofs_rejected=robust["proofs_rejected"]),
                         expiration=task.collab_cfg.metrics_expiration)
                 logger.info(
                     "epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",

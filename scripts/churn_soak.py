@@ -129,6 +129,8 @@ sys.path.insert(0, _REPO)
 
 import numpy as np  # noqa: E402
 
+from dalle_tpu.obs.trace import (Tracer, merge_rows,  # noqa: E402
+                                 span as obs_span)
 from dalle_tpu.swarm import DHT, Identity  # noqa: E402
 from dalle_tpu.swarm import compression  # noqa: E402
 from dalle_tpu.swarm.allreduce import run_allreduce  # noqa: E402
@@ -243,9 +245,19 @@ class SoakPeer:
                  wire_codec: int = compression.NONE,
                  ef: bool = False,
                  repair: bool = False,
-                 aux_rounds: Optional[List[str]] = None):
+                 aux_rounds: Optional[List[str]] = None,
+                 inject_fault: bool = False):
         self.name = name
         self.node = node
+        # flight recorder (dalle_tpu/obs): every peer records its round
+        # phases under the SHARED protocol round id ({prefix}:{epoch}),
+        # so the harness can merge all peers' rings into one cross-peer
+        # timeline — and dump the last rounds when an oracle goes red
+        self.tracer = Tracer(peer=name, ring_bytes=128 * 1024)
+        # --inject-oracle-failure: corrupt this peer's FINAL apply so
+        # the convergence oracle fires deterministically (the failure-
+        # dump path's test fixture, never set in a real soak)
+        self.inject_fault = inject_fault
         self.dht = ChaosDHT(node, plan) if plan.enabled else node
         self.prefix = prefix
         self.target = target_epochs
@@ -359,27 +371,39 @@ class SoakPeer:
                 grads = grads_for_epoch(self.epoch,
                                         full_scale=self.full_scale)
                 averaged = grads
+                trace = f"{self.prefix}:{self.epoch}"
                 ra = (RoundAudit(self.prefix, self.epoch,
                                  self.audit_policy)
                       if self.audit_policy is not None else None)
                 try:
+                    t_mm = time.monotonic()
                     g = make_group(self.dht, self.prefix,
                                    epoch=self.epoch, weight=1.0,
                                    matchmaking_time=self.mt,
                                    min_group_size=1, ledger=self.ledger)
+                    self.tracer.add(
+                        "swarm", "matchmaking", trace, t_mm,
+                        time.monotonic() - t_mm,
+                        group=g.size if g is not None else 1)
                     if g is not None and g.size > 1:
-                        out = run_allreduce(
-                            self.dht, g, self.prefix, self.epoch,
-                            [grads], weight=1.0,
-                            allreduce_timeout=self.at,
-                            sender_timeout=min(2.0, self.at / 3),
-                            codec=self.wire_codec, ledger=self.ledger,
-                            screen=self.screen,
-                            max_peer_weight=self.max_peer_weight,
-                            audit=ra, ef_scatter=self.ef_scatter,
-                            ef_gather=self.ef_gather,
-                            pin_codec=self.wire_codec
-                            != compression.NONE)
+                        # the span closes on the exception path too —
+                        # a failed round's phase is IN the timeline
+                        # (attrs carry the exception class)
+                        with obs_span(self.tracer, "swarm", "allreduce",
+                                      trace, group=g.size):
+                            out = run_allreduce(
+                                self.dht, g, self.prefix, self.epoch,
+                                [grads], weight=1.0,
+                                allreduce_timeout=self.at,
+                                sender_timeout=min(2.0, self.at / 3),
+                                codec=self.wire_codec,
+                                ledger=self.ledger,
+                                screen=self.screen,
+                                max_peer_weight=self.max_peer_weight,
+                                audit=ra, ef_scatter=self.ef_scatter,
+                                ef_gather=self.ef_gather,
+                                pin_codec=self.wire_codec
+                                != compression.NONE)
                         averaged = out[0]
                 except Exception as e:  # noqa: BLE001 - degraded epoch
                     # a failed round is an ALONE-equivalent epoch (the
@@ -388,8 +412,10 @@ class SoakPeer:
                     averaged = grads
                 if ra is not None and ra.begun:
                     try:
-                        rep = audit_round(self.dht, ra, self.ledger,
-                                          repair=self.repair_plane)
+                        with obs_span(self.tracer, "swarm", "audit",
+                                      trace):
+                            rep = audit_round(self.dht, ra, self.ledger,
+                                              repair=self.repair_plane)
                         for cls, key in (("failed", "fail"),
                                          ("omitted", "omit"),
                                          ("unserved", "unserved")):
@@ -419,7 +445,9 @@ class SoakPeer:
                 self.ledger.advance_epoch(self.epoch)
                 if self.gossip is not None:
                     try:
-                        self.gossip.step()
+                        with obs_span(self.tracer, "swarm", "gossip",
+                                      trace):
+                            self.gossip.step()
                     except Exception as e:  # noqa: BLE001 - degraded
                         self.errors.append(
                             f"gossip at epoch {self.epoch}: {e!r}")
@@ -429,9 +457,19 @@ class SoakPeer:
                             and self.ledger.remote_score(pid) > 0):
                         self.first_remote[pid] = self.epoch
                 self._track_proofs()
-                with self.lock:
-                    self.state = self.state + averaged
-                    self.epoch += 1
+                if self.inject_fault and self.epoch == self.target - 1:
+                    # forced oracle failure: corrupt the final apply so
+                    # the convergence fingerprint diverges; the event
+                    # names this peer and the poisoned phase — exactly
+                    # what the flight dump must surface
+                    averaged = averaged + 977.0
+                    self.tracer.event("swarm", "fault_injected", trace,
+                                      kind="corrupt_apply",
+                                      target_phase="apply")
+                with obs_span(self.tracer, "swarm", "apply", trace):
+                    with self.lock:
+                        self.state = self.state + averaged
+                        self.epoch += 1
                 self.epoch_log.append(self.epoch)
             # post-target gossip linger: the aux pairs run ~2x the
             # per-epoch wall, so their proof receipts can publish
@@ -554,7 +592,51 @@ class SoakPeer:
                                 if self.repair_plane is not None
                                 else {}),
                     "peer_id": self.node.peer_id,
-                    "injected": dict(getattr(self.dht, "injected", {}))}
+                    "injected": dict(getattr(self.dht, "injected", {})),
+                    # flight-ring excerpt (last rounds) — collected by
+                    # the harness for SOAK_FLIGHT.json, stripped from
+                    # the persisted report either way
+                    "_spans": self.tracer.last_rounds(4)}
+
+
+def _collect_flight_spans(results: List[Dict]) -> List[dict]:
+    """Pop every result row's flight-ring excerpt and merge them into
+    one cross-peer timeline (the spans never ride the report JSON —
+    they go to the SOAK_FLIGHT.json artifact instead)."""
+    return merge_rows([r.pop("_spans", []) for r in results])
+
+
+def _emit_flight_dump(out_path: str, mode: str, seed: int,
+                      violations: List[str],
+                      span_rows: List[dict]) -> Optional[str]:
+    """On any oracle violation, dump the merged last-rounds timeline as
+    SOAK_FLIGHT.json next to the report — the artifact that answers
+    "which phase of which round on which peer" instead of just exit 1."""
+    if not violations:
+        return None
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(out_path)) or ".",
+        "SOAK_FLIGHT.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"mode": mode, "seed": seed,
+                   "violations": violations,
+                   "traces": sorted({r["trace"] for r in span_rows}),
+                   "timeline": span_rows}, fh, indent=1)
+        fh.write("\n")
+    print(f"oracle failure: flight dump -> {path}")
+    return path
+
+
+def _emit_timeline(out_path: str, peers: List[SoakPeer]) -> str:
+    """Always-on artifact: every peer's FULL span ring merged into one
+    cross-peer timeline JSONL (`scripts/trace_report.py` consumes it)."""
+    path = os.path.splitext(os.path.abspath(out_path))[0] \
+        + "_TRACE.jsonl"
+    rows = merge_rows([p.tracer.dump() for p in peers])
+    with open(path, "w", encoding="utf-8") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+    return path
 
 
 def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
@@ -632,7 +714,10 @@ def run_soak(args) -> dict:
                               deadline=deadline,
                               matchmaking_time=args.matchmaking_time,
                               allreduce_timeout=args.allreduce_timeout,
-                              wire_codec=wire_codec, ef=args.ef))
+                              wire_codec=wire_codec, ef=args.ef,
+                              inject_fault=(i == 0 and getattr(
+                                  args, "inject_oracle_failure",
+                                  False))))
     for p in peers:
         p.start()
 
@@ -702,6 +787,15 @@ def run_soak(args) -> dict:
     if leaked:
         violations.append(f"leaked threads: {leaked}")
 
+    # -- flight recorder artifacts ----------------------------------------
+    # the merged cross-peer timeline ALWAYS lands next to the report
+    # (trace_report.py consumes it); an oracle failure additionally
+    # dumps the last rounds as SOAK_FLIGHT.json
+    trace_path = _emit_timeline(args.out, all_peers)
+    flight_path = _emit_flight_dump(
+        args.out, "churn", args.seed, violations,
+        _collect_flight_spans(results))
+
     return {"seed": args.seed,
             "params": {"peers": args.peers, "epochs": args.epochs,
                        "kills": args.kills, "joins": args.joins,
@@ -710,6 +804,7 @@ def run_soak(args) -> dict:
                        "deadline": args.deadline,
                        "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule, "elapsed_s": elapsed,
+            "artifacts": {"trace": trace_path, "flight": flight_path},
             "peers": results, "violations": violations,
             "pass": not violations}
 
@@ -840,6 +935,10 @@ def run_byzantine(args) -> dict:
     if leaked:
         violations.append(f"leaked threads: {leaked}")
 
+    flight_path = _emit_flight_dump(
+        args.out, "byzantine", args.seed, violations,
+        _collect_flight_spans(control + attack))
+
     return {"mode": "byzantine", "seed": args.seed,
             "params": {"peers": args.peers, "epochs": args.epochs,
                        "matchmaking_time": args.matchmaking_time,
@@ -848,6 +947,7 @@ def run_byzantine(args) -> dict:
                        "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
+            "artifacts": {"flight": flight_path},
             "control": control, "attack": attack,
             "violations": violations, "pass": not violations}
 
@@ -1193,6 +1293,10 @@ def run_hostile(args) -> dict:
     if leaked:
         violations.append(f"leaked threads: {leaked}")
 
+    flight_path = _emit_flight_dump(
+        args.out, "hostile-owner", args.seed, violations,
+        _collect_flight_spans(control + attack + nofix + transparency))
+
     return {"mode": "hostile-owner", "seed": args.seed,
             "params": {"peers": args.peers, "epochs": args.epochs,
                        "matchmaking_time": args.matchmaking_time,
@@ -1201,6 +1305,7 @@ def run_hostile(args) -> dict:
                        "wire_bits": args.wire_bits, "ef": args.ef},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
+            "artifacts": {"flight": flight_path},
             "control": control, "attack": attack, "nofix": nofix,
             "transparency": transparency,
             "violations": violations, "pass": not violations}
@@ -1249,6 +1354,11 @@ def main(argv=None) -> int:
                              "legs (default ON — the r15 gates run "
                              "with EF armed; requires --wire-bits 8/4)")
     parser.add_argument("--no-ef", dest="ef", action="store_false")
+    parser.add_argument("--inject-oracle-failure", action="store_true",
+                        help="TESTING the failure-dump path: peer0 "
+                             "corrupts its final apply so the "
+                             "convergence oracle fires and the run "
+                             "emits SOAK_FLIGHT.json (churn mode only)")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args(argv)
     if args.hostile_owner and args.byzantine:

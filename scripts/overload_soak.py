@@ -56,6 +56,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from dalle_tpu.config import ServingConfig, tiny_model_config  # noqa: E402
+from dalle_tpu.obs.trace import Tracer  # noqa: E402
 from dalle_tpu.models.dalle import DALLE, init_params  # noqa: E402
 from dalle_tpu.models.decode import (SamplingConfig,  # noqa: E402
                                      generate_images, resolve_buckets)
@@ -211,9 +212,14 @@ def run_soak(args) -> dict:
     chaos = ServeChaos(plan)
     pipeline = PixelPipeline(pixel_fn, metrics=metrics,
                              degraded_fn=degraded_fn, chaos=chaos)
+    # flight recorder (dalle_tpu/obs): the engine records every
+    # request's lifecycle (submit → admit → first_code → harvest →
+    # pixels → complete) in a byte-capped ring; an oracle failure dumps
+    # it as SOAK_FLIGHT.json instead of just exit 1
+    tracer = Tracer(peer="server", ring_bytes=256 * 1024)
     engine = DecodeEngine(params, cfg, serving, sampling=SAM,
                           pixel_pipeline=pipeline, metrics=metrics,
-                          chaos=chaos).start()
+                          chaos=chaos, tracer=tracer).start()
     httpd = ServingHTTPServer(("127.0.0.1", 0), engine,
                               request_timeout_s=serving.request_timeout_s)
     http_thread = threading.Thread(target=httpd.serve_forever,
@@ -370,6 +376,10 @@ def run_soak(args) -> dict:
         "parity_mismatches": mismatches[:8],
         "oracles": oracles,
         "ok": ok,
+        # flight-ring contents — popped by main(): a failing run dumps
+        # them as SOAK_FLIGHT.json, a passing run drops them (the ring
+        # is diagnostic payload, not report payload)
+        "_flight_rows": tracer.dump(),
     }
     return report
 
@@ -406,6 +416,23 @@ def main():
     report = run_soak(args)
     out_path = args.out or os.path.join(
         os.path.dirname(__file__), "..", "OVERLOAD_SOAK.json")
+    flight_rows = report.pop("_flight_rows", [])
+    if not report["ok"]:
+        # any oracle failure emits the merged request timeline as
+        # SOAK_FLIGHT.json next to the report (the serving twin of the
+        # churn soak's dump) — evidence, not just exit 1
+        flight_path = os.path.join(
+            os.path.dirname(os.path.abspath(out_path)) or ".",
+            "SOAK_FLIGHT.json")
+        with open(flight_path, "w") as f:
+            json.dump({"mode": "overload", "seed": args.seed,
+                       "violations": [k for k, v in
+                                      report["oracles"].items() if not v],
+                       "timeline": flight_rows}, f, indent=1)
+            f.write("\n")
+        report["artifacts"] = {"flight": flight_path}
+        print(f"oracle failure: flight dump -> {flight_path}",
+              flush=True)
     with open(out_path, "w") as f:
         f.write(json.dumps(report, indent=1) + "\n")
     print(json.dumps({k: report[k] for k in (

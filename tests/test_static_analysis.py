@@ -700,6 +700,57 @@ def drain_reduce(decode, raws, acc, fused_accumulate):
     return acc
 """,
     ),
+    (
+        "blocking-io-under-lock",
+        "dalle_tpu/fake_sink.py",
+        """
+import threading, time
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def flush(self, path, row):
+        with self._lock:
+            f = open(path, "a")
+            f.write(row)
+            time.sleep(0.05)
+def dump(path, rows):
+    lk = threading.Lock()
+    with lk:
+        with open(path, "a") as f:
+            f.writelines(rows)
+def dump_single_header(path, rows):
+    lk = threading.Lock()
+    with lk, open(path, "a") as f:
+        f.writelines(rows)
+""",
+        """
+import threading, time
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending = []
+    def add(self, row):
+        with self._lock:
+            self._pending.append(row)   # memory only: fine
+    def flush(self, path):
+        with self._lock:
+            rows, self._pending = self._pending, []
+        with open(path, "a") as f:     # I/O OUTSIDE the lock
+            f.writelines(rows)
+    def waiter(self):
+        with self._cv:
+            self._cv.wait(timeout=0.1)  # releases the lock: fine
+def slow_helper(path):
+    time.sleep(0.01)                   # no lock held: fine
+    with open(path) as f:
+        return f.read()
+def open_before_lock(path):
+    lk = threading.Lock()
+    with open(path) as f, lk:          # open PRECEDES the acquire
+        pass
+""",
+    ),
 ]
 
 
