@@ -12,10 +12,13 @@ Stdlib-``ast`` only. Three rule families:
   thread-daemon-join, mixed-lock-writes, unchecked-pool-future —
   lifecycle and locking discipline for the swarm's background-thread
   layer.
-- **flow** (whole-program): use-after-donate, lock-order-cycle,
-  rng-key-reuse — flow-sensitive properties resolved over the project
-  model (``project.py``: symbol table, intra-package call graph, jit
-  wrappers with their donate_argnums/static_argnums).
+- **flow** (whole-program): use-after-donate, donated-escape,
+  lock-order-cycle, rng-key-reuse — flow-sensitive properties resolved
+  over the field- and closure-sensitive project model (``project.py``:
+  symbol table, intra-package call graph, jit wrappers with their
+  donate_argnums/static_argnums, constructor-parameter attribute
+  provenance, lowered closures/lambdas, tuple/dict pack–unpack, and
+  base-class walking).
 
 Entry points: ``scripts/lint.py`` (CLI with ``--check``/baseline,
 ``--diff``/``--jobs``, JSON/SARIF output, content-hash parse cache) and
@@ -35,6 +38,7 @@ from dalle_tpu.analysis.core import (  # noqa: F401
     diff_baseline,
     fingerprint_findings,
     load_baseline,
+    prune_stale_baseline,
     save_baseline,
 )
 from dalle_tpu.analysis import (concurrency_rules, flow_rules,  # noqa: F401

@@ -10,11 +10,22 @@ what makes ``--diff`` fast: whole-program rules need summaries for the
 WHOLE tree even when only one file changed, and unchanged summaries
 come from here.
 
-Invalidation is structural, not temporal: the version key folds in the
-analyzer version, the summary schema, and the registered rule ids — a
-new rule, changed rule logic (bump ``ANALYZER_VERSION``), or a schema
-change discards the whole cache. Corrupt/foreign cache files are
-ignored, never trusted.
+Invalidation is structural, not temporal, and — since the v2 flow model
+— *split by product*:
+
+- ``rules_key`` (analyzer version + registered per-file rule ids)
+  guards the cached per-file findings: new or changed per-file rule
+  logic discards findings but keeps summaries;
+- ``schema_key`` (the flow-IR summary schema) guards the cached
+  summaries: a schema bump discards every summary but keeps the
+  per-file findings of unchanged rules, so the re-scan after a flow
+  model upgrade only pays the summarize half.
+
+An entry can therefore be a *partial* hit: ``lookup`` returns the entry
+dict and the caller checks which products are present (``"findings"`` /
+``"summary"`` keys — a present-but-``None`` summary means the file
+does not parse, which is itself a cacheable fact). Corrupt/foreign
+cache files are ignored wholesale, never trusted.
 """
 
 from __future__ import annotations
@@ -22,49 +33,84 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
+
+_FORMAT = 2
 
 
-def version_key() -> str:
-    from dalle_tpu.analysis.core import (ANALYZER_VERSION, PROJECT_RULES,
-                                         RULES, _load_rules)
-    from dalle_tpu.analysis.project import SUMMARY_SCHEMA
+def rules_key() -> str:
+    """Version key for the per-file finding half: analyzer version +
+    the registered per-file rule ids."""
+    from dalle_tpu.analysis.core import ANALYZER_VERSION, RULES, _load_rules
     _load_rules()
-    ids = ",".join(sorted(RULES) + sorted(PROJECT_RULES))
-    digest = hashlib.sha256(ids.encode()).hexdigest()[:12]
-    return f"{ANALYZER_VERSION}|{SUMMARY_SCHEMA}|{digest}"
+    digest = hashlib.sha256(",".join(sorted(RULES)).encode()).hexdigest()
+    return f"{ANALYZER_VERSION}|{digest[:12]}"
+
+
+def schema_key() -> str:
+    """Version key for the flow-summary half. Project rules re-run on
+    every scan (they are not cached), so only the summary schema — what
+    the IR *contains* — participates."""
+    from dalle_tpu.analysis.project import SUMMARY_SCHEMA
+    return str(SUMMARY_SCHEMA)
 
 
 def load(path: Optional[str]) -> dict:
     """Load (or initialize) a cache dict. Anything unreadable, of a
-    different version, or structurally off is discarded wholesale."""
-    fresh = {"version": version_key(), "files": {}}
+    different format, or structurally off is discarded wholesale; a
+    rules-key mismatch strips cached findings only, a schema-key
+    mismatch strips cached summaries only."""
+    rk, sk = rules_key(), schema_key()
+    fresh = {"format": _FORMAT, "rules_key": rk, "schema_key": sk,
+             "files": {}}
     if path is None or not os.path.exists(path):
         return fresh
     try:
         with open(path, "r", encoding="utf-8") as fh:
             data = json.load(fh)
         if (not isinstance(data, dict)
-                or data.get("version") != fresh["version"]
-                or not isinstance(data.get("files"), dict)):
+                or data.get("format") != _FORMAT
+                or not isinstance(data.get("files"), dict)
+                or not all(isinstance(e, dict)
+                           for e in data["files"].values())):
             return fresh
+        if data.get("rules_key") != rk:
+            for e in data["files"].values():
+                e.pop("findings", None)
+            data["rules_key"] = rk
+        if data.get("schema_key") != sk:
+            for e in data["files"].values():
+                e.pop("summary", None)
+            data["schema_key"] = sk
         return data
     except (OSError, ValueError):
         return fresh
 
 
-def lookup(cache: dict, rel: str, sha: str
-           ) -> Optional[Tuple[List[dict], Optional[dict]]]:
+def lookup(cache: dict, rel: str, sha: str) -> Optional[dict]:
+    """The entry for ``rel`` when its content hash matches — possibly a
+    partial hit (check for the ``"findings"`` / ``"summary"`` keys)."""
     entry = cache["files"].get(rel)
     if entry is None or entry.get("sha") != sha:
         return None
-    return entry.get("findings", []), entry.get("summary")
+    return entry
 
 
-def store(cache: dict, rel: str, sha: str, findings: List[dict],
-          summary: Optional[dict]) -> None:
-    cache["files"][rel] = {"sha": sha, "findings": findings,
-                           "summary": summary}
+def store(cache: dict, rel: str, sha: str,
+          findings: Optional[List[dict]],
+          summary: Optional[dict], has_summary: bool = True) -> None:
+    """Merge the computed products into the entry. ``findings=None``
+    means "not computed this scan" (keep whatever the entry has);
+    ``has_summary=False`` likewise for the summary (``summary=None``
+    with ``has_summary=True`` is the cacheable does-not-parse fact)."""
+    entry = cache["files"].get(rel)
+    if entry is None or entry.get("sha") != sha:
+        entry = {"sha": sha}
+        cache["files"][rel] = entry
+    if findings is not None:
+        entry["findings"] = findings
+    if has_summary:
+        entry["summary"] = summary
 
 
 def save(path: Optional[str], cache: dict,

@@ -158,16 +158,39 @@ class FileContext:
         roots: List[ast.AST] = []
         wrapped_names: Set[str] = set()
         defs_by_name: Dict[str, List[ast.AST]] = {}
+        # prepass: `wrap = jax.jit` aliases — `wrap(f)` then compiles f
+        # exactly like `jax.jit(f)` (the indirect-wrapping blind spot)
+        aliases: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, (ast.Name, ast.Attribute)):
+                d = dotted_name(node.value)
+                if d is not None and d.split(".")[-1] in _JIT_LEAVES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+
+        def is_jit(expr: ast.AST) -> bool:
+            d = dotted_name(expr)
+            if d is not None and d in aliases:
+                return True
+            if isinstance(expr, ast.Call):
+                fd = dotted_name(expr.func)
+                if fd is not None and fd in aliases:
+                    return True     # @wrap(static_argnums=...) form
+            return _is_jit_expr(expr)
+
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs_by_name.setdefault(node.name, []).append(node)
-                if any(_is_jit_expr(d) for d in node.decorator_list):
+                if any(is_jit(d) for d in node.decorator_list):
                     roots.append(node)
             elif isinstance(node, ast.Call):
                 callee = dotted_name(node.func)
                 leaf = callee.split(".")[-1] if callee else None
                 takes_fn = (leaf in _JIT_LEAVES
                             or leaf == "pallas_call"
+                            or (callee is not None and callee in aliases)
                             or _is_jit_expr(node.func))
                 if takes_fn and node.args:
                     arg = node.args[0]
@@ -223,8 +246,9 @@ class FileContext:
 # -- rule registry --------------------------------------------------------
 
 #: bump to invalidate parse caches when rule logic changes without a
-#: registry change (cache.py folds this into its version key)
-ANALYZER_VERSION = 2
+#: registry change (cache.py folds this into its rules key; flow
+#: summaries are guarded separately by project.SUMMARY_SCHEMA)
+ANALYZER_VERSION = 3
 
 RuleFn = Callable[[FileContext], Iterable[Finding]]
 
@@ -290,9 +314,13 @@ def _select_rules(rules: Optional[Iterable[str]]):
             [PROJECT_RULES[r] for r in rules if r in PROJECT_RULES])
 
 
-def _file_findings(source: str, path: str,
-                   file_rules) -> List[Finding]:
-    """Per-file rules over one source string (no project pass)."""
+def _file_findings(source: str, path: str, file_rules,
+                   timings: Optional[Dict[str, float]] = None
+                   ) -> List[Finding]:
+    """Per-file rules over one source string (no project pass).
+    ``timings``: per-rule wall seconds accumulated in place (budget
+    accounting for ``--format json``)."""
+    import time
     try:
         ctx = FileContext(path, source)
     except SyntaxError as e:
@@ -302,7 +330,11 @@ def _file_findings(source: str, path: str,
                         snippet="")]
     findings: List[Finding] = []
     for r in file_rules:
+        t0 = time.monotonic()
         findings.extend(f for f in r.fn(ctx) if f is not None)
+        if timings is not None:
+            timings[r.id] = timings.get(r.id, 0.0) \
+                + (time.monotonic() - t0)
     return findings
 
 
@@ -355,39 +387,55 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return sorted(set(out))
 
 
-def _analyze_one(rel: str, source: str):
-    """Worker for the parallel scan: (per-file finding dicts, summary).
-    Top-level so ProcessPoolExecutor can pickle it; computes ALL
-    per-file rules — selection filters at report time, which keeps the
-    parse cache rule-selection-independent."""
+def _analyze_one(rel: str, source: str, need_findings: bool = True,
+                 need_summary: bool = True):
+    """Worker for the parallel scan: (rel, finding dicts | None,
+    summary, per-rule timings). Top-level so ProcessPoolExecutor can
+    pickle it; computes ALL per-file rules — selection filters at
+    report time, which keeps the parse cache rule-selection-
+    independent. A split-version cache hit may need only one product
+    (``need_findings``/``need_summary``); the skipped product returns
+    None and the caller keeps its cached value."""
     from dalle_tpu.analysis.project import summarize_source
     _load_rules()
-    findings = [f.to_dict() for f in
-                _file_findings(source, rel, list(RULES.values()))]
-    try:
-        summary = summarize_source(rel, source)
-    except SyntaxError:
-        summary = None
-    return rel, findings, summary
+    timings: Dict[str, float] = {}
+    findings = None
+    if need_findings:
+        findings = [f.to_dict() for f in
+                    _file_findings(source, rel, list(RULES.values()),
+                                   timings)]
+    summary = None
+    if need_summary:
+        try:
+            summary = summarize_source(rel, source)
+        except SyntaxError:
+            summary = None
+    return rel, findings, summary, timings
 
 
 def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
                   rules: Optional[Iterable[str]] = None,
                   jobs: int = 1,
                   cache_path: Optional[str] = None,
-                  changed_only: Optional[Set[str]] = None) -> List[Finding]:
+                  changed_only: Optional[Set[str]] = None,
+                  stats: Optional[Dict[str, object]] = None
+                  ) -> List[Finding]:
     """Analyze every ``*.py`` under ``paths``; finding paths are made
     relative to ``root`` (default: cwd) so baselines are machine-
     independent.
 
     ``cache_path``: content-hash parse cache (cache.py) — unchanged
     files reuse their per-file findings and project summary without
-    re-parsing. ``jobs`` > 1 fans cache misses over a process pool.
+    re-parsing; a split-version partial hit recomputes only the stale
+    product. ``jobs`` > 1 fans cache misses over a process pool.
     ``changed_only``: report per-file findings only for these relative
     paths (the ``--diff`` mode); the project model is still built over
     the FULL scope — whole-program rules are only sound over the whole
     program — so flow findings are always reported wherever they land.
+    ``stats``: filled in place with per-rule finding/timing counts and
+    cache hit/miss counts (the ``--format json`` budget report).
     """
+    import time as _time
     from dalle_tpu.analysis import cache as cache_mod
     from dalle_tpu.analysis.project import Project
     paths = list(paths)         # iterated twice: file walk + scope prune
@@ -404,36 +452,55 @@ def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
 
     cache = cache_mod.load(cache_path) if cache_path else None
     per_file: Dict[str, List[dict]] = {}
-    summaries: Dict[str, dict] = {}
-    misses: List[str] = []
+    summaries: Dict[str, Optional[dict]] = {}
+    #: rel -> (need_findings, need_summary); full AND partial misses
+    misses: Dict[str, Tuple[bool, bool]] = {}
     shas: Dict[str, str] = {}
+    rule_seconds: Dict[str, float] = {}
+    n_hits = 0
     for rel, source in entries.items():
         sha = hashlib.sha256(source.encode()).hexdigest()
         shas[rel] = sha
-        hit = cache_mod.lookup(cache, rel, sha) if cache else None
-        if hit is not None:
-            per_file[rel], summaries[rel] = hit
+        entry = cache_mod.lookup(cache, rel, sha) if cache else None
+        need_f, need_s = True, True
+        if entry is not None:
+            if "findings" in entry:
+                per_file[rel] = entry["findings"]
+                need_f = False
+            if "summary" in entry:
+                summaries[rel] = entry["summary"]
+                need_s = False
+        if need_f or need_s:
+            misses[rel] = (need_f, need_s)
         else:
-            misses.append(rel)
+            n_hits += 1
+
+    def _take(result) -> None:
+        rel, findings, summary, timings = result
+        if findings is not None:
+            per_file[rel] = findings
+        if misses[rel][1]:
+            summaries[rel] = summary
+        for rid, sec in timings.items():
+            rule_seconds[rid] = rule_seconds.get(rid, 0.0) + sec
 
     if jobs > 1 and len(misses) > 1:
         import concurrent.futures
         with concurrent.futures.ProcessPoolExecutor(
                 max_workers=jobs) as pool:
-            futs = [pool.submit(_analyze_one, rel, entries[rel])
-                    for rel in misses]
+            futs = [pool.submit(_analyze_one, rel, entries[rel], nf, ns)
+                    for rel, (nf, ns) in misses.items()]
             for fut in futs:
-                rel, findings, summary = fut.result()
-                per_file[rel], summaries[rel] = findings, summary
+                _take(fut.result())
     else:
-        for rel in misses:
-            _rel, findings, summary = _analyze_one(rel, entries[rel])
-            per_file[rel], summaries[rel] = findings, summary
+        for rel, (nf, ns) in misses.items():
+            _take(_analyze_one(rel, entries[rel], nf, ns))
 
     if cache is not None:
-        for rel in misses:
-            cache_mod.store(cache, rel, shas[rel], per_file[rel],
-                            summaries[rel])
+        for rel, (nf, ns) in misses.items():
+            cache_mod.store(cache, rel, shas[rel],
+                            per_file.get(rel) if nf else None,
+                            summaries.get(rel), has_summary=ns)
         # prune only entries this scan could actually see: a scoped run
         # (lint.py dalle_tpu/serving) must not evict the rest of the
         # tree's cache and turn the next full --check cold
@@ -458,12 +525,35 @@ def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
         findings.extend(Finding.from_dict(d) for d in dicts
                         if d["rule"] in file_rule_ids)
     if proj_rules:
+        t0 = _time.monotonic()
         project = Project(
             {rel: sm for rel, sm in summaries.items() if sm is not None},
             entries)
+        rule_seconds["<project-assembly>"] = _time.monotonic() - t0
         for r in proj_rules:
+            t0 = _time.monotonic()
             findings.extend(f for f in r.fn(project) if f is not None)
+            rule_seconds[r.id] = rule_seconds.get(r.id, 0.0) \
+                + (_time.monotonic() - t0)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if stats is not None:
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        rids = sorted(set(counts) | set(rule_seconds))
+        stats["files"] = len(entries)
+        stats["cache"] = {
+            "hits": n_hits,
+            "partial": sum(1 for nf, ns in misses.values()
+                           if not (nf and ns)),
+            "misses": len(misses),
+        }
+        # per-rule budget ledger: cold timings only (cache hits run no
+        # rules — a warm scan legitimately reports ~0 for per-file ids)
+        stats["rules"] = {
+            rid: {"findings": counts.get(rid, 0),
+                  "seconds": round(rule_seconds.get(rid, 0.0), 4)}
+            for rid in rids}
     return findings
 
 
@@ -514,3 +604,24 @@ def diff_baseline(findings: Iterable[Finding], baseline: Set[str]
         if fp not in baseline:
             fresh.append(f)
     return fresh, baseline - seen
+
+
+def prune_stale_baseline(path: str, findings: Iterable[Finding]) -> int:
+    """Drop baseline entries whose finding no longer exists (fixes) and
+    rewrite the file; returns the number pruned. The ratchet face of
+    ``--check``'s stale-entry failure: a fixed finding must leave the
+    baseline, it only shrinks."""
+    if not os.path.exists(path):
+        return 0
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    current = {fp for _f, fp in fingerprint_findings(findings)}
+    entries = data.get("findings", [])
+    kept = [e for e in entries if e.get("fingerprint") in current]
+    pruned = len(entries) - len(kept)
+    if pruned:
+        data["findings"] = kept
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=1)
+            fh.write("\n")
+    return pruned

@@ -1,6 +1,6 @@
 """graftlint flow rule family: whole-program, flow-sensitive hazards.
 
-These three rules run over the :mod:`~dalle_tpu.analysis.project` model
+These rules run over the :mod:`~dalle_tpu.analysis.project` model
 (flow IR + symbol table + call graph), not a single parsed tree — each
 encodes an invariant the r9 zero-sync engine and the r10 chaos layer
 made load-bearing:
@@ -20,8 +20,12 @@ made load-bearing:
   an intervening ``split`` produces *correlated* samples: silent, no
   crash, but it breaks the swarm's bit-exact parity oracles (the same
   request would sample different codes solo vs co-tenant).
+- **donated-escape** — a binding that escaped into an attribute,
+  container, or closure *before* being donated leaves the holder
+  referencing a deleted buffer; a later read through the holder is the
+  same corpse read with the name laundered through a data structure.
 
-All three interpret the same statement-ordered IR with branch-union and
+All four interpret the same statement-ordered IR with branch-union and
 loop-twice semantics: branches merge conservatively (a hazard on either
 arm survives the join), and loop bodies run twice so a donation or
 consumption at the bottom of an iteration meets its read at the top of
@@ -62,95 +66,379 @@ def _matches(binding: str, donated: Dict[str, Tuple[int, str]]
     return None
 
 
-def _clear_binding(name: str, donated: Dict[str, Tuple[int, str]]) -> None:
-    """Rebinding ``name`` retires it (and anything reached through it)
-    from the donated set — ``state = fn(state)`` is the sanctioned
-    pattern."""
-    for d in list(donated):
-        if d == name or d.startswith(name + "."):
-            del donated[d]
+class _DonState:
+    """donated: binding -> (donation line, callee). alias: plain-name
+    alias edges (``st = self._state``) — donating either side marks the
+    whole group, since every name reaches the same deleted buffer.
+    packs: tuple composition (``carry = (state, x)``) for positional
+    re-aliasing at the unpack."""
+
+    def __init__(self):
+        self.donated: Dict[str, Tuple[int, str]] = {}
+        self.alias: Dict[str, Set[str]] = {}
+        self.packs: Dict[str, List[Optional[str]]] = {}
+
+    def copy(self) -> "_DonState":
+        st = _DonState()
+        st.donated = dict(self.donated)
+        st.alias = {k: set(v) for k, v in self.alias.items()}
+        st.packs = {k: list(v) for k, v in self.packs.items()}
+        return st
+
+    def link(self, a: str, b: str) -> None:
+        if a == b:
+            return
+        self.alias.setdefault(a, set()).add(b)
+        self.alias.setdefault(b, set()).add(a)
+
+    def group(self, name: str) -> Set[str]:
+        out = {name}
+        queue = [name]
+        while queue:
+            for nxt in self.alias.get(queue.pop(), ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    queue.append(nxt)
+        return out
+
+    def donate(self, name: str, fact: Tuple[int, str]) -> None:
+        for n in self.group(name):
+            self.donated.setdefault(n, fact)
+
+    def clear(self, name: str) -> None:
+        """Rebinding ``name`` retires it (and anything reached through
+        it) — ``state = fn(state)`` is the sanctioned pattern. Aliases
+        of the old value keep their donated state: they still point at
+        the deleted buffer."""
+        for d in list(self.donated):
+            if d == name or d.startswith(name + "."):
+                del self.donated[d]
+        for n in self.alias.pop(name, ()):
+            self.alias.get(n, set()).discard(name)
+        self.packs.pop(name, None)
 
 
-def _run_donate_block(block: List[dict], donated: Dict[str, Tuple[int, str]],
+def _run_donate_block(block: List[dict], st: _DonState,
                       ctx: dict, findings: List[Optional[Finding]],
                       seen: Set[Tuple[int, str]]) -> bool:
     """Returns True when the block terminated (return/raise/break/
     continue) — a terminated branch contributes nothing to its join."""
     project: Project = ctx["project"]
+    closures: Dict[str, List[str]] = ctx["closures"]
+
+    def report(line: int, read_name: str, hit: str, how: str) -> None:
+        key = (line, read_name)
+        if key in seen:
+            return
+        seen.add(key)
+        dline, callee = st.donated[hit]
+        findings.append(_mk_finding(
+            project, "use-after-donate", ctx["path"], line,
+            f"'{read_name}' is read{how} after '{hit}' was donated "
+            f"to {callee} (line {dline}): the buffer was "
+            "deleted at dispatch — rebind the result "
+            f"('{hit} = {callee}(...)') or re-slice from "
+            "the returned state"))
+
     for op in block:
         t = op["t"]
         if t == "term":
             return True
         if t == "read":
-            hit = _matches(op["n"], donated)
+            hit = _matches(op["n"], st.donated)
             if hit is not None:
-                key = (op["l"], op["n"])
-                if key not in seen:
-                    seen.add(key)
-                    dline, callee = donated[hit]
-                    findings.append(_mk_finding(
-                        project, "use-after-donate", ctx["path"], op["l"],
-                        f"'{op['n']}' is read after '{hit}' was donated "
-                        f"to {callee} (line {dline}): the buffer was "
-                        "deleted at dispatch — rebind the result "
-                        f"('{hit} = {callee}(...)') or re-slice from "
-                        "the returned state"))
+                report(op["l"], op["n"], hit, "")
+        elif t == "closure":
+            if op["n"] is not None:
+                closures[op["n"]] = op["frees"]
         elif t == "call":
+            # a call into a closure reads every binding it captured —
+            # the nested-def edge v1 was blind to
+            fn = op.get("fn")
+            if fn in closures:
+                for free in closures[fn]:
+                    hit = _matches(free, st.donated)
+                    if hit is not None:
+                        report(op["l"], free, hit,
+                               f" (captured by closure '{fn}')")
             pos = project.donate_positions(
                 ctx["module"], ctx["cls"], ctx["qual"], op)
             if pos:
                 callee = op.get("fn") or op.get("inner") or "a jitted call"
                 for p in pos:
                     if p < len(op["args"]) and op["args"][p] is not None:
-                        donated.setdefault(op["args"][p],
-                                           (op["l"], callee))
+                        st.donate(op["args"][p], (op["l"], callee))
         elif t == "assign":
-            for tg in op["tg"]:
-                _clear_binding(tg, donated)
+            src = op.get("src")
+            tgs = op["tg"]
+            for tg in tgs:
+                st.clear(tg)
+            if src is not None:
+                if src.startswith("name:"):
+                    for tg in tgs:
+                        # attribute targets (`self._last = state`) are
+                        # HOLDERS — donated-escape's job; aliasing them
+                        # here would double-report every attribute
+                        # escape under both rules
+                        if "." not in tg:
+                            st.link(tg, src[5:])
+                elif src.startswith("pack:"):
+                    elts = [e or None for e in src[5:].split(",")]
+                    for tg in tgs:
+                        st.packs[tg] = elts
+                elif src.startswith("unpack:"):
+                    elts = st.packs.get(src[7:])
+                    if elts is not None:
+                        for i, tg in enumerate(tgs):
+                            if i < len(elts) and elts[i] is not None:
+                                st.link(tg, elts[i])
+                elif src.startswith("item:"):
+                    _t, base, k = src.split(":", 2)
+                    elts = st.packs.get(base)
+                    if elts is not None and k.isdigit() \
+                            and int(k) < len(elts) \
+                            and elts[int(k)] is not None:
+                        for tg in tgs:
+                            st.link(tg, elts[int(k)])
         elif t == "with":
-            if _run_donate_block(op["b"], donated, ctx, findings, seen):
+            if _run_donate_block(op["b"], st, ctx, findings, seen):
                 return True
         elif t == "branch":
-            outs = []
+            outs: List[_DonState] = []
             n_term = 0
             for b in op["bs"]:
-                branch_state = dict(donated)
+                branch_state = st.copy()
                 if _run_donate_block(b, branch_state, ctx, findings,
                                      seen):
                     n_term += 1
                 else:
                     outs.append(branch_state)
-            merged: Dict[str, Tuple[int, str]] = {}
+            merged = _DonState()
             for o in outs:
-                merged.update(o)
-            donated.clear()
-            donated.update(merged)
+                merged.donated.update(o.donated)
+                for k, v in o.alias.items():
+                    merged.alias.setdefault(k, set()).update(v)
+                merged.packs.update(o.packs)
+            st.donated, st.alias, st.packs = \
+                merged.donated, merged.alias, merged.packs
             if n_term == len(op["bs"]) and op["bs"]:
                 return True      # every arm left: the join is dead code
         elif t == "loop":
             # two passes: the second meets pass-one donations at the top
             # of the body (the wrap-around read); break/continue inside
             # stop a pass but never terminate the enclosing block
-            _run_donate_block(op["b"], donated, ctx, findings, seen)
-            _run_donate_block(op["b"], donated, ctx, findings, seen)
+            _run_donate_block(op["b"], st, ctx, findings, seen)
+            _run_donate_block(op["b"], st, ctx, findings, seen)
     return False
 
 
 @project_rule(
     "use-after-donate", "flow", "error",
     "A binding passed in a donate_argnums position of a jitted call"
-    " (decorator, binding, factory, or immediate jax.jit form — resolved"
-    " through the project call graph) is read again without rebinding:"
-    " the donated buffer was deleted at dispatch, so the read returns"
-    " garbage or raises depending on backend timing. `state = fn(state)`"
-    " is the sanctioned shape; `fn(state); state.x` is the bug.")
+    " (decorator, binding, factory, immediate, aliased-wrapper, or"
+    " attribute-provenance jax.jit form — resolved through the project"
+    " call graph) is read again without rebinding, directly, through a"
+    " plain alias, or through a closure that captured it: the donated"
+    " buffer was deleted at dispatch, so the read returns garbage or"
+    " raises depending on backend timing. `state = fn(state)` is the"
+    " sanctioned shape; `fn(state); state.x` is the bug.")
 def use_after_donate(project: Project) -> Iterable[Finding]:
     findings: List[Optional[Finding]] = []
     for path, module, qual, rec in iter_functions(project):
         ctx = {"project": project, "path": path, "module": module,
-               "qual": qual, "cls": rec["cls"]}
+               "qual": qual, "cls": rec["cls"], "closures": {}}
         seen: Set[Tuple[int, str]] = set()
-        _run_donate_block(rec["body"], {}, ctx, findings, seen)
+        _run_donate_block(rec["body"], _DonState(), ctx, findings, seen)
+    return [f for f in findings if f is not None]
+
+
+# -- donated-escape --------------------------------------------------------
+#
+# The complement of use-after-donate: that rule follows the donated NAME
+# (and its plain aliases); this one follows the places the binding
+# ESCAPED to before the donation — an attribute (`self._last = state`),
+# a container (`pending.append(state)`, `d[k] = state`, a packed
+# tuple), or a closure — and flags a read through the escape hatch
+# after the buffer was deleted. This is the exact bug class a unified
+# device-state substrate could reintroduce invisibly: the substrate
+# stores the donated state in an attribute, a later method reads it.
+
+
+class _EscState:
+    def __init__(self):
+        self.donated: Dict[str, Tuple[int, str]] = {}
+        #: holder -> bindings it contains (attribute, container, pack)
+        self.held: Dict[str, Set[str]] = {}
+        #: holder -> (donation line, callee, binding) once a held
+        #: binding is donated — the holder now hides a deleted buffer
+        self.stale: Dict[str, Tuple[int, str, str]] = {}
+
+    def copy(self) -> "_EscState":
+        st = _EscState()
+        st.donated = dict(self.donated)
+        st.held = {k: set(v) for k, v in self.held.items()}
+        st.stale = dict(self.stale)
+        return st
+
+    def clear(self, name: str) -> None:
+        for d in list(self.donated):
+            if d == name or d.startswith(name + "."):
+                del self.donated[d]
+        self.held.pop(name, None)
+        self.stale.pop(name, None)
+
+
+def _overlaps(a: str, b: str) -> bool:
+    return a == b or a.startswith(b + ".") or b.startswith(a + ".")
+
+
+def _run_escape_block(block: List[dict], st: _EscState, ctx: dict,
+                      findings: List[Optional[Finding]],
+                      seen: Set[Tuple[int, str]]) -> bool:
+    project: Project = ctx["project"]
+    closures: Dict[str, List[str]] = ctx["closures"]
+
+    def report(line: int, text: str, key_name: str) -> None:
+        key = (line, key_name)
+        if key not in seen:
+            seen.add(key)
+            findings.append(_mk_finding(
+                project, "donated-escape", ctx["path"], line, text))
+
+    def note_donation(d: str, line: int, callee: str) -> None:
+        st.donated.setdefault(d, (line, callee))
+        for holder, vals in st.held.items():
+            if any(_overlaps(v, d) for v in vals):
+                st.stale.setdefault(holder, (line, callee, d))
+
+    for op in block:
+        t = op["t"]
+        if t == "term":
+            return True
+        if t == "read":
+            for holder, (dline, callee, binding) in st.stale.items():
+                if op["n"] == holder or op["n"].startswith(holder + "."):
+                    report(
+                        op["l"],
+                        f"'{op['n']}' is read after donated binding "
+                        f"'{binding}' escaped into '{holder}' and was "
+                        f"donated to {callee} (line {dline}): the "
+                        "holder still references the deleted buffer — "
+                        "store the REBOUND result instead, or clear "
+                        "the holder before the donating call",
+                        op["n"])
+                    break
+        elif t == "escape":
+            st.held.setdefault(op["h"], set()).update(op["vs"])
+            # storing a binding that is ALREADY stale-held keeps it held
+        elif t == "closure":
+            if op["n"] is not None:
+                closures[op["n"]] = op["frees"]
+            else:
+                # a lambda created after the donation captures a corpse
+                for free in op["frees"]:
+                    hit = _matches(free, st.donated)
+                    if hit is not None:
+                        dline, callee = st.donated[hit]
+                        report(
+                            op["l"],
+                            f"a lambda capturing '{free}' is created "
+                            f"after '{hit}' was donated to {callee} "
+                            f"(line {dline}): every call of it will "
+                            "read the deleted buffer",
+                            free)
+        elif t == "call":
+            # a closure that captured a binding escaping into another
+            # call after the donation defers the corpse read
+            for arg in op.get("args") or ():
+                if arg in closures:
+                    for free in closures[arg]:
+                        hit = _matches(free, st.donated)
+                        if hit is not None:
+                            dline, callee = st.donated[hit]
+                            report(
+                                op["l"],
+                                f"closure '{arg}' capturing '{free}' "
+                                f"escapes after '{hit}' was donated to "
+                                f"{callee} (line {dline}): whoever "
+                                "calls it reads the deleted buffer",
+                                f"{arg}:{free}")
+            pos = project.donate_positions(
+                ctx["module"], ctx["cls"], ctx["qual"], op)
+            if pos:
+                callee = op.get("fn") or op.get("inner") or "a jitted call"
+                for p in pos:
+                    if p < len(op["args"]) and op["args"][p] is not None:
+                        note_donation(op["args"][p], op["l"], callee)
+        elif t == "assign":
+            src = op.get("src")
+            tgs = op["tg"]
+            for tg in tgs:
+                st.clear(tg)
+            if src is not None:
+                if src.startswith("name:") and src[5:] != "self":
+                    # an attribute target is a holder (`self.x = state`);
+                    # a plain local alias is use-after-donate's job
+                    for tg in tgs:
+                        if "." in tg:
+                            st.held[tg] = {src[5:]}
+                elif src.startswith("pack:"):
+                    vals = {e for e in src[5:].split(",") if e}
+                    if vals:
+                        for tg in tgs:
+                            st.held[tg] = set(vals)
+                elif src.startswith("dpack:"):
+                    vals = {kv.split("=", 1)[1]
+                            for kv in src[6:].split(",") if "=" in kv}
+                    if vals:
+                        for tg in tgs:
+                            st.held[tg] = set(vals)
+        elif t == "with":
+            if _run_escape_block(op["b"], st, ctx, findings, seen):
+                return True
+        elif t == "branch":
+            outs: List[_EscState] = []
+            n_term = 0
+            for b in op["bs"]:
+                branch_state = st.copy()
+                if _run_escape_block(b, branch_state, ctx, findings,
+                                     seen):
+                    n_term += 1
+                else:
+                    outs.append(branch_state)
+            merged = _EscState()
+            for o in outs:
+                merged.donated.update(o.donated)
+                for k, v in o.held.items():
+                    merged.held.setdefault(k, set()).update(v)
+                merged.stale.update(o.stale)
+            st.donated, st.held, st.stale = \
+                merged.donated, merged.held, merged.stale
+            if n_term == len(op["bs"]) and op["bs"]:
+                return True
+        elif t == "loop":
+            _run_escape_block(op["b"], st, ctx, findings, seen)
+            _run_escape_block(op["b"], st, ctx, findings, seen)
+    return False
+
+
+@project_rule(
+    "donated-escape", "flow", "error",
+    "A binding escaped into an attribute, container (append/put/"
+    " subscript/packed tuple), or closure and was THEN donated to a"
+    " jitted call: the holder still references the buffer that donation"
+    " deleted, and a later read through the holder (or a closure/lambda"
+    " carrying the capture onward) returns garbage or raises. Store the"
+    " rebound result instead, or clear the holder before the donating"
+    " call. This is the bug class a unified device-state substrate"
+    " could reintroduce invisibly (ROADMAP direction 5).")
+def donated_escape(project: Project) -> Iterable[Finding]:
+    findings: List[Optional[Finding]] = []
+    for path, module, qual, rec in iter_functions(project):
+        ctx = {"project": project, "path": path, "module": module,
+               "qual": qual, "cls": rec["cls"], "closures": {}}
+        seen: Set[Tuple[int, str]] = set()
+        _run_escape_block(rec["body"], _EscState(), ctx, findings, seen)
     return [f for f in findings if f is not None]
 
 
@@ -379,10 +667,21 @@ def _is_nonconsuming(callee: str) -> bool:
 
 
 class _KeyState:
-    """keys: binding -> consumed-at line (None = live/unconsumed)."""
+    """keys: binding -> consumed-at line (None = live/unconsumed).
+    packs: tuple/dict composition (``carry = (cache, x, rng)``) so a key
+    threaded through a pack–unpack round trip — the ``lax.scan`` carry
+    shape — stays tracked."""
 
     def __init__(self):
         self.keys: Dict[str, Optional[int]] = {}
+        self.packs: Dict[str, object] = {}   # name -> [elts] | {k: elt}
+
+    def copy(self) -> "_KeyState":
+        st = _KeyState()
+        st.keys = dict(self.keys)
+        st.packs = {k: (list(v) if isinstance(v, list) else dict(v))
+                    for k, v in self.packs.items()}
+        return st
 
 
 def _run_rng_block(block: List[dict], st: _KeyState, ctx: dict,
@@ -406,13 +705,41 @@ def _run_rng_block(block: List[dict], st: _KeyState, ctx: dict,
         else:
             st.keys[name] = line
 
+    closures: Dict[str, List[str]] = ctx["closures"]
+
+    def drop(tg: str) -> None:
+        st.keys.pop(tg, None)
+        st.packs.pop(tg, None)
+
+    def alias_or_track(tg: str, elt: Optional[str],
+                       fallback_fresh: bool) -> None:
+        """Unpack/item target: alias the packed element's key state when
+        known; otherwise a key-NAMED target of an untracked source (a
+        scan-carry parameter) enters the tracked set fresh."""
+        if elt is not None and elt in st.keys:
+            st.keys[tg] = st.keys[elt]
+        elif fallback_fresh and _KEY_PARAM_RE.match(tg):
+            st.keys[tg] = None
+        else:
+            drop(tg)
+
     for op in block:
         t = op["t"]
         if t == "term":
             return True
-        if t == "call":
+        if t == "closure":
+            if op["n"] is not None:
+                closures[op["n"]] = op["frees"]
+        elif t == "call":
             callee = op.get("fn")
             if callee is None:
+                continue
+            if callee in closures:
+                # calling a closure consumes every key it captured
+                for free in closures[callee]:
+                    if free in st.keys:
+                        consume(free, op["l"],
+                                f"closure {callee}() capturing it")
                 continue
             if _is_nonconsuming(callee):
                 continue
@@ -437,16 +764,64 @@ def _run_rng_block(block: List[dict], st: _KeyState, ctx: dict,
                         continue
                     if i < len(params) and _KEY_PARAM_RE.match(params[i]):
                         consume(arg, op["l"], f"{callee}()")
+                for kname, kval in (op.get("kw") or {}).items():
+                    if kval in st.keys and kname in params \
+                            and _KEY_PARAM_RE.match(kname):
+                        consume(kval, op["l"], f"{callee}()")
         elif t == "assign":
             src = op.get("src")
-            for tg in op["tg"]:
-                if src == "key":
+            tgs = op["tg"]
+            if src == "key":
+                for tg in tgs:
+                    st.packs.pop(tg, None)
                     st.keys[tg] = None       # fresh, unconsumed
-                elif src is not None and src.startswith("name:") \
-                        and src[5:] in st.keys:
-                    st.keys[tg] = st.keys[src[5:]]   # alias copy
-                elif tg in st.keys:
-                    del st.keys[tg]          # rebound to a non-key
+            elif src is not None and src.startswith("name:"):
+                s = src[5:]
+                for tg in tgs:
+                    if s in st.keys:
+                        st.packs.pop(tg, None)
+                        st.keys[tg] = st.keys[s]     # alias copy
+                    elif s in st.packs:
+                        p = st.packs[s]
+                        st.packs[tg] = (list(p) if isinstance(p, list)
+                                        else dict(p))
+                        st.keys.pop(tg, None)
+                    else:
+                        drop(tg)
+            elif src is not None and src.startswith("pack:"):
+                elts = [e or None for e in src[5:].split(",")]
+                for tg in tgs:
+                    st.keys.pop(tg, None)
+                    st.packs[tg] = elts
+            elif src is not None and src.startswith("dpack:"):
+                mapping = {kv.split("=", 1)[0]: kv.split("=", 1)[1]
+                           for kv in src[6:].split(",") if "=" in kv}
+                for tg in tgs:
+                    st.keys.pop(tg, None)
+                    st.packs[tg] = mapping
+            elif src is not None and src.startswith("unpack:"):
+                d = src[7:]
+                pk = st.packs.get(d)
+                fresh = pk is None and d not in st.keys
+                for i, tg in enumerate(tgs):
+                    elt = (pk[i] if isinstance(pk, list)
+                           and i < len(pk) else None)
+                    alias_or_track(tg, elt, fallback_fresh=fresh)
+            elif src is not None and src.startswith("item:"):
+                _t, base, k = src.split(":", 2)
+                pk = st.packs.get(base)
+                elt = None
+                if isinstance(pk, list) and k.isdigit() \
+                        and int(k) < len(pk):
+                    elt = pk[int(k)]
+                elif isinstance(pk, dict):
+                    elt = pk.get(k)
+                fresh = pk is None and base not in st.keys
+                for tg in tgs:
+                    alias_or_track(tg, elt, fallback_fresh=fresh)
+            else:
+                for tg in tgs:
+                    drop(tg)                 # rebound to a non-key
         elif t == "with":
             if _run_rng_block(op["b"], st, ctx, findings, seen):
                 return True
@@ -454,19 +829,21 @@ def _run_rng_block(block: List[dict], st: _KeyState, ctx: dict,
             outs = []
             n_term = 0
             for b in op["bs"]:
-                bst = _KeyState()
-                bst.keys = dict(st.keys)
+                bst = st.copy()
                 if _run_rng_block(b, bst, ctx, findings, seen):
                     n_term += 1
                 else:
-                    outs.append(bst.keys)
+                    outs.append(bst)
             merged: Dict[str, Optional[int]] = {}
+            merged_packs: Dict[str, object] = {}
             for o in outs:
-                for k, v in o.items():
+                for k, v in o.keys.items():
                     if k in merged and merged[k] is not None:
                         continue     # keep the consumed-at if any arm set
                     merged[k] = v if v is not None else merged.get(k)
+                merged_packs.update(o.packs)
             st.keys = merged
+            st.packs = merged_packs
             if n_term == len(op["bs"]) and op["bs"]:
                 return True
         elif t == "loop":
@@ -487,7 +864,7 @@ def rng_key_reuse(project: Project) -> Iterable[Finding]:
     findings: List[Optional[Finding]] = []
     for path, module, qual, rec in iter_functions(project):
         ctx = {"project": project, "path": path, "module": module,
-               "qual": qual, "cls": rec["cls"]}
+               "qual": qual, "cls": rec["cls"], "closures": {}}
         st = _KeyState()
         params = rec["params"]
         if rec["cls"] is not None and params[:1] == ["self"]:

@@ -28,11 +28,17 @@ the flow rules need: *what does this dotted callee resolve to*, *does it
 donate and at which positions*, *which locks does it (transitively)
 acquire*, *what are its parameter names*.
 
-Known approximations (see LINTS.md "Known limits"): resolution is
-name-based — values flowing through data structures, constructor
-parameters (``self.apply_fn = apply_fn``), or ``wrap = jax.jit`` escape
-it; attribute types come from constructor-call assignments in the
-class's own methods; inheritance is not walked.
+The v2 model is field- and closure-sensitive (see LINTS.md "What the
+flow model tracks"): constructor-parameter attribute provenance
+(``self.apply_fn = apply_fn`` links the jit binding passed at every
+construction site to every ``self.apply_fn(...)`` call site), nested
+defs and lambdas are lowered with captured-binding (free-variable)
+edges, tuple/dict pack–unpack is tracked one level deep (the
+``lax.scan`` carry shape), ``wrap = jax.jit`` aliases are recognized as
+jit wrappers, and base classes are walked for method/lock/attribute
+identity. Remaining approximations: dynamic dispatch (callables in
+configs, ``getattr``) resolves to nothing, and resolution stays
+intra-package.
 """
 
 from __future__ import annotations
@@ -43,10 +49,17 @@ from typing import Any, Dict, List, Optional, Tuple
 from dalle_tpu.analysis.core import _JIT_LEAVES, dotted_name
 
 #: bump when the summary schema or extraction changes — invalidates
-#: cached summaries (cache.py folds this into its version key)
-SUMMARY_SCHEMA = 3
+#: cached summaries (cache.py folds this into its summary key; per-file
+#: findings of unchanged rules survive a schema-only bump)
+SUMMARY_SCHEMA = 4
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: receiver methods that store an argument INTO the receiver — the
+#: container-escape edge donated-escape tracks (`pending.append(state)`)
+_CONTAINER_STORE_METHS = {"append", "appendleft", "add", "put",
+                          "put_nowait", "insert", "extend", "push",
+                          "setdefault"}
 
 
 def module_name_for(path: str) -> str:
@@ -77,30 +90,37 @@ def _argnums(call: ast.Call, kw_name: str) -> List[int]:
     return []
 
 
-def jit_call_info(call: ast.Call) -> Optional[Dict[str, List[int]]]:
+def _names_jit(dotted: Optional[str], aliases=frozenset()) -> bool:
+    """``dotted`` denotes the jit wrapper itself: ``jax.jit`` / ``pjit``
+    or a recorded alias of one (``wrap = jax.jit``)."""
+    return dotted is not None and (
+        dotted.split(".")[-1] in _JIT_LEAVES or dotted in aliases)
+
+
+def jit_call_info(call: ast.Call, aliases=frozenset()
+                  ) -> Optional[Dict[str, List[int]]]:
     """``{'donate': [...], 'static': [...]}`` when ``call`` is a direct
-    jit wrap: ``jax.jit(f, ...)`` / ``pjit(f, ...)``. Returns None for
-    anything else (including ``partial`` — see :func:`jit_deco_info`)."""
-    d = dotted_name(call.func)
-    if d is not None and d.split(".")[-1] in _JIT_LEAVES and call.args:
+    jit wrap: ``jax.jit(f, ...)`` / ``pjit(f, ...)`` / ``wrap(f, ...)``
+    through a recorded alias. Returns None for anything else (including
+    ``partial`` — see :func:`jit_deco_info`)."""
+    if _names_jit(dotted_name(call.func), aliases) and call.args:
         return {"donate": _argnums(call, "donate_argnums"),
                 "static": _argnums(call, "static_argnums")}
     return None
 
 
-def jit_deco_info(deco: ast.AST) -> Optional[Dict[str, List[int]]]:
-    """jit info for a decorator expression: ``@jax.jit`` (bare),
-    ``@functools.partial(jax.jit, donate_argnums=...)``, or
-    ``@pjit``-style names."""
-    d = dotted_name(deco)
-    if d is not None and d.split(".")[-1] in _JIT_LEAVES:
+def jit_deco_info(deco: ast.AST, aliases=frozenset()
+                  ) -> Optional[Dict[str, List[int]]]:
+    """jit info for a decorator expression: ``@jax.jit`` (bare, or an
+    alias of it), ``@functools.partial(jax.jit, donate_argnums=...)``,
+    or ``@pjit``-style names."""
+    if _names_jit(dotted_name(deco), aliases):
         return {"donate": [], "static": []}
     if isinstance(deco, ast.Call):
         callee = dotted_name(deco.func)
         if callee is not None and callee.split(".")[-1] == "partial" \
                 and deco.args:
-            inner = dotted_name(deco.args[0])
-            if inner is not None and inner.split(".")[-1] in _JIT_LEAVES:
+            if _names_jit(dotted_name(deco.args[0]), aliases):
                 return {"donate": _argnums(deco, "donate_argnums"),
                         "static": _argnums(deco, "static_argnums")}
     return None
@@ -117,15 +137,33 @@ def _is_lock_ctor(value: ast.AST) -> bool:
 # Ops (JSON dicts, evaluation order within each statement):
 #   {"t": "read",   "n": dotted, "l": line}
 #   {"t": "call",   "fn": dotted|None, "inner": dotted|None,
-#    "jit": {...}|None, "args": [dotted|None, ...], "l": line}
+#    "jit": {...}|None, "args": [dotted|None, ...],
+#    "kw": {name: dotted}|absent, "l": line}
 #       fn:    the callee when it is a plain name/attribute chain
 #       inner: when the callee is itself a call (factory pattern
 #              `_chunk_fn(cfg)(params, state)`), the inner callee's name
 #       jit:   set when the callee is a direct `jax.jit(f, ...)` call —
 #              the immediate-call form donates on THIS call's args
-#   {"t": "assign", "tg": [dotted, ...], "src": "key"|"name:<d>"|None}
-#       src tags the RHS for the rng rule: "key" = a fresh
-#       PRNGKey/split/fold_in result, "name:<d>" = a plain alias copy
+#       kw:    keyword args whose values are plain dotted names (the
+#              constructor-provenance pass maps them to params)
+#   {"t": "assign", "tg": [dotted, ...], "src":
+#        "key"|"name:<d>"|"pack:<d0>,<d1>,..."|"unpack:<d>"|
+#        "item:<d>:<key>"|None}
+#       src tags the RHS: "key" = a fresh PRNGKey/split/fold_in result,
+#       "name:<d>" = a plain alias copy, "pack:..." = a tuple/list
+#       literal of the named elements (empty slot = non-name),
+#       "unpack:<d>" = tg are the POSITIONAL elements of <d>
+#       (`cache, cur, rng = carry` — the scan-carry shape),
+#       "item:<d>:<key>" = one element (`rng = carry[2]`, `k = d["rng"]`)
+#   {"t": "escape", "h": dotted, "vs": [dotted, ...], "l": line}
+#       a binding stored INTO a holder it does not rebind: a subscript
+#       store (`d[k] = state`) or a container-store method call
+#       (`pending.append(state)`). Attribute stores (`self.x = state`)
+#       ride the plain assign op (the dotted target IS the holder).
+#   {"t": "closure","n": name|None, "frees": [dotted, ...], "l": line}
+#       a nested def (n = its name) or lambda (n = None) whose body
+#       reads the listed enclosing-scope bindings; the body itself is
+#       lowered as its own function record
 #   {"t": "with",   "locks": [dotted, ...], "l": line, "b": Block}
 #   {"t": "branch", "bs": [Block, ...]}
 #   {"t": "loop",   "b": Block}
@@ -170,9 +208,24 @@ class _Summarizer(ast.NodeVisitor):
             "suppress": {},         # line -> [rule, ...]
         }
         tree = ast.parse(source)
+        # prepass: `wrap = jax.jit` aliases anywhere in the file, so the
+        # indirect-wrapping form (`f = wrap(g, donate_argnums=0)`) is a
+        # recognized jit binding wherever it appears
+        self.jit_aliases: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, (ast.Name, ast.Attribute)):
+                d = dotted_name(node.value)
+                if d is not None and d.split(".")[-1] in _JIT_LEAVES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.jit_aliases.add(t.id)
         self._collect_imports(tree)
         for node in tree.body:
             self._top_level(node)
+
+    def _jit_info(self, call: ast.Call) -> Optional[Dict[str, List[int]]]:
+        return jit_call_info(call, self.jit_aliases)
 
     # -- imports ----------------------------------------------------------
 
@@ -214,17 +267,20 @@ class _Summarizer(ast.NodeVisitor):
                 if _is_lock_ctor(value):
                     self.summary["module_locks"].append(name)
                 elif isinstance(value, ast.Call):
-                    info = jit_call_info(value)
+                    info = self._jit_info(value)
                     if info is not None:
                         self.summary["module_jit"][name] = info
 
     def _class(self, node: ast.ClassDef) -> None:
         cls: Dict[str, Any] = {
             "line": node.lineno,
+            "bases": [d for d in (dotted_name(b) for b in node.bases)
+                      if d is not None],
             "attr_types": {},     # self.X = SomeClass(...) -> callee name
             "lock_attrs": [],
             "lock_aliases": {},   # Condition(self._lock) sharing
             "jit_attrs": {},      # self.X = jax.jit(...) -> info
+            "param_attrs": {},    # self.X = <ctor param> -> param name
         }
         self.summary["classes"][node.name] = cls
         for item in node.body:
@@ -235,6 +291,11 @@ class _Summarizer(ast.NodeVisitor):
 
     def _scan_self_assigns(self, meth: ast.AST, cls: Dict[str, Any]
                            ) -> None:
+        ctor_params: set = set()
+        if getattr(meth, "name", "") == "__init__":
+            a = meth.args
+            ctor_params = {x.arg for x in (a.posonlyargs + a.args
+                                           + a.kwonlyargs)}
         for node in ast.walk(meth):
             if not isinstance(node, ast.Assign):
                 continue
@@ -258,6 +319,13 @@ class _Summarizer(ast.NodeVisitor):
                     if attr not in cls["lock_attrs"]:
                         cls["lock_attrs"].append(attr)
                     continue
+                if isinstance(value, ast.Name) \
+                        and value.id in ctor_params:
+                    # `self.apply_fn = apply_fn`: attribute provenance —
+                    # the Project links every construction site's
+                    # argument to this attribute's call sites
+                    cls["param_attrs"].setdefault(attr, value.id)
+                    continue
                 calls = []
                 if isinstance(value, ast.Call):
                     calls = [value]
@@ -267,7 +335,7 @@ class _Summarizer(ast.NodeVisitor):
                     calls = [v for v in value.values
                              if isinstance(v, ast.Call)]
                 for c in calls:
-                    info = jit_call_info(c)
+                    info = self._jit_info(c)
                     if info is not None:
                         cls["jit_attrs"][attr] = info
                         break
@@ -280,14 +348,14 @@ class _Summarizer(ast.NodeVisitor):
     # -- functions ---------------------------------------------------------
 
     def _function(self, node: ast.AST, qual_prefix: str,
-                  cls: Optional[str]) -> None:
+                  cls: Optional[str]) -> dict:
         qual = qual_prefix + node.name
         a = node.args
         params = [x.arg for x in (a.posonlyargs + a.args)]
         donates = None
         is_property = False
         for deco in node.decorator_list:
-            info = jit_deco_info(deco)
+            info = jit_deco_info(deco, self.jit_aliases)
             if info is not None:
                 donates = info
             leaf = (dotted_name(deco) or "").split(".")[-1]
@@ -295,7 +363,7 @@ class _Summarizer(ast.NodeVisitor):
                 is_property = True
         emitter = _BodyEmitter(self, qual_prefix=qual + ".", cls=cls)
         body = emitter.block(node.body)
-        self.summary["functions"][qual] = {
+        rec = {
             "line": node.lineno,
             "cls": cls,
             "params": params,
@@ -306,12 +374,137 @@ class _Summarizer(ast.NodeVisitor):
             "is_property": is_property,
             "body": body,
         }
+        self.summary["functions"][qual] = rec
+        return rec
+
+    def _lambda(self, node: ast.Lambda, qual_prefix: str,
+                cls: Optional[str]) -> dict:
+        """Lower a lambda body as its own function record (so a lambda
+        handed to ``jax.jit`` participates in the rng/donate flow like a
+        named def)."""
+        qual = f"{qual_prefix}<lambda:{node.lineno}>"
+        a = node.args
+        params = [x.arg for x in (a.posonlyargs + a.args)]
+        emitter = _BodyEmitter(self, qual_prefix=qual + ".", cls=cls)
+        body: List[dict] = []
+        emitter.expr(node.body, body)
+        body.append({"t": "term"})
+        rec = {
+            "line": node.lineno, "cls": cls, "params": params,
+            "jit": None, "returns_jit": None,
+            "jit_locals": emitter.jit_locals,
+            "local_locks": emitter.local_locks,
+            "is_property": False, "body": body,
+        }
+        self.summary["functions"][qual] = rec
+        return rec
+
+
+def _value_names(value: Optional[ast.AST]) -> List[str]:
+    """Dotted names a RHS value stores: the name itself, tuple/list/set
+    elements, dict values — one level of nesting each way."""
+    if value is None:
+        return []
+    if isinstance(value, (ast.Name, ast.Attribute)):
+        d = dotted_name(value)
+        return [d] if d is not None else []
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        out: List[str] = []
+        for e in value.elts:
+            out.extend(_value_names(e))
+        return out
+    if isinstance(value, ast.Dict):
+        out = []
+        for v in value.values:
+            out.extend(_value_names(v))
+        return out
+    return []
+
+
+def _const_key(node: ast.AST) -> Optional[str]:
+    """A constant int/str subscript key, as the stable string the
+    pack/item srcs use; None for anything dynamic."""
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (int, str)) \
+            and not isinstance(node.value, bool):
+        s = str(node.value)
+        if all(c not in s for c in ",:="):
+            return s
+    return None
+
+
+def _collect_frees(rec: dict, own_name: Optional[str] = None) -> List[str]:
+    """Free dotted names a lowered function record reads: everything it
+    reads/calls/stores whose root is neither a parameter nor locally
+    assigned (Python scoping: a name assigned anywhere in the body is
+    local for the WHOLE body). Over-approximates — module refs like
+    ``jnp.sum`` appear too — which is safe: the flow walkers only
+    intersect frees with their tracked binding sets."""
+    reads: List[str] = []
+    assigned: set = set()
+
+    def walk(block: List[dict]) -> None:
+        for op in block:
+            t = op["t"]
+            if t == "read":
+                reads.append(op["n"])
+            elif t == "call":
+                for nm in [op.get("fn")] + list(op.get("args") or ()):
+                    if nm:
+                        reads.append(nm)
+                for nm in (op.get("kw") or {}).values():
+                    if nm:
+                        reads.append(nm)
+            elif t == "assign":
+                for tg in op["tg"]:
+                    assigned.add(tg.split(".")[0])
+                src = op.get("src")
+                if not src:
+                    continue
+                if src.startswith("name:"):
+                    reads.append(src[5:])
+                elif src.startswith(("unpack:", "item:")):
+                    reads.append(src.split(":", 2)[1])
+                elif src.startswith("pack:"):
+                    reads.extend(x for x in src[5:].split(",") if x)
+                elif src.startswith("dpack:"):
+                    reads.extend(kv.split("=", 1)[1]
+                                 for kv in src[6:].split(",") if "=" in kv)
+            elif t == "escape":
+                reads.append(op["h"])
+                reads.extend(op["vs"])
+            elif t == "closure":
+                reads.extend(op["frees"])
+            elif t == "with":
+                reads.extend(op.get("locks", ()))
+                walk(op["b"])
+            elif t == "branch":
+                for b in op["bs"]:
+                    walk(b)
+            elif t == "loop":
+                walk(op["b"])
+
+    walk(rec["body"])
+    bound = set(rec["params"])
+    if own_name:
+        bound.add(own_name)
+    out: List[str] = []
+    seen: set = set()
+    for n in reads:
+        root = n.split(".")[0]
+        if root in bound or root in assigned or n in seen:
+            continue
+        seen.add(n)
+        out.append(n)
+    return out
 
 
 class _BodyEmitter:
-    """Lowers one function body to the flow IR (nested defs recurse into
-    :meth:`_Summarizer._function` and contribute no ops — a closure
-    read of a donated binding is a documented false negative)."""
+    """Lowers one function body to the flow IR. Nested defs and lambdas
+    recurse into :meth:`_Summarizer._function`/:meth:`_lambda` AND leave
+    a ``closure`` op carrying their free (captured) names behind — the
+    edge that connects a closure read of a binding its encloser donated
+    (v1's documented false negative)."""
 
     def __init__(self, summarizer: _Summarizer, qual_prefix: str,
                  cls: Optional[str]):
@@ -338,7 +531,12 @@ class _BodyEmitter:
             self._call(node, out)
             return
         if isinstance(node, ast.Lambda):
-            return  # separate scope; not lowered (documented limit)
+            # lowered as its own function record; the closure op carries
+            # the captured names to the walkers at the occurrence site
+            rec = self.s._lambda(node, self.qual_prefix, self.cls)
+            out.append({"t": "closure", "n": None,
+                        "frees": _collect_frees(rec), "l": node.lineno})
+            return
         if isinstance(node, ast.NamedExpr):
             self.expr(node.value, out)
             self._assign([node.target], node.value, out)
@@ -361,7 +559,7 @@ class _BodyEmitter:
             # factory / immediate-jit form: f(...)(args)
             self._call(node.func, out)
             inner = dotted_name(node.func.func)
-            jit = jit_call_info(node.func)
+            jit = self.s._jit_info(node.func)
         elif fn is None:
             self.expr(node.func, out)
         elif isinstance(node.func, ast.Attribute):
@@ -375,10 +573,30 @@ class _BodyEmitter:
             d = dotted_name(arg)
             self.expr(arg, out)
             args.append(d)
-        for kw in node.keywords:
-            self.expr(kw.value, out)
-        out.append({"t": "call", "fn": fn, "inner": inner, "jit": jit,
-                    "args": args, "l": node.lineno})
+        kw: Dict[str, str] = {}
+        for k in node.keywords:
+            self.expr(k.value, out)
+            if k.arg is not None:
+                d = dotted_name(k.value)
+                if d is not None:
+                    kw[k.arg] = d
+        if fn is not None and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _CONTAINER_STORE_METHS:
+            # `pending.append(state)` / `q.put((rid, state))`: the
+            # receiver now holds the argument — the container-escape
+            # edge donated-escape follows
+            base = dotted_name(node.func.value)
+            vs = []
+            for arg in node.args:
+                vs.extend(_value_names(arg))
+            if base is not None and vs:
+                out.append({"t": "escape", "h": base, "vs": vs,
+                            "l": node.lineno})
+        op = {"t": "call", "fn": fn, "inner": inner, "jit": jit,
+              "args": args, "l": node.lineno}
+        if kw:
+            op["kw"] = kw
+        out.append(op)
 
     # -- statements --------------------------------------------------------
 
@@ -390,26 +608,48 @@ class _BodyEmitter:
 
     def _assign(self, targets: List[ast.AST], value: Optional[ast.AST],
                 out: List[dict]) -> None:
+        # positional unpack of a named binding — the lax.scan carry
+        # shape (`cache, cur_input, rng = carry`): tg are POSITIONAL
+        if (value is not None and len(targets) == 1
+                and isinstance(targets[0], (ast.Tuple, ast.List))
+                and targets[0].elts
+                and all(isinstance(e, ast.Name)
+                        for e in targets[0].elts)
+                and isinstance(value, (ast.Name, ast.Attribute))):
+            vd = dotted_name(value)
+            if vd is not None:
+                out.append({"t": "assign",
+                            "tg": [e.id for e in targets[0].elts],
+                            "src": "unpack:" + vd})
+                return
         names: List[str] = []
-        for t in targets:
-            stack = [t]
-            while stack:
-                cur = stack.pop()
-                if isinstance(cur, (ast.Tuple, ast.List)):
-                    stack.extend(cur.elts)
-                elif isinstance(cur, ast.Starred):
-                    stack.append(cur.value)
-                elif isinstance(cur, ast.Subscript):
-                    # writing INTO a buffer is a read of the binding,
-                    # never a rebind
+
+        def collect(cur: ast.AST) -> None:
+            if isinstance(cur, (ast.Tuple, ast.List)):
+                for e in cur.elts:
+                    collect(e)
+            elif isinstance(cur, ast.Starred):
+                collect(cur.value)
+            elif isinstance(cur, ast.Subscript):
+                # writing INTO a buffer is a read of the binding,
+                # never a rebind; a named RHS stored through it is a
+                # container escape (`d[k] = state`)
+                self.expr(cur.value, out)
+                self.expr(cur.slice, out)
+                holder = dotted_name(cur.value)
+                vs = _value_names(value)
+                if holder is not None and vs:
+                    out.append({"t": "escape", "h": holder, "vs": vs,
+                                "l": cur.lineno})
+            else:
+                d = dotted_name(cur)
+                if d is not None:
+                    names.append(d)
+                elif isinstance(cur, ast.Attribute):
                     self.expr(cur.value, out)
-                    self.expr(cur.slice, out)
-                else:
-                    d = dotted_name(cur)
-                    if d is not None:
-                        names.append(d)
-                    elif isinstance(cur, ast.Attribute):
-                        self.expr(cur.value, out)
+
+        for t in targets:
+            collect(t)
         src = None
         if isinstance(value, ast.Call):
             callee = dotted_name(value.func)
@@ -419,6 +659,29 @@ class _BodyEmitter:
             d = dotted_name(value)
             if d is not None:
                 src = "name:" + d
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            elts = [dotted_name(e)
+                    if isinstance(e, (ast.Name, ast.Attribute)) else None
+                    for e in value.elts]
+            if any(elts):
+                src = "pack:" + ",".join(e or "" for e in elts)
+        elif isinstance(value, ast.Dict):
+            pairs = []
+            for kx, vx in zip(value.keys, value.values):
+                if kx is None or not isinstance(
+                        vx, (ast.Name, ast.Attribute)):
+                    continue
+                kk = _const_key(kx)
+                vv = dotted_name(vx)
+                if kk is not None and vv is not None:
+                    pairs.append(f"{kk}={vv}")
+            if pairs:
+                src = "dpack:" + ",".join(pairs)
+        elif isinstance(value, ast.Subscript):
+            base = dotted_name(value.value)
+            k = _const_key(value.slice)
+            if base is not None and k is not None:
+                src = f"item:{base}:{k}"
         if names:
             out.append({"t": "assign", "tg": names, "src": src})
 
@@ -428,7 +691,7 @@ class _BodyEmitter:
         names and self-attributes)."""
         if not isinstance(value, ast.Call):
             return
-        info = jit_call_info(value)
+        info = self.s._jit_info(value)
         is_lock = _is_lock_ctor(value)
         if info is None and not is_lock:
             return
@@ -444,8 +707,11 @@ class _BodyEmitter:
 
     def stmt(self, stmt: ast.stmt, out: List[dict]) -> None:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            self.s._function(stmt, qual_prefix=self.qual_prefix,
-                             cls=self.cls)
+            rec = self.s._function(stmt, qual_prefix=self.qual_prefix,
+                                   cls=self.cls)
+            out.append({"t": "closure", "n": stmt.name,
+                        "frees": _collect_frees(rec, own_name=stmt.name),
+                        "l": stmt.lineno})
             return
         if isinstance(stmt, ast.ClassDef):
             return  # nested classes: out of scope
@@ -470,7 +736,7 @@ class _BodyEmitter:
             return
         if isinstance(stmt, ast.Return):
             if isinstance(stmt.value, ast.Call):
-                info = jit_call_info(stmt.value)
+                info = self.s._jit_info(stmt.value)
                 if info is not None:
                     self.returns_jit = info
             self.expr(stmt.value, out)
@@ -592,6 +858,12 @@ class Project:
                         # dotted path resolvable too
                         amap[target] = ("mod", target)
             self._aliases[sm["module"]] = amap
+        #: (module, Class) -> {attr: jit info}: attribute provenance —
+        #: `self.apply_fn = apply_fn` in a ctor whose construction sites
+        #: pass a jit binding for that parameter (the shape the trainer's
+        #: CollaborativeOptimizer uses for its donated apply step)
+        self._ctor_jit_attrs: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        self._link_ctor_params()
 
     # -- lookup helpers ----------------------------------------------------
 
@@ -606,6 +878,150 @@ class Project:
         if path is None:
             return None
         return self.files[path]["classes"].get(name)
+
+    # -- inheritance -------------------------------------------------------
+
+    def cls_mro(self, module: str, name: str
+                ) -> List[Tuple[str, str, dict]]:
+        """The class and its project-resolvable bases, nearest first —
+        how base-class locks, attribute types, jit attributes, and
+        methods become visible from a subclass (v1's documented
+        inheritance blind spot)."""
+        out: List[Tuple[str, str, dict]] = []
+        seen: set = set()
+        queue: List[Tuple[str, str]] = [(module, name)]
+        while queue:
+            m, n = queue.pop(0)
+            if (m, n) in seen:
+                continue
+            seen.add((m, n))
+            c = self.cls(m, n)
+            if c is None:
+                continue
+            out.append((m, n, c))
+            for b in c.get("bases", ()):
+                rb = self._resolve_class_name(m, b)
+                if rb is not None:
+                    queue.append(rb)
+        return out
+
+    def _resolve_class_name(self, module: str, dotted: str
+                            ) -> Optional[Tuple[str, str]]:
+        """A class-naming expression (`Base`, `mod.Base`) -> its
+        defining (module, name), or None outside the project."""
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            r = self._resolve_symbol(module, parts[0])
+            if r is not None and r[0] == "class":
+                return (r[1], r[2])
+            return None
+        amap = self._aliases.get(module, {})
+        for cut in range(len(parts) - 1, 0, -1):
+            head = ".".join(parts[:cut])
+            alias = amap.get(head)
+            if alias is None:
+                continue
+            if alias[0] != "mod":
+                return None
+            target_mod = alias[1]
+            rest = parts[cut:]
+            while len(rest) > 1 and f"{target_mod}.{rest[0]}" \
+                    in self.modules:
+                target_mod = f"{target_mod}.{rest[0]}"
+                rest = rest[1:]
+            if len(rest) == 1:
+                r = self._resolve_symbol(target_mod, rest[0])
+                if r is not None and r[0] == "class":
+                    return (r[1], r[2])
+            return None
+        return None
+
+    # -- constructor-parameter attribute provenance ------------------------
+
+    def _link_ctor_params(self) -> None:
+        """One pass over every call op: a construction site whose class
+        stores a ctor parameter into an attribute (`self.apply_fn =
+        apply_fn`) links the argument's jit identity to that attribute,
+        so `self.apply_fn(...)` call sites resolve to the jit binding
+        that was passed in."""
+        for path, module, qual, rec in iter_functions(self):
+
+            def visit(block: List[dict]) -> None:
+                for op in block:
+                    t = op["t"]
+                    if t == "call" and op.get("fn"):
+                        self._link_one_call(module, rec["cls"], qual, op)
+                    elif t == "with":
+                        visit(op["b"])
+                    elif t == "branch":
+                        for b in op["bs"]:
+                            visit(b)
+                    elif t == "loop":
+                        visit(op["b"])
+
+            visit(rec["body"])
+
+    def _link_one_call(self, module: str, cls: Optional[str],
+                       qual: str, op: dict) -> None:
+        r = self.resolve_callee(module, cls, qual, op["fn"])
+        if r is None or r[0] != "class":
+            return
+        _k, cmod, cname = r
+        param_attrs: Dict[str, str] = {}
+        init_params: List[str] = []
+        for m, n, c in self.cls_mro(cmod, cname):
+            for attr, param in c.get("param_attrs", {}).items():
+                param_attrs.setdefault(attr, param)
+            if not init_params:
+                init = self.function(m, f"{n}.__init__")
+                if init is not None:
+                    init_params = init["params"][1:]   # drop self
+        if not param_attrs or not init_params:
+            return
+        kw = op.get("kw") or {}
+        for attr, param in param_attrs.items():
+            dotted = kw.get(param)
+            if dotted is None and param in init_params:
+                idx = init_params.index(param)
+                args = op.get("args") or []
+                if idx < len(args):
+                    dotted = args[idx]
+            if dotted is None:
+                continue
+            info = self._jit_value_info(module, cls, qual, dotted)
+            if info is not None:
+                self._ctor_jit_attrs.setdefault(
+                    (cmod, cname), {}).setdefault(attr, info)
+
+    def _jit_value_info(self, module: str, cls: Optional[str],
+                        qual: str, dotted: str) -> Optional[dict]:
+        """jit info for a dotted VALUE expression: a jit binding name, or
+        a property whose getter returns a jit (reading `task.apply_step`
+        yields the jitted callable)."""
+        r = self.resolve_callee(module, cls, qual, dotted)
+        if r is None:
+            return None
+        if r[0] == "jit":
+            return r[1]
+        if r[0] == "fn":
+            rec = self.function(r[1], r[2])
+            if rec is not None and rec["is_property"] \
+                    and rec["returns_jit"]:
+                return rec["returns_jit"]
+        return None
+
+    def _norm(self, r: Optional[Tuple]) -> Optional[Tuple]:
+        """Normalize a ``("jit-name", module, sym)`` resolution to the
+        ``("jit", info)`` form every consumer understands — this is what
+        lets a FROM-IMPORTED jit binding donate like a local one."""
+        if r is not None and r[0] == "jit-name":
+            path = self.modules.get(r[1])
+            if path is not None:
+                info = self.files[path]["module_jit"].get(r[2])
+                if info is not None:
+                    return ("jit", info)
+            return None
+        return r
 
     def _resolve_symbol(self, module: str, sym: str
                         ) -> Optional[Tuple[str, str, str]]:
@@ -641,27 +1057,33 @@ class Project:
         parts = dotted.split(".")
         # self.<...>
         if parts[0] == "self" and cls is not None:
-            c = self.cls(module, cls)
-            if c is None or len(parts) < 2:
+            mro = self.cls_mro(module, cls)
+            if not mro or len(parts) < 2:
                 return None
             if len(parts) == 2:
                 attr = parts[1]
-                if attr in c["jit_attrs"]:
-                    return ("jit", c["jit_attrs"][attr])
-                meth = self.function(module, f"{cls}.{attr}")
-                if meth is not None:
-                    return ("fn", module, f"{cls}.{attr}")
+                for m, n, c in mro:
+                    info = c["jit_attrs"].get(attr) \
+                        or self._ctor_jit_attrs.get((m, n), {}).get(attr)
+                    if info is not None:
+                        return ("jit", info)
+                    meth = self.function(m, f"{n}.{attr}")
+                    if meth is not None:
+                        return ("fn", m, f"{n}.{attr}")
                 return None
             if len(parts) == 3:
-                ty = c["attr_types"].get(parts[1])
-                if ty is None:
-                    return None
-                r = self.resolve_callee(module, None, func_qual, ty)
-                if r is not None and r[0] == "class":
-                    _kind, tmod, tcls = r
-                    meth = self.function(tmod, f"{tcls}.{parts[2]}")
-                    if meth is not None:
-                        return ("fn", tmod, f"{tcls}.{parts[2]}")
+                for m, n, c in mro:
+                    ty = c["attr_types"].get(parts[1])
+                    if ty is None:
+                        continue
+                    r = self.resolve_callee(m, None, func_qual, ty)
+                    if r is not None and r[0] == "class":
+                        _kind, tmod, tcls = r
+                        for m2, n2, _c2 in self.cls_mro(tmod, tcls):
+                            meth = self.function(m2, f"{n2}.{parts[2]}")
+                            if meth is not None:
+                                return ("fn", m2, f"{n2}.{parts[2]}")
+                    break
             return None
         # function-local / enclosing-function jit bindings
         if len(parts) == 1:
@@ -683,7 +1105,7 @@ class Project:
                 sm = self.files[path]
                 if dotted in sm["module_jit"]:
                     return ("jit", sm["module_jit"][dotted])
-            return self._resolve_symbol(module, dotted)
+            return self._norm(self._resolve_symbol(module, dotted))
         # module-alias dotted call: m.f / pkg.sub.f / Class.method
         amap = self._aliases.get(module, {})
         for cut in range(len(parts) - 1, 0, -1):
@@ -700,7 +1122,8 @@ class Project:
                     target_mod = f"{target_mod}.{rest[0]}"
                     rest = rest[1:]
                 if len(rest) == 1:
-                    return self._resolve_symbol(target_mod, rest[0])
+                    return self._norm(
+                        self._resolve_symbol(target_mod, rest[0]))
                 if len(rest) == 2:
                     r = self._resolve_symbol(target_mod, rest[0])
                     if r is not None and r[0] == "class":
@@ -764,20 +1187,40 @@ class Project:
 
     # -- lock identity -----------------------------------------------------
 
+    def _cls_lock_id(self, module: str, name: str, attr: str
+                     ) -> Optional[str]:
+        """Lock identity for ``<instance of (module, name)>.<attr>``,
+        walking base classes and dereferencing Condition-on-lock
+        aliases; anchored at the DEFINING class so a base-class lock is
+        ONE node no matter which subclass acquires it."""
+        for m, n, c in self.cls_mro(module, name):
+            a = c["lock_aliases"].get(attr, attr)
+            if a in c["lock_attrs"]:
+                return f"{m}:{n}.{a}"
+        return None
+
     def lock_id(self, module: str, cls: Optional[str], func_qual: str,
                 dotted: str) -> Optional[str]:
         """Stable identity for an acquired lock: ``module:Class.attr``
-        for self-attributes (Condition-on-lock aliases dereferenced),
-        ``module:name`` for module globals, ``module:qual.name`` for
-        function locals. None when the name is not a known lock."""
+        for self-attributes (Condition-on-lock aliases dereferenced,
+        base classes walked), ``module:name`` for module globals,
+        ``module:qual.name`` for function locals. ``self.<attr>.<lock>``
+        dereferences the attribute's constructed type
+        (``self.metrics._lock`` -> ``ServingMetrics._lock``). None when
+        the name is not a known lock."""
         if dotted.startswith("self.") and cls is not None:
-            c = self.cls(module, cls)
-            if c is None:
-                return None
-            attr = dotted.split(".", 1)[1]
-            attr = c["lock_aliases"].get(attr, attr)
-            if attr in c["lock_attrs"]:
-                return f"{module}:{cls}.{attr}"
+            parts = dotted.split(".")
+            if len(parts) == 2:
+                return self._cls_lock_id(module, cls, parts[1])
+            if len(parts) == 3:
+                for m, n, c in self.cls_mro(module, cls):
+                    ty = c["attr_types"].get(parts[1])
+                    if ty is None:
+                        continue
+                    r = self.resolve_callee(m, None, func_qual, ty)
+                    if r is not None and r[0] == "class":
+                        return self._cls_lock_id(r[1], r[2], parts[2])
+                    break
             return None
         qual_parts = func_qual.split(".")
         for depth in range(len(qual_parts), 0, -1):

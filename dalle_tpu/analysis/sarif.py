@@ -37,13 +37,20 @@ def _pairs(findings: Iterable[Finding],
 
 
 def to_json(findings: Iterable[Finding],
-            exclude_fingerprints: FrozenSet[str] = frozenset()) -> str:
+            exclude_fingerprints: FrozenSet[str] = frozenset(),
+            stats: object = None) -> str:
+    """Finding dicts + fingerprints; ``stats`` (when provided by the
+    scan) adds the per-rule finding/timing ledger so a new rule's CI
+    budget cost is visible the day it lands."""
     out = []
     for f, fp in _pairs(findings, exclude_fingerprints):
         d = f.to_dict()
         d["fingerprint"] = fp
         out.append(d)
-    return json.dumps({"findings": out}, indent=1)
+    doc = {"findings": out}
+    if stats:
+        doc["stats"] = stats
+    return json.dumps(doc, indent=1)
 
 
 def to_sarif(findings: Iterable[Finding],

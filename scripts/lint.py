@@ -6,6 +6,7 @@ Usage:
     python scripts/lint.py --check              # exit 1 on unbaselined
     python scripts/lint.py --check --diff       # changed files only
     python scripts/lint.py --write-baseline     # triage current findings
+    python scripts/lint.py --prune-stale        # drop fixed baseline rows
     python scripts/lint.py --format sarif       # SARIF 2.1.0 to stdout
     python scripts/lint.py --jobs 0             # parallel scan (cpu count)
     python scripts/lint.py --list-rules
@@ -38,7 +39,7 @@ sys.path.insert(0, _REPO)
 
 from dalle_tpu.analysis import (all_rules, analyze_paths,  # noqa: E402
                                 diff_baseline, load_baseline,
-                                save_baseline)
+                                prune_stale_baseline, save_baseline)
 from dalle_tpu.analysis import sarif  # noqa: E402
 
 
@@ -80,6 +81,10 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="write the current findings to the "
                              "baseline file (triage step)")
+    parser.add_argument("--prune-stale", action="store_true",
+                        help="drop baseline entries whose finding no "
+                             "longer exists (the shrink half of the "
+                             "ratchet), then continue as usual")
     parser.add_argument("--rule", action="append", dest="rules",
                         help="restrict to specific rule id(s)")
     parser.add_argument("--diff", action="store_true",
@@ -124,9 +129,23 @@ def main(argv=None) -> int:
                   "full scan", file=sys.stderr)
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     cache_path = None if args.no_cache else args.cache
+    stats = {}
     findings = analyze_paths(paths, root=_REPO, rules=args.rules,
                              jobs=jobs, cache_path=cache_path,
-                             changed_only=changed_only)
+                             changed_only=changed_only, stats=stats)
+
+    if args.prune_stale:
+        if scoped:
+            # a restricted scan cannot tell "fixed" from "out of
+            # scope": pruning on it would evict live triaged entries
+            print("--prune-stale requires the full default scope "
+                  "(no path arguments, no --rule, no --diff)",
+                  file=sys.stderr)
+            return 2
+        pruned = prune_stale_baseline(args.baseline, findings)
+        print(f"pruned {pruned} stale baseline entr"
+              f"{'y' if pruned == 1 else 'ies'} from {args.baseline}",
+              file=sys.stderr)
 
     if args.write_baseline:
         if scoped:
@@ -144,6 +163,13 @@ def main(argv=None) -> int:
 
     baseline = load_baseline(args.baseline)
     fresh, stale = diff_baseline(findings, baseline)
+    # stale entries (baselined findings that no longer exist — fixes)
+    # FAIL --check: the ratchet only shrinks, and it shrinks in the
+    # same commit as the fix, enforced by CI rather than convention.
+    # Suppressed under a restricted scope: out-of-scope baseline
+    # entries are invisible to this scan, not fixed.
+    stale_fails = bool(stale) and not scoped
+    check_rc = 1 if (args.check and (fresh or stale_fails)) else 0
 
     # --check reporting excludes by baseline fingerprint rather than
     # serializing the `fresh` list: fingerprints must be computed over
@@ -151,27 +177,27 @@ def main(argv=None) -> int:
     # fresh duplicate emits its baselined twin's fingerprint
     exclude = frozenset(baseline) if args.check else frozenset()
     if args.format == "json":
-        print(sarif.to_json(findings, exclude_fingerprints=exclude))
-        return 1 if (args.check and fresh) else 0
+        print(sarif.to_json(findings, exclude_fingerprints=exclude,
+                            stats=stats))
+        return check_rc
     if args.format == "sarif":
         print(sarif.to_sarif(findings, exclude_fingerprints=exclude))
-        return 1 if (args.check and fresh) else 0
+        return check_rc
 
     if args.check:
         for f in fresh:
             print(f.format())
             print(f"    {f.snippet}")
-        if stale and not scoped:
-            # suppressed under a restricted scope: out-of-scope baseline
-            # entries are invisible to this scan, not fixed
-            print(f"note: {len(stale)} stale baseline entr"
+        if stale_fails:
+            print(f"{len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
-                  "— shrink the baseline with --write-baseline)")
+                  "must leave the baseline — run --prune-stale)")
         if fresh:
             print(f"\n{len(fresh)} unbaselined finding(s). Fix them, "
                   "suppress with '# graftlint: disable=<rule>' + a "
                   "justification, or triage with --write-baseline.")
-            return 1
+        if check_rc:
+            return check_rc
         print(f"lint clean: {len(findings)} finding(s), all baselined "
               f"({len(baseline)} baseline entries)")
         return 0

@@ -1,0 +1,177 @@
+"""Mutation corpus: hazard injections into the REAL modules.
+
+Each entry names a flow rule, a real source file, an exact-text anchor
+in it, and the replacement that reintroduces the hazard class the rule
+encodes. The harness (`build_project` / `scan_mutated`) assembles the
+whole-program model over the real tree once, then re-summarizes only
+the mutated file per entry — so the corpus stays a few hundred
+milliseconds even though every entry is a full whole-program scan.
+
+Anchors are load-bearing: if a refactor changes the anchored code, the
+corpus FAILS with "anchor drifted" instead of silently mutating
+nothing. Update the anchor together with the refactor — that is the
+moment to re-confirm the rule still sees the new shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    name: str          # stable id (pytest parametrize id)
+    rule: str          # the flow rule that must detect the injection
+    path: str          # repo-relative file the hazard is injected into
+    anchor: str        # exact text that must exist (drift is loud)
+    replacement: str   # the hazardous rewrite
+    why: str           # the bug class this injection simulates
+
+
+MUTATIONS: List[Mutation] = [
+    Mutation(
+        name="engine-chunk-rebind-deleted",
+        rule="use-after-donate",
+        path="dalle_tpu/serving/engine.py",
+        anchor="self._state = _chunk_fn(self._cfg",
+        replacement="_chunk_fn(self._cfg",
+        why="the r9 hot loop donates EngineState through the _chunk_fn "
+            "factory every iteration; deleting the rebind makes the "
+            "next iteration's dispatch read the deleted buffer (the "
+            "loop wrap-around read)",
+    ),
+    Mutation(
+        name="trainer-apply-rebind-deleted",
+        rule="use-after-donate",
+        path="dalle_tpu/swarm/optimizer.py",
+        anchor="self.state = self.apply_step(self.state, grads_tree)",
+        replacement="self.apply_step(self.state, grads_tree)",
+        why="the trainer's donated apply step reaches the optimizer as "
+            "a CONSTRUCTOR PARAMETER (self.apply_step = apply_step, "
+            "fed from task.apply_step's jitted property) — detection "
+            "requires the v2 attribute-provenance link; the very next "
+            "line reads self.state.params through the corpse",
+    ),
+    Mutation(
+        name="decode-sampler-split-deleted",
+        rule="rng-key-reuse",
+        path="dalle_tpu/models/decode.py",
+        anchor="            rng, sub = jax.random.split(rng)\n"
+               "            sampled = sample_logits(sub, logits, "
+               "sampling)",
+        replacement="            probe = jax.random.categorical(rng, "
+                    "logits)\n"
+                    "            sampled = sample_logits(rng, logits, "
+                    "sampling)",
+        why="the decode sampler threads its key through the lax.scan "
+            "carry tuple; deleting the split and drawing twice from "
+            "the carry key correlates every sampled code — detection "
+            "requires the v2 carry-unpack key tracking",
+    ),
+    Mutation(
+        name="engine-metrics-lock-inversion",
+        rule="lock-order-cycle",
+        path="dalle_tpu/serving/engine.py",
+        anchor="    def start(self) -> \"DecodeEngine\":",
+        replacement="    def _probe_metrics_depth(self) -> int:\n"
+                    "        with self.metrics._lock:\n"
+                    "            with self._cv:\n"
+                    "                return len(self._handles)\n"
+                    "\n"
+                    "    def start(self) -> \"DecodeEngine\":",
+        why="the engine's real edge is _cv -> ServingMetrics._lock "
+            "(submit under _cv records into the metrics ledger, lifted "
+            "through the call graph); a method acquiring "
+            "metrics._lock -> _cv closes the cycle — detection "
+            "requires the v2 attribute-path lock identity "
+            "(self.metrics._lock dereferenced through attr_types)",
+    ),
+    Mutation(
+        name="engine-stale-state-stash",
+        rule="donated-escape",
+        path="dalle_tpu/serving/engine.py",
+        anchor="            if self._tracer is None:\n"
+               "                self._state = _chunk_fn(self._cfg, "
+               "self._chunk, visible)(\n"
+               "                    self._params, self._state)",
+        replacement="            self._prev_state = self._state\n"
+                    "            if self._tracer is None:\n"
+                    "                self._state = _chunk_fn(self._cfg, "
+                    "self._chunk, visible)(\n"
+                    "                    self._params, self._state)\n"
+                    "                _stale = self._prev_state.pos",
+        why="stashing the pre-chunk state in an attribute and reading "
+            "it after the donating dispatch is the exact shape a "
+            "unified device-state substrate (ROADMAP direction 5) "
+            "could reintroduce: the holder references the deleted "
+            "buffer",
+    ),
+]
+
+
+# -- harness ---------------------------------------------------------------
+
+def load_tree() -> Dict[str, str]:
+    """{repo-relative path: source} for the real dalle_tpu/ tree."""
+    sources: Dict[str, str] = {}
+    pkg = os.path.join(REPO, "dalle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, REPO).replace(os.sep, "/")
+            with open(p, "r", encoding="utf-8") as fh:
+                sources[rel] = fh.read()
+    return sources
+
+
+def summarize_tree(sources: Dict[str, str]) -> Dict[str, dict]:
+    from dalle_tpu.analysis.project import summarize_source
+    out = {}
+    for rel, src in sources.items():
+        try:
+            out[rel] = summarize_source(rel, src)
+        except SyntaxError:
+            pass
+    return out
+
+
+def run_rule(rule: str, summaries: Dict[str, dict],
+             sources: Dict[str, str]) -> List:
+    from dalle_tpu.analysis.core import PROJECT_RULES, _load_rules
+    from dalle_tpu.analysis.project import Project
+    _load_rules()
+    project = Project(summaries, sources)
+    return [f for f in PROJECT_RULES[rule].fn(project) if f is not None]
+
+
+def scan_mutated(mut: Mutation, sources: Dict[str, str],
+                 summaries: Dict[str, dict]
+                 ) -> Tuple[Optional[str], List]:
+    """Apply one mutation and run its rule over the re-assembled
+    project. Returns (error, findings): error is set when the anchor
+    drifted (the corpus must fail loudly, not skip)."""
+    from dalle_tpu.analysis.project import summarize_source
+    src = sources.get(mut.path)
+    if src is None:
+        return f"{mut.path} is gone — update the corpus", []
+    if mut.anchor not in src:
+        return (f"anchor drifted in {mut.path} — the real code changed; "
+                f"update mutation '{mut.name}' alongside it", [])
+    mutated = dict(sources)
+    mutated[mut.path] = src.replace(mut.anchor, mut.replacement)
+    try:
+        mut_summary = summarize_source(mut.path, mutated[mut.path])
+    except SyntaxError as e:
+        return f"mutation '{mut.name}' does not parse: {e}", []
+    mut_summaries = dict(summaries)
+    mut_summaries[mut.path] = mut_summary
+    findings = run_rule(mut.rule, mut_summaries, mutated)
+    return None, [f for f in findings if f.path == mut.path]

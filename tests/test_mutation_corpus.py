@@ -1,0 +1,79 @@
+"""Tier-1 gate over the graftlint mutation corpus
+(tests/mutation_corpus/): every known hazard class, injected into the
+REAL engine/trainer/model modules, must be detected by its flow rule.
+
+This is the enforced half of the flow-rule contract (LINTS.md "The
+mutation-corpus contract"): the per-rule fixtures prove a rule CAN
+fire; this proves the whole-program approximation still SEES the real
+call sites the rule exists for — the half that rots silently when a
+refactor changes a shape the resolver no longer recognizes. An
+undetected injection fails tier-1; a drifted anchor fails tier-1 too
+(loudly, instead of mutating nothing).
+
+The project model over the unmutated tree is summarized once per
+session; each entry re-summarizes only its mutated file, so the whole
+corpus is one cold-parse plus milliseconds per mutation.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "graftlint_mutation_corpus",
+    os.path.join(REPO, "tests", "mutation_corpus", "corpus.py"))
+corpus = importlib.util.module_from_spec(_spec)
+sys.modules[_spec.name] = corpus   # dataclasses resolves __module__
+_spec.loader.exec_module(corpus)
+
+
+@pytest.fixture(scope="module")
+def tree():
+    sources = corpus.load_tree()
+    summaries = corpus.summarize_tree(sources)
+    return sources, summaries
+
+
+@pytest.fixture(scope="module")
+def clean_by_rule(tree):
+    """Precondition per rule: the UNMUTATED tree is clean, so any
+    finding after an injection is attributable to the injection."""
+    sources, summaries = tree
+    out = {}
+    for rule in sorted({m.rule for m in corpus.MUTATIONS}):
+        out[rule] = corpus.run_rule(rule, summaries, sources)
+    return out
+
+
+def test_corpus_covers_every_flow_rule():
+    """The contract floor: >= 1 injection per registered flow rule —
+    a new flow rule ships with its mutation or fails here."""
+    from dalle_tpu.analysis import PROJECT_RULES
+    covered = {m.rule for m in corpus.MUTATIONS}
+    assert covered == set(PROJECT_RULES), (
+        f"flow rules without a real-module mutation: "
+        f"{set(PROJECT_RULES) - covered}")
+
+
+def test_real_tree_is_clean_for_corpus_rules(clean_by_rule):
+    for rule, findings in clean_by_rule.items():
+        assert findings == [], (
+            f"{rule} fires on the UNMUTATED tree — fix the finding "
+            f"first, the corpus needs a clean baseline: "
+            f"{[f.format() for f in findings]}")
+
+
+@pytest.mark.parametrize("mut", corpus.MUTATIONS,
+                         ids=[m.name for m in corpus.MUTATIONS])
+def test_injected_hazard_is_detected(mut, tree, clean_by_rule):
+    sources, summaries = tree
+    error, findings = corpus.scan_mutated(mut, sources, summaries)
+    assert error is None, error
+    assert findings, (
+        f"rule '{mut.rule}' went blind on mutation '{mut.name}' "
+        f"({mut.path}): {mut.why}")
+    assert all(f.rule == mut.rule for f in findings)

@@ -614,6 +614,204 @@ class ProofFolder(threading.Thread):
             self.join(timeout=join_timeout)
 """,
     ),
+    # v2 flow model: one fixture pair per formerly-documented blind spot
+    # (LINTS.md "What the flow model tracks") — a true positive the v1
+    # name-based model missed, and the sanctioned idiom staying quiet.
+    (
+        # blind spot: indirect wrapping (`wrap = jax.jit`)
+        "use-after-donate",
+        "dalle_tpu/fake_alias.py",
+        """
+import jax
+wrap = jax.jit
+def update(state, grads):
+    return state
+_step = wrap(update, donate_argnums=0)
+def train(state, grads):
+    _step(state, grads)              # aliased wrapper still donates...
+    return state.loss                # ...and this reads the corpse
+""",
+        """
+import jax
+wrap = jax.jit
+def update(state, grads):
+    return state
+_step = wrap(update, donate_argnums=0)
+def train(state, grads):
+    state = _step(state, grads)      # rebind: the sanctioned shape
+    return state.loss
+""",
+    ),
+    (
+        # blind spot: closure capture of a donated binding
+        "use-after-donate",
+        "dalle_tpu/fake_closure.py",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+def train(state, grads):
+    def peek():
+        return state.loss            # captures `state`...
+    _step(state, grads)              # ...which this donates...
+    return peek()                    # ...and this reads the corpse
+""",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+def train(state, grads):
+    state = _step(state, grads)      # rebound BEFORE the capture:
+    def peek():                      # the closure reads the live
+        return state.loss            # result, not the donated buffer
+    return peek()
+""",
+    ),
+    (
+        # blind spot: jit binding through a constructor parameter
+        # (`self.apply_fn = apply_fn` — the trainer's
+        # CollaborativeOptimizer shape)
+        "use-after-donate",
+        "dalle_tpu/fake_ctor.py",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+class Trainer:
+    def __init__(self, apply_fn):
+        self.apply_fn = apply_fn
+    def train(self, state, grads):
+        self.apply_fn(state, grads)  # donates through the ctor param...
+        return state.loss            # ...then reads the corpse
+def make():
+    return Trainer(_step)
+""",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+class Trainer:
+    def __init__(self, apply_fn):
+        self.apply_fn = apply_fn
+    def train(self, state, grads):
+        state = self.apply_fn(state, grads)   # rebind retires it
+        return state.loss
+def make():
+    return Trainer(_step)
+""",
+    ),
+    (
+        # blind spot: key threaded through a lax.scan carry tuple (the
+        # decode sampler's shape)
+        "rng-key-reuse",
+        "dalle_tpu/fake_scan.py",
+        """
+import jax
+from jax import lax
+def sample(cache, rng, xs):
+    def step(carry, x):
+        cache, rng = carry           # unpacked carry key is tracked
+        a = jax.random.normal(rng, ())
+        b = jax.random.uniform(rng, ())   # same key: correlated
+        return (cache, rng), a + b
+    return lax.scan(step, (cache, rng), xs)
+""",
+        """
+import jax
+from jax import lax
+def sample(cache, rng, xs):
+    def step(carry, x):
+        cache, rng = carry
+        rng, sub = jax.random.split(rng)   # split first: both fresh
+        a = jax.random.normal(sub, ())
+        return (cache, rng), a
+    return lax.scan(step, (cache, rng), xs)
+""",
+    ),
+    (
+        # blind spot: base-class locks (inheritance not walked in v1)
+        "lock-order-cycle",
+        "dalle_tpu/fake_baselock.py",
+        """
+import threading
+class Base:
+    def __init__(self):
+        self._head = threading.Lock()
+        self._tail = threading.Lock()
+    def push(self):
+        with self._head:
+            with self._tail:
+                return 1
+class Sub(Base):
+    def pop(self):
+        with self._tail:
+            with self._head:         # inverted vs Base.push: the
+                return 2             # subclass acquires the SAME locks
+""",
+        """
+import threading
+class Base:
+    def __init__(self):
+        self._head = threading.Lock()
+        self._tail = threading.Lock()
+    def push(self):
+        with self._head:
+            with self._tail:
+                return 1
+class Sub(Base):
+    def pop(self):
+        with self._head:
+            with self._tail:         # same order: consistent
+                return 2
+""",
+    ),
+    (
+        # the rule the v2 model newly enables: a donated binding that
+        # ESCAPED (attribute/container/closure) before the donation —
+        # the bug class a unified device-state substrate could
+        # reintroduce (ROADMAP direction 5)
+        "donated-escape",
+        "dalle_tpu/fake_escape.py",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+class Loop:
+    def run(self, state, grads):
+        self._last = state           # escapes into an attribute...
+        state = _step(state, grads)  # ...the donation deletes it...
+        return self._last.loss       # ...and the holder reads garbage
+def drain(state, grads, pending):
+    pending.append(state)            # escapes into a container...
+    state = _step(state, grads)
+    return pending[0].loss           # ...read through the container
+""",
+        """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+class Loop:
+    def run(self, state, grads):
+        state = _step(state, grads)
+        self._last = state           # holds the REBOUND result: live
+        return self._last.loss
+def drain(state, grads, pending):
+    state = _step(state, grads)
+    pending.append(state)
+    return pending[0].loss
+def stash_then_clear(state, grads, pending):
+    pending.append(state)
+    pending = []                     # holder rebound before the
+    state = _step(state, grads)      # donation: nothing stale
+    return state.loss
+""",
+    ),
     (
         "mixed-lock-writes",
         "dalle_tpu/fake.py",
@@ -923,24 +1121,9 @@ def train(state, grads):
         "dalle_tpu.fake_train", None, "train", op) == [0]
 
 
-def test_use_after_donate_catches_broken_engine_loop():
-    """Mutation sensitivity on the REAL engine: the r9 hot loop donates
-    state through the `_chunk_fn` factory every iteration; deleting the
-    rebind must fire use-after-donate (the next iteration's dispatch
-    reads the donated binding — the loop wrap-around read). Guards the
-    rule against resolution bit-rot going quietly blind on the exact
-    call sites it exists for."""
-    path = os.path.join(REPO, "dalle_tpu", "serving", "engine.py")
-    with open(path, "r", encoding="utf-8") as fh:
-        src = fh.read()
-    rel = "dalle_tpu/serving/engine.py"
-    assert analyze_sources({rel: src}, rules=["use-after-donate"]) == []
-    rebind = "self._state = _chunk_fn(self._cfg"
-    assert rebind in src, "engine loop changed: update this mutation"
-    mutated = src.replace(rebind, "_chunk_fn(self._cfg")
-    hits = analyze_sources({rel: mutated}, rules=["use-after-donate"])
-    assert hits, "rule went blind on the engine's donated chunk dispatch"
-    assert all(f.rule == "use-after-donate" for f in hits)
+# Mutation sensitivity on the REAL modules lives in the corpus now:
+# tests/mutation_corpus/ + tests/test_mutation_corpus.py generalize the
+# old single engine-loop mutation test to >= 1 injection per flow rule.
 
 
 def test_parse_cache_keeps_warm_scan_in_budget(tmp_path):
@@ -1013,6 +1196,228 @@ def b(x):
     results = doc["runs"][0]["results"]
     assert [r["partialFingerprints"]["graftlint/v1"] for r in results] \
         == [pairs[1][1]]
+
+
+# -- parse cache under the split-version schema ----------------------------
+
+_CACHE_PKG = {
+    "pkg/steps.py": """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+def train(state, grads):
+    _step(state, grads)
+    return state.loss
+""",
+    "pkg/handlers.py": """
+def recv(sock):
+    try:
+        return sock.recv()
+    except Exception:
+        return None
+""",
+}
+
+
+def _cache_scan(tmp_path, cache_name="cache.json", stats=None):
+    import os as _os
+    root = str(tmp_path)
+    for rel, src in _CACHE_PKG.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return analyze_paths([_os.path.join(root, "pkg")], root=root,
+                         cache_path=str(tmp_path / cache_name),
+                         stats=stats)
+
+
+def test_cache_corrupt_and_foreign_files_are_discarded(tmp_path):
+    """An unreadable or structurally foreign cache file must be ignored
+    wholesale — never trusted, never a crash."""
+    import json
+    cold = _cache_scan(tmp_path)
+    assert {f.rule for f in cold} == {"use-after-donate", "silent-except"}
+    cache = tmp_path / "cache.json"
+    for poison in ("{not json", json.dumps({"something": "else"}),
+                   json.dumps({"format": 2, "files": "nope"}),
+                   json.dumps({"format": 99, "files": {}})):
+        cache.write_text(poison)
+        stats = {}
+        again = _cache_scan(tmp_path, stats=stats)
+        assert again == cold
+        assert stats["cache"]["hits"] == 0      # poison bought nothing
+
+
+def test_cache_schema_bump_keeps_per_file_findings(tmp_path):
+    """The split version key: a summary-schema change discards flow
+    summaries but NOT the per-file findings of unchanged rules — the
+    re-scan after a flow-model upgrade pays only the summarize half.
+    A rules-key change does the inverse."""
+    import json
+    cold = _cache_scan(tmp_path)
+    cache = tmp_path / "cache.json"
+
+    data = json.loads(cache.read_text())
+    assert all("findings" in e and "summary" in e
+               for e in data["files"].values())
+
+    # schema bump: summaries invalidated, findings kept
+    data["schema_key"] = "stale-schema"
+    cache.write_text(json.dumps(data))
+    stats = {}
+    warm = _cache_scan(tmp_path, stats=stats)
+    assert warm == cold
+    assert stats["cache"]["misses"] == len(_CACHE_PKG)
+    assert stats["cache"]["partial"] == len(_CACHE_PKG)
+    # no per-file rule ran again: their timing ledger is empty
+    per_file_rules = set(RULES)
+    assert not (set(stats["rules"]) & per_file_rules
+                and any(stats["rules"][r]["seconds"] > 0
+                        for r in set(stats["rules"]) & per_file_rules))
+
+    # rules-key bump: findings invalidated, summaries kept
+    data = json.loads(cache.read_text())
+    data["rules_key"] = "stale-rules"
+    cache.write_text(json.dumps(data))
+    stats = {}
+    warm = _cache_scan(tmp_path, stats=stats)
+    assert warm == cold
+    assert stats["cache"]["partial"] == len(_CACHE_PKG)
+
+    # untouched: full hits, nothing recomputed
+    stats = {}
+    warm = _cache_scan(tmp_path, stats=stats)
+    assert warm == cold
+    assert stats["cache"]["hits"] == len(_CACHE_PKG)
+    assert stats["cache"]["misses"] == 0
+
+
+# -- CLI: stale-baseline enforcement + --prune-stale ------------------------
+
+def _lint_cli():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_cli", os.path.join(REPO, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_fails_on_stale_baseline_entries(tmp_path, capsys):
+    """A baselined finding that no longer exists is a FIXED finding: the
+    ratchet must shrink in the same commit, so --check fails until
+    --prune-stale (or --write-baseline) removes the entry."""
+    import json
+    cli = _lint_cli()
+    cache = str(tmp_path / "cache.json")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "silent-except", "path": "dalle_tpu/gone.py",
+         "line": 1, "snippet": "except Exception:",
+         "fingerprint": "feedfacefeedface"}]}))
+    rc = cli.main(["--check", "--baseline", str(baseline),
+                   "--cache", cache])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale baseline" in out and "--prune-stale" in out
+    # --prune-stale drops the dead entry, then --check goes green
+    rc = cli.main(["--prune-stale", "--baseline", str(baseline),
+                   "--cache", cache])
+    assert rc == 0
+    assert json.loads(baseline.read_text())["findings"] == []
+    rc = cli.main(["--check", "--baseline", str(baseline),
+                   "--cache", cache])
+    assert rc == 0
+    # scoped runs still only NOTE staleness (out-of-scope entries are
+    # invisible, not fixed) — same baseline, restricted path
+    baseline.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "silent-except", "path": "dalle_tpu/gone.py",
+         "line": 1, "snippet": "except Exception:",
+         "fingerprint": "feedfacefeedface"}]}))
+    rc = cli.main(["--check", "--baseline", str(baseline),
+                   "--cache", cache,
+                   os.path.join(REPO, "dalle_tpu", "analysis")])
+    assert rc == 0
+    # and --prune-stale refuses a restricted scope outright
+    rc = cli.main(["--prune-stale", "--baseline", str(baseline),
+                   "--cache", cache,
+                   os.path.join(REPO, "dalle_tpu", "analysis")])
+    assert rc == 2
+
+
+def test_json_format_reports_per_rule_stats(tmp_path, capsys):
+    """--format json carries the per-rule finding/timing ledger so a new
+    rule's CI budget cost is visible the day it lands."""
+    import json
+    cli = _lint_cli()
+    rc = cli.main(["--format", "json",
+                   "--cache", str(tmp_path / "cache.json")])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    stats = doc["stats"]
+    assert set(stats["cache"]) == {"hits", "partial", "misses"}
+    for rid in ("use-after-donate", "donated-escape", "lock-order-cycle",
+                "rng-key-reuse"):
+        assert rid in stats["rules"]
+        assert set(stats["rules"][rid]) == {"findings", "seconds"}
+
+
+# -- SARIF golden ----------------------------------------------------------
+
+_SARIF_FIXTURE = {
+    "dalle_tpu/fake_sarif.py": """
+import jax
+def update(state, grads):
+    return state
+_step = jax.jit(update, donate_argnums=0)
+def train(state, grads):
+    _step(state, grads)
+    return state.loss
+def recv_a(sock):
+    try:
+        return sock.recv()
+    except Exception:
+        return None
+def recv_b(sock):
+    try:
+        return sock.recv()
+    except Exception:  # graftlint: disable=silent-except
+        return None
+""",
+}
+
+
+def test_sarif_output_matches_golden():
+    """The SARIF 2.1.0 shape CI annotators rely on, pinned: rule
+    metadata under tool.driver.rules, severity->level mapping (error
+    rule vs warning rule), inline suppressions excluded, baselined
+    fingerprints excluded, stable partialFingerprints."""
+    import json
+    from dalle_tpu.analysis import sarif
+    findings = analyze_sources(
+        dict(_SARIF_FIXTURE),
+        rules=["use-after-donate", "silent-except"])
+    # recv_b's handler is inline-suppressed: it must already be gone
+    assert sorted(f.rule for f in findings) == [
+        "silent-except", "use-after-donate"]
+    doc = json.loads(sarif.to_sarif(findings))
+    golden_path = os.path.join(REPO, "tests", "golden",
+                               "graftlint_fixture.sarif.json")
+    with open(golden_path, "r", encoding="utf-8") as fh:
+        golden = json.load(fh)
+    assert doc == golden
+    # excluding the baselined fingerprint drops its result AND its rule
+    # metadata row
+    pairs = fingerprint_findings(findings)
+    donate_fp = [fp for f, fp in pairs if f.rule == "use-after-donate"]
+    doc2 = json.loads(sarif.to_sarif(
+        findings, exclude_fingerprints=frozenset(donate_fp)))
+    results = doc2["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["silent-except"]
+    assert [r["id"] for r in
+            doc2["runs"][0]["tool"]["driver"]["rules"]] \
+        == ["silent-except"]
 
 
 def test_repo_scan_is_clean_against_baseline():
