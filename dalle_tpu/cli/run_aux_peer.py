@@ -78,6 +78,29 @@ _ROBUST_SUM_FIELDS = (
     "proofs_convicted", "proofs_rejected")
 
 
+_FLEET_SUM_FIELDS = (
+    ("goodput_img_per_s", "fleet_goodput_img_per_s"),
+    ("queue_depth", "fleet_queue_depth"),
+    ("live_slots", "fleet_live_slots"),
+    ("shed", "fleet_shed"),
+    ("prefix_hits", "fleet_prefix_hits"),
+    ("prefix_misses", "fleet_prefix_misses"))
+
+
+def fleet_stats(records):
+    """Fleet-wide SERVING stats from the DHT serving records
+    (``serving/router.py`` — the same records the router places by):
+    engine count plus summed goodput/queue/occupancy/prefix counters.
+    Serving peers are optional in a training swarm, so an empty record
+    set reports zero engines rather than omitting the keys (the
+    /metrics exposition wants stable gauge names)."""
+    out = {"fleet_engines": len(records)}
+    for src, dst in _FLEET_SUM_FIELDS:
+        total = sum(float(r.get(src) or 0) for r in records.values())
+        out[dst] = round(total, 4)
+    return out
+
+
 def aggregate(metrics):
     """Swarm-wide stats from per-peer reports (run_aux_peer.py:119-144).
 
@@ -200,6 +223,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 time.sleep(aux.refresh_period)
                 stats = aggregate(fetch_metrics(
                     task.dht, peer.experiment_prefix))
+                # serving-plane fleet view (ROADMAP direction 3): sum
+                # goodput/queue/prefix telemetry over the DHT serving
+                # records the router places by
+                from dalle_tpu.serving.router import discover_engines
+                stats.update(fleet_stats(discover_engines(
+                    task.dht, peer.experiment_prefix)))
                 latest_stats = stats
                 logger.info(
                     "round %d: epoch=%s alive=%d sum_sps=%.1f mean_loss=%s",

@@ -38,11 +38,11 @@ from typing import Optional, Sequence
 from dalle_tpu.cli._args import (add_dataclass_args, check_no_collisions,
                                  dataclass_from_args)
 from dalle_tpu.cli.run_trainer import MODEL_PRESETS
-from dalle_tpu.config import ModelConfig, ServingConfig
+from dalle_tpu.config import ModelConfig, PeerConfig, ServingConfig
 
 logger = logging.getLogger("dalle_tpu.server")
 
-CONFIG_CLASSES = (ModelConfig, ServingConfig)
+CONFIG_CLASSES = (ModelConfig, ServingConfig, PeerConfig)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -78,6 +78,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="permit torch's permissive pickle loader for VQGAN/CLIP "
              "checkpoints (EXECUTES code from the file — trusted "
              "origins only; utils/torch_io.py)")
+    parser.add_argument(
+        "--advertise", action="store_true",
+        help="join the swarm DHT (PeerConfig flags: --port, "
+             "--initial-peers, --identity-path, --experiment-prefix) "
+             "and advertise this engine's /readyz slice under "
+             "{prefix}_serving so a run_router front-end places to it")
+    parser.add_argument(
+        "--advertise-url", type=str, default=None,
+        help="the URL OTHER hosts reach this engine at (default "
+             "http://<http-host>:<http-port> — override when bound to "
+             "0.0.0.0 or behind a port map)")
+    parser.add_argument("--advert-ttl", type=float, default=None,
+                        help="serving-record TTL seconds (default "
+                             "router.DEFAULT_SERVING_TTL)")
+    parser.add_argument(
+        "--prime-service-s", type=float, default=None,
+        help="seed the decode service EMA with this calibrated "
+             "per-request cadence (seconds): the deadline shedder is "
+             "live from request one, and a fleet router is not fed "
+             "the compile-inflated samples a cold engine's first wave "
+             "otherwise bakes into its advertised cadence")
     parser.add_argument("--platform", type=str, default=None)
     parser.add_argument("--log-level", type=str, default="INFO")
     for cls in CONFIG_CLASSES:
@@ -198,6 +219,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     metrics = ServingMetrics(n_slots=serving.n_slots,
                              jsonl_path=args.metrics_file,
                              interval_s=serving.metrics_interval_s)
+    if args.prime_service_s is not None:
+        metrics.prime_service(args.prime_service_s, force=True)
     pixel_fn, degraded_fn = _build_pixel_fn(args, cfg)
     pipeline = (PixelPipeline(pixel_fn, metrics=metrics,
                               degraded_fn=degraded_fn)
@@ -211,6 +234,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     httpd = ServingHTTPServer((serving.http_host, serving.http_port),
                               engine, tokenizer=tokenizer,
                               request_timeout_s=serving.request_timeout_s)
+
+    # fleet advertising (serving/router.py): this engine's /readyz
+    # slice rides a TTL'd DHT record under {prefix}_serving — the
+    # router's placement input. The advertiser is stopped BEFORE the
+    # DHT is torn down (a publish against a dead native node is a
+    # use-after-free, the rendezvous.stop() contract).
+    dht = advertiser = None
+    if args.advertise:
+        from dalle_tpu.serving.router import (DEFAULT_SERVING_TTL,
+                                              ServingAdvertiser)
+        from dalle_tpu.swarm.dht import DHT
+        from dalle_tpu.swarm.identity import Identity
+        from dalle_tpu.swarm.metrics import make_validators
+        peer = dataclass_from_args(PeerConfig, args)
+        # the STANDARD validator chain (task.py wires the same one):
+        # the serving record's subkey gains the signed ownership marker
+        # validated swarm peers demand — an unsigned record is invisible
+        # to every trainer/aux/router whose DHT enforces signatures
+        ident = Identity.load_or_create(peer.identity_path)
+        dht = DHT(host=peer.host, port=peer.port,
+                  initial_peers=list(peer.initial_peers),
+                  client_mode=peer.client_mode,
+                  identity=ident,
+                  record_validators=make_validators(
+                      ident, peer.experiment_prefix))
+        url = args.advertise_url or (
+            f"http://{serving.http_host}:{httpd.server_address[1]}")
+        advertiser = ServingAdvertiser(
+            dht, peer.experiment_prefix, engine, url,
+            ttl=args.advert_ttl or DEFAULT_SERVING_TTL)
+        advertiser.publish_once()
+        advertiser.start()
+        logger.info("advertising %s under '%s_serving' (peer %s)",
+                    url, peer.experiment_prefix, dht.peer_id[:12])
+
     logger.info("=" * 60)
     logger.info("serving %s on http://%s:%d (%d slots, %d-step chunks, "
                 "%d prefix buckets%s)", args.preset, serving.http_host,
@@ -240,8 +298,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "(bounded by drain_timeout_s=%.0fs)",
                     serving.drain_timeout_s)
     finally:
+        if advertiser is not None:
+            advertiser.stop()
         httpd.server_close()
         engine.stop(drain=True)
+        if dht is not None:
+            dht.shutdown()
         logger.info("drained; final stats: %s", engine.stats())
     return 0
 

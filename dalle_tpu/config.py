@@ -549,6 +549,17 @@ class ServingConfig:
     brownout_low_frac: float = 0.25
     brownout_hold_s: float = 1.0
     brownout_max_images: int = 1
+    # Prompt-prefix KV cache (serving/prefix_cache.py): pool the
+    # teacher-forced text-segment KV per distinct prompt on device and
+    # admit repeated prompts at pos = text_seq_len, skipping their
+    # whole text prefill (bit-exact to the cold path — the text KV is
+    # a pure function of the prompt; pinned by test). The value is the
+    # pool's byte budget in MB (fixed-size entries, LRU eviction); when
+    # kv_budget_mb is also set the pool is RESERVED out of it, so the
+    # engine's total KV footprint stays under the one existing budget.
+    # None (the default) disables the pool — admission byte-identical
+    # to the r12 path.
+    prefix_cache_mb: Optional[float] = None
     # Serving fault plan (serving/chaos.py ServeFaultPlan: inline JSON
     # or a file path). None = the bit-transparent clean path.
     chaos_plan: Optional[str] = None
@@ -609,6 +620,11 @@ class ServingConfig:
             raise ValueError(
                 f"brownout_max_images must be >= 1 "
                 f"(got {self.brownout_max_images})")
+        if self.prefix_cache_mb is not None \
+                and not self.prefix_cache_mb > 0:
+            raise ValueError(
+                f"prefix_cache_mb must be > 0 or None "
+                f"(got {self.prefix_cache_mb})")
 
 
 @dataclass(frozen=True)

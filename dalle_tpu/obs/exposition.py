@@ -153,7 +153,8 @@ def _counterish(prefix: str, stats: Dict[str, object],
 _SERVING_COUNTERS = (
     "submitted", "admitted", "completed", "cancelled",
     "cancelled_mid_decode", "failed", "shed", "shed_queued", "browned",
-    "flood_injected", "deadline_met", "deadline_missed")
+    "flood_injected", "deadline_met", "deadline_missed",
+    "prefix_hits", "prefix_misses")
 _SERVING_GAUGES = (
     "uptime_s", "img_per_s", "goodput_img_per_s", "service_ema_s",
     "p50_latency_s", "p95_latency_s", "p50_ttft_s", "p95_ttft_s",

@@ -22,6 +22,14 @@ engine built on ``decode_step``'s per-slot position vector:
   failures, admission crashes, queue floods — CHAOS.md)
 - :mod:`server`    — stdlib-HTTP front-end (``cli/run_server.py``) with
   liveness (``/healthz``) split from readiness (``/readyz``)
+- :mod:`prefix_cache` — the prompt-prefix KV pool: device-resident
+  text-segment KV per distinct prompt, warm admission skips the whole
+  teacher-forced prefill bit-exactly (SERVING.md "Fleet routing +
+  prompt-prefix cache")
+- :mod:`router`    — the fleet layer: TTL'd DHT serving records
+  (``{prefix}_serving``, the rendezvous pattern) + the placing HTTP
+  front-end (``cli/run_router.py``) with least-predicted-completion
+  placement, prompt affinity and 429/503/timeout failover
 """
 
 from dalle_tpu.serving.chaos import (ServeChaos, ServeFaultPlan,
@@ -30,6 +38,12 @@ from dalle_tpu.serving.engine import (DeadlineShedError, DecodeEngine,
                                       RequestHandle)
 from dalle_tpu.serving.metrics import ServingMetrics
 from dalle_tpu.serving.pixels import PixelPipeline
+from dalle_tpu.serving.prefix_cache import (PrefixCache,
+                                            prompt_fingerprint)
+from dalle_tpu.serving.router import (Router, RouterHTTPServer,
+                                      ServingAdvertiser,
+                                      discover_engines, engine_record,
+                                      serving_key)
 from dalle_tpu.serving.scheduler import (LANES, SlotScheduler,
                                          kv_bytes_per_slot)
 
@@ -38,11 +52,19 @@ __all__ = [
     "DeadlineShedError",
     "DecodeEngine",
     "PixelPipeline",
+    "PrefixCache",
     "RequestHandle",
+    "Router",
+    "RouterHTTPServer",
     "ServeChaos",
     "ServeFaultPlan",
+    "ServingAdvertiser",
     "ServingMetrics",
     "SlotScheduler",
+    "discover_engines",
+    "engine_record",
     "kv_bytes_per_slot",
     "maybe_wrap_serving",
+    "prompt_fingerprint",
+    "serving_key",
 ]

@@ -74,6 +74,10 @@ from dalle_tpu.models.decode import (SamplingConfig, bucket_bounds,
                                      resolve_buckets, sample_logits)
 from dalle_tpu.serving.chaos import ServeChaos, maybe_wrap_serving
 from dalle_tpu.serving.metrics import ServingMetrics
+from dalle_tpu.serving.prefix_cache import (PrefixCache, extract_prefix,
+                                            prefix_entry_bytes,
+                                            prompt_fingerprint,
+                                            scatter_prefix, stack_entries)
 from dalle_tpu.serving.scheduler import (LANES, SlotScheduler,
                                          kv_bytes_per_slot)
 
@@ -207,6 +211,54 @@ def _admit_fn(cfg: ModelConfig, k: int):
 
 
 @functools.lru_cache(maxsize=64)
+def _warm_admit_fn(cfg: ModelConfig, k: int):
+    """Jitted batched WARM slot initialization — the prefix-cache twin
+    of :func:`_admit_fn`: the ``k`` slots' text-segment cache rows are
+    scattered from pooled prefix KV and the slots start at
+    ``pos = text_seq_len``, skipping the whole text prefill. Bit-exact
+    to the cold path by construction: the scattered rows are the bytes
+    a cold prefill writes (pooled at a previous request's harvest), the
+    RNG chain is advanced exactly the ``text_len`` split-steps the cold
+    chunk loop would have burned through the text segment (each step
+    splits once and keeps ``[0]`` — the sampled draws at text positions
+    are discarded there), and the input token at ``text_len`` is the
+    teacher-forced emission of position ``text_len - 1``, i.e. the
+    prompt's last token. State donated like every admission; the
+    prefix operand is NOT donated (the pool keeps serving it)."""
+    text_len = cfg.text_seq_len
+
+    def admit(state: EngineState, slots, texts, keys, temps, topks,
+              topps, prefix) -> EngineState:
+        def adv(_, ks):
+            return jax.vmap(jax.random.split)(ks)[:, 0]
+
+        keys = jax.lax.fori_loop(0, text_len, adv, keys)
+        return EngineState(
+            cache=scatter_prefix(state.cache, slots, prefix, text_len),
+            pos=state.pos.at[slots].set(text_len),
+            tokens=state.tokens.at[slots].set(texts[:, -1]),
+            rngs=state.rngs.at[slots].set(keys),
+            text=state.text.at[slots].set(texts),
+            codes=state.codes.at[slots].set(0),
+            temp=state.temp.at[slots].set(temps),
+            top_k=state.top_k.at[slots].set(topks),
+            top_p=state.top_p.at[slots].set(topps))
+
+    return jax.jit(admit, donate_argnums=0)
+
+
+@functools.lru_cache(maxsize=64)
+def _extract_prefix_fn(cfg: ModelConfig):
+    """Jitted prefix extraction: one slot's text-segment KV rows as
+    fresh device buffers (pooled at harvest time, while the slot's
+    text rows are still intact — image-position writes never touch
+    them). NOT donated: the engine state must survive the slice."""
+    text_len = cfg.text_seq_len
+    return jax.jit(lambda cache, slot: extract_prefix(cache, slot,
+                                                      text_len))
+
+
+@functools.lru_cache(maxsize=64)
 def _release_fn(cfg: ModelConfig, k: int):
     """Jitted batched slot release for mid-decode cancellation: the
     ``k`` cancelled slots' positions jump to ``total_seq_len`` (the
@@ -254,9 +306,12 @@ class RequestHandle:
             raise TimeoutError(
                 f"request {self.request_id} not done within {timeout}s")
         if "error" in self._payload:
-            # the typed shed marker rides the payload so the front-end
-            # maps a queued-shed to 429 without matching message text
+            # typed markers ride the payload so the front-end maps a
+            # queued-shed to 429 and an engine-stop cancellation to 503
+            # (retryable elsewhere — a router fails these over) without
+            # matching message text
             exc = (DeadlineShedError if self._payload.get("shed")
+                   else EngineStoppedError if self._payload.get("stopped")
                    else RuntimeError)
             raise exc(
                 f"request {self.request_id}: {self._payload['error']}")
@@ -301,6 +356,10 @@ class _Pending:
     #: work but resolves out of band and never feeds the ledger
     synthetic: bool = False
     first_code_seen: bool = field(default=False)
+    #: prompt fingerprint for the prefix pool (None = pool off or
+    #: synthetic); hit verdict set at admission
+    prefix_key: Optional[str] = None
+    prefix_hit: bool = False
 
 
 class DecodeEngine:
@@ -350,10 +409,22 @@ class DecodeEngine:
         n_buckets = resolve_buckets(serving.decode_buckets, s)
         self._bounds = bucket_bounds(total, n_buckets)
         self._chunk = serving.steps_per_call
+        # prompt-prefix pool (serving/prefix_cache.py): device-resident
+        # text-segment KV per distinct prompt; its byte budget is
+        # RESERVED out of kv_budget_mb when one is set, so slots + pool
+        # stay under the one existing budget
+        self._prefix: Optional[PrefixCache] = None
+        prefix_reserved = 0
+        if serving.prefix_cache_mb is not None:
+            prefix_budget = int(serving.prefix_cache_mb * 2 ** 20)
+            self._prefix = PrefixCache(prefix_entry_bytes(cfg),
+                                       prefix_budget)
+            prefix_reserved = prefix_budget
         self.scheduler = SlotScheduler(
             s, kv_bytes_per_slot(cfg), serving.kv_budget_mb,
             admit_burst=serving.admit_burst,
-            low_lane_bypass=serving.low_lane_bypass)
+            low_lane_bypass=serving.low_lane_bypass,
+            reserved_bytes=prefix_reserved)
         self.metrics = metrics or ServingMetrics(
             n_slots=s, interval_s=serving.metrics_interval_s)
         # ONE ServeChaos per serving process: the front-end and pixel
@@ -457,6 +528,10 @@ class DecodeEngine:
             key = np.asarray(rng)
         key = key.astype(np.uint32).reshape(2)
         sampling = self._validated_sampling(sampling)
+        # fingerprint outside the lock: hashing 256 ids is cheap but
+        # the queue lock's hold time is the admission latency floor
+        prefix_key = (prompt_fingerprint(text)
+                      if self._prefix is not None else None)
         with self._cv:
             if self._stopping:
                 raise EngineStoppedError("engine is stopping; submit "
@@ -480,7 +555,7 @@ class DecodeEngine:
             handle = RequestHandle(rid)
             self._queues[lane].append(_Pending(
                 rid, text, key, handle, sampling, lane=lane,
-                deadline=deadline))
+                deadline=deadline, prefix_key=prefix_key))
             if len(self._handles) >= self._handles_prune_at:
                 # lazy prune: resolved handles leave the abandonment
                 # registry so a long-lived server stays O(outstanding).
@@ -633,6 +708,13 @@ class DecodeEngine:
         return self._brownout
 
     @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        """The prompt-prefix pool (None when ``prefix_cache_mb`` is
+        unset) — tests and the bench reach hit/eviction accounting
+        through here."""
+        return self._prefix
+
+    @property
     def chaos(self) -> Optional[ServeChaos]:
         """The process-wide ServeChaos (None on the clean path) — the
         front-end and pixel worker reach the shared seam through here."""
@@ -656,9 +738,12 @@ class DecodeEngine:
         return self._thread.is_alive()
 
     def readiness(self) -> dict:
-        """The cheap readiness slice for /readyz: queue state + the
-        counter telemetry a router places by — no percentile math, no
-        record-window scan (those stay on /stats)."""
+        """The cheap readiness slice for /readyz AND the DHT serving
+        record (``serving/router.py`` advertises exactly this — the
+        router's placement inputs): queue state, live-slot occupancy,
+        the admission clamp, the measured service cadence and the
+        prefix-pool counters — no percentile math, no record-window
+        scan (those stay on /stats)."""
         with self._cv:
             depths = {ln: len(self._queues[ln]) for ln in LANES}
             draining = self._stopping
@@ -668,6 +753,14 @@ class DecodeEngine:
         out["queue_capacity"] = self._serving.queue_capacity
         out["brownout"] = self._brownout
         out["draining"] = draining
+        # _slots is engine-thread-owned; this unlocked read is a benign
+        # telemetry race (fixed-length list of refs, each entry read
+        # once) — a probe must never contend with the admission path
+        out["live_slots"] = sum(p is not None for p in self._slots)
+        out["n_slots"] = self._serving.n_slots
+        out["max_live"] = self.scheduler.max_live
+        out["occupancy"] = round(
+            out["live_slots"] / max(1, self._serving.n_slots), 4)
         return out
 
     def stats(self) -> dict:
@@ -682,6 +775,8 @@ class DecodeEngine:
         snap["draining"] = draining
         snap["n_slots"] = self._serving.n_slots
         snap["max_live_slots"] = self.scheduler.max_live
+        if self._prefix is not None:
+            snap["prefix_cache"] = self._prefix.stats()
         return snap
 
     @property
@@ -712,26 +807,61 @@ class DecodeEngine:
 
     def _admit_batch(self, admitted: List[_Pending],
                      slots: List[int]) -> None:
-        """Scatter all K admitted requests into their slots in ONE
-        jitted dispatch (state donated, like the chunk)."""
-        self._state = _admit_fn(self._cfg, len(admitted))(
-            self._state,
-            jnp.asarray(np.asarray(slots, np.int32)),
-            jnp.asarray(np.stack([p.text for p in admitted])),
-            jnp.asarray(np.stack([p.key for p in admitted])),
-            jnp.asarray([p.sampling.temperature for p in admitted],
-                        jnp.float32),
-            jnp.asarray([p.sampling.top_k for p in admitted], jnp.int32),
-            jnp.asarray([p.sampling.top_p for p in admitted],
-                        jnp.float32))
+        """Scatter all K admitted requests into their slots in one
+        jitted dispatch per temperature path (state donated, like the
+        chunk): COLD requests prefill from pos 0; WARM requests (their
+        prompt's text KV is pooled) scatter the cached prefix and start
+        at pos = text_len, skipping the text prefill entirely."""
+        warm_entries: Dict[int, Any] = {}
+        if self._prefix is not None:
+            for i, p in enumerate(admitted):
+                if p.prefix_key is None:
+                    continue
+                entry = self._prefix.lookup(p.prefix_key, p.text)
+                if entry is not None:
+                    warm_entries[i] = entry
+                    p.prefix_hit = True
+        cold = [(p, s) for i, (p, s) in enumerate(zip(admitted, slots))
+                if i not in warm_entries]
+        warm = [(p, s, warm_entries[i])
+                for i, (p, s) in enumerate(zip(admitted, slots))
+                if i in warm_entries]
+        if cold:
+            cp, cs = [p for p, _ in cold], [s for _, s in cold]
+            self._state = _admit_fn(self._cfg, len(cp))(
+                self._state,
+                jnp.asarray(np.asarray(cs, np.int32)),
+                jnp.asarray(np.stack([p.text for p in cp])),
+                jnp.asarray(np.stack([p.key for p in cp])),
+                jnp.asarray([p.sampling.temperature for p in cp],
+                            jnp.float32),
+                jnp.asarray([p.sampling.top_k for p in cp], jnp.int32),
+                jnp.asarray([p.sampling.top_p for p in cp], jnp.float32))
+        if warm:
+            wp, ws = [p for p, _, _ in warm], [s for _, s, _ in warm]
+            self._state = _warm_admit_fn(self._cfg, len(wp))(
+                self._state,
+                jnp.asarray(np.asarray(ws, np.int32)),
+                jnp.asarray(np.stack([p.text for p in wp])),
+                jnp.asarray(np.stack([p.key for p in wp])),
+                jnp.asarray([p.sampling.temperature for p in wp],
+                            jnp.float32),
+                jnp.asarray([p.sampling.top_k for p in wp], jnp.int32),
+                jnp.asarray([p.sampling.top_p for p in wp], jnp.float32),
+                stack_entries([e for _, _, e in warm]))
+        text_len = self._cfg.text_seq_len
         for pending, slot in zip(admitted, slots):
             self._slots[slot] = pending
-            self._pos_host[slot] = 0
+            self._pos_host[slot] = text_len if pending.prefix_hit else 0
             if not pending.synthetic:
-                self.metrics.record_admit(pending.rid)
+                self.metrics.record_admit(
+                    pending.rid,
+                    prefix_hit=(pending.prefix_hit
+                                if self._prefix is not None else None))
                 if self._tracer is not None:
                     self._tracer.event("serving", "admit",
-                                       f"req:{pending.rid}", slot=slot)
+                                       f"req:{pending.rid}", slot=slot,
+                                       prefix_hit=pending.prefix_hit)
 
     def _after_chunk(self, live_slots: List[int], queue_depth: int,
                      mirror_current: bool = False) -> List[int]:
@@ -775,6 +905,19 @@ class DecodeEngine:
                 if self._tracer is not None:
                     self._tracer.event("serving", "harvest",
                                        f"req:{pending.rid}", slot=slot)
+            # pool this prompt's text prefix while the slot's text rows
+            # are still intact (image-position writes never touch them;
+            # the slice is enqueued on the post-chunk state BEFORE any
+            # later donated dispatch can overwrite it, the same in-order
+            # guarantee the code-row harvest below rides)
+            if (self._prefix is not None and not pending.synthetic
+                    and pending.prefix_key is not None
+                    and pending.prefix_key not in self._prefix
+                    and self._prefix.insertable()):
+                self._prefix.insert(
+                    pending.prefix_key, pending.text,
+                    _extract_prefix_fn(self._cfg)(self._state.cache,
+                                                  jnp.int32(slot)))
             # slice BEFORE clearing the slot: if the slice dispatch
             # raises, the pending is still reachable from _slots for
             # the crash-path cancel sweep (first-claim-wins dedupes the
@@ -854,7 +997,12 @@ class DecodeEngine:
         for pend in (leftover + admitting
                      + [p for p in self._slots if p is not None]
                      + [p for p, _row in harvests]):
-            if pend.handle._resolve({"error": "cancelled at engine stop"}) \
+            # "stopped" is the typed marker: result() raises
+            # EngineStoppedError, the front-end answers 503 — a router
+            # retries the request on another engine instead of treating
+            # a dying engine's cancellations as a deterministic 500
+            if pend.handle._resolve({"error": "cancelled at engine stop",
+                                     "stopped": True}) \
                     and not pend.synthetic:
                 self.metrics.record_cancelled(pend.rid)
         self._slots = [None] * self._serving.n_slots
@@ -869,7 +1017,8 @@ class DecodeEngine:
             handles = [h for h in self._handles.values() if not h.done()]
         for h in handles:
             if h._resolve({"error": "abandoned: engine drain timed out "
-                                    f"after {timeout:.1f}s"}):
+                                    f"after {timeout:.1f}s",
+                           "stopped": True}):
                 self.metrics.record_cancelled(h.request_id)
 
     def _run(self) -> None:

@@ -61,6 +61,10 @@ class ServingMetrics:
         self._admit_t: Dict[int, float] = {}
         self._ttft: Dict[int, float] = {}
         self._lane: Dict[int, str] = {}
+        # per-rid prefix-cache verdict (set at admit when the engine
+        # runs a prefix pool); rides the completion row so benches can
+        # split TTFT by hit/miss per request
+        self._prefix_hit: Dict[int, bool] = {}
         self._records: List[dict] = []
         self._submitted = 0
         self._admitted = 0
@@ -74,6 +78,8 @@ class ServingMetrics:
         self._completed_by_lane = {lane: 0 for lane in LANES}
         self._browned = 0
         self._flood_injected = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         self._deadline_met = 0
         self._deadline_missed = 0
         self._service_ema_s: Optional[float] = None
@@ -91,10 +97,20 @@ class ServingMetrics:
             self._submit_t[rid] = time.monotonic()
             self._lane[rid] = lane
 
-    def record_admit(self, rid: int) -> None:
+    def record_admit(self, rid: int,
+                     prefix_hit: Optional[bool] = None) -> None:
+        """``prefix_hit``: whether admission scattered a pooled prompt
+        prefix (None = the engine runs no prefix pool — the completion
+        row then carries no verdict)."""
         with self._lock:
             self._admitted += 1
             self._admit_t[rid] = time.monotonic()
+            if prefix_hit is not None:
+                self._prefix_hit[rid] = prefix_hit
+                if prefix_hit:
+                    self._prefix_hits += 1
+                else:
+                    self._prefix_misses += 1
 
     def record_first_code(self, rid: int) -> None:
         """First image code emitted (chunk-boundary granularity; the
@@ -129,16 +145,22 @@ class ServingMetrics:
         with self._lock:
             return self._service_ema_s
 
-    def prime_service(self, service_s: float) -> None:
+    def prime_service(self, service_s: float,
+                      force: bool = False) -> None:
         """Seed the service EMA from a calibration run (or a prior
         server's measurement) so the deadline shedder is live from the
         FIRST request instead of admitting optimistically until the
-        first harvest. Later harvests fold in normally."""
+        first harvest. Later harvests fold in normally. ``force``
+        overwrites an EXISTING EMA — the post-warm-up reset: the
+        compile wave's 10-50x-inflated samples otherwise poison the
+        cadence that shedding AND router placement read (a router
+        shuns a freshly-booted engine for dozens of requests while the
+        alpha-0.3 EMA decays back to truth)."""
         if not service_s > 0:
             raise ValueError(
                 f"service_s must be > 0, got {service_s!r}")
         with self._lock:
-            if self._service_ema_s is None:
+            if force or self._service_ema_s is None:
                 self._service_ema_s = service_s
 
     def record_complete(self, rid: int,
@@ -158,6 +180,8 @@ class ServingMetrics:
                 "ttft_s": round(self._ttft.pop(rid, now - t_sub), 6),
                 "latency_s": round(now - t_sub, 6),
             }
+            if rid in self._prefix_hit:
+                row["prefix_hit"] = self._prefix_hit.pop(rid)
             self._completed += 1
             self._completed_by_lane[row["lane"]] = \
                 self._completed_by_lane.get(row["lane"], 0) + 1
@@ -220,6 +244,7 @@ class ServingMetrics:
         self._admit_t.pop(rid, None)
         self._ttft.pop(rid, None)
         self._lane.pop(rid, None)
+        self._prefix_hit.pop(rid, None)
 
     # -- engine-level sampling ------------------------------------------
 
@@ -246,6 +271,10 @@ class ServingMetrics:
                 "cancelled_mid_decode": self._cancelled_mid_decode,
                 "goodput_img_per_s": round(
                     self._deadline_met / elapsed, 4),
+                "prefix_hits": self._prefix_hits,
+                "prefix_misses": self._prefix_misses,
+                "service_ema_s": (None if self._service_ema_s is None
+                                  else round(self._service_ema_s, 6)),
             }
 
     def snapshot(self) -> dict:
@@ -282,6 +311,8 @@ class ServingMetrics:
                 "shed_queued": self._shed_queued,
                 "browned": self._browned,
                 "flood_injected": self._flood_injected,
+                "prefix_hits": self._prefix_hits,
+                "prefix_misses": self._prefix_misses,
                 "deadline_met": self._deadline_met,
                 "deadline_missed": self._deadline_missed,
                 "img_per_s": round(self._completed / elapsed, 4),

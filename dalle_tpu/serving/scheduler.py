@@ -38,6 +38,24 @@ from dalle_tpu.config import ModelConfig
 LANES = ("high", "low")
 
 
+def completion_waves(ahead: int, live: int, max_live: int) -> int:
+    """Admission waves until a request queued behind ``ahead`` others
+    (with ``live`` slots decoding) reaches a slot: the queue drains
+    ``max_live`` at a time. ONE definition for the engine's deadline
+    shedder and the router's placement predictions — the two sides of
+    the same admission economy must never disagree."""
+    return 1 + (ahead + live) // max(1, max_live)
+
+
+def predict_completion_s(ahead: int, live: int, max_live: int,
+                         service_s: float) -> float:
+    """The wave model: waves × the measured per-request service time.
+    Exact for saturated fixed-length decode, optimistic by partial-wave
+    progress otherwise — the right bias for a shed/placement decision
+    (never reject work a healthy engine would have finished)."""
+    return completion_waves(ahead, live, max_live) * service_s
+
+
 def kv_bytes_per_slot(cfg: ModelConfig) -> int:
     """KV-cache bytes one slot (batch row) owns, from the real cache
     pytree via ``eval_shape`` — stays correct for both the cycle-carry
@@ -77,7 +95,8 @@ class SlotScheduler:
     def __init__(self, n_slots: int, bytes_per_slot: int,
                  kv_budget_mb: Optional[int] = None,
                  admit_burst: Optional[int] = None,
-                 low_lane_bypass: Optional[int] = None):
+                 low_lane_bypass: Optional[int] = None,
+                 reserved_bytes: int = 0):
         self.n_slots = n_slots
         self.bytes_per_slot = bytes_per_slot
         self.admit_burst = admit_burst
@@ -90,7 +109,14 @@ class SlotScheduler:
         if kv_budget_mb is None:
             self.max_live = n_slots
         else:
-            by_budget = (kv_budget_mb * 2 ** 20) // max(1, bytes_per_slot)
+            # reserved_bytes carves a co-tenant pool (the prompt-prefix
+            # cache) out of the SAME budget: live slots + pool together
+            # stay under kv_budget_mb, with at least one slot always
+            # admissible (the clamp below) so a misconfigured reserve
+            # degrades throughput, never wedges admission
+            by_budget = (kv_budget_mb * 2 ** 20
+                         - max(0, int(reserved_bytes))) \
+                // max(1, bytes_per_slot)
             self.max_live = int(max(1, min(n_slots, by_budget)))
 
     def grant(self, queued: int, live: int, free: int) -> int:
@@ -143,11 +169,7 @@ class SlotScheduler:
         """Predicted seconds until a request queued behind ``ahead``
         same-or-higher-lane requests (with ``live`` slots already
         decoding) completes, given the measured per-request decode
-        service time. Wave model: the queue drains ``max_live`` at a
-        time, and the candidate rides wave ``1 + (ahead+live)//max_live``
-        — exact for saturated fixed-length decode (every request costs
-        the same chunk count), optimistic by partial-wave progress
-        otherwise, which is the right bias for a shed decision (never
-        reject work a healthy engine would have finished)."""
-        waves = 1 + (ahead + live) // max(1, self.max_live)
-        return waves * service_s
+        service time — the module-level wave model at this scheduler's
+        admission clamp (see :func:`predict_completion_s`)."""
+        return predict_completion_s(ahead, live, self.max_live,
+                                    service_s)

@@ -67,6 +67,10 @@ class _ClientGone(Exception):
 
 class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True   # connection threads must not block exit
+    # the stdlib default accept backlog (5) DROPS connections under a
+    # burst — a router fanning a spike onto this engine would see
+    # connection-refused noise instead of queue-depth backpressure
+    request_queue_size = 128
 
     def __init__(self, address, engine, tokenizer=None,
                  request_timeout_s: float = 300.0, registry=None):
@@ -127,10 +131,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "queue_full": full,
                 "brownout": state["brownout"],
                 "queue_depth_by_lane": state["queue_depth_by_lane"],
+                "queue_depth": state["queue_depth"],
+                "queue_capacity": state["queue_capacity"],
+                "live_slots": state["live_slots"],
+                "n_slots": state["n_slots"],
+                "max_live": state["max_live"],
+                "occupancy": state["occupancy"],
+                "service_ema_s": state["service_ema_s"],
                 "shed": state["shed"],
                 "browned": state["browned"],
                 "cancelled_mid_decode": state["cancelled_mid_decode"],
                 "goodput_img_per_s": state["goodput_img_per_s"],
+                "prefix_hits": state["prefix_hits"],
+                "prefix_misses": state["prefix_misses"],
             })
         elif self.path == "/stats":
             self._reply(200, engine.stats())
@@ -251,6 +264,14 @@ class _Handler(BaseHTTPRequestHandler):
             # shed marker): same contract as the submit-time shed
             self._cancel_all(handles)
             self._reply(429, {"error": str(e), "shed": True})
+            return
+        except EngineStoppedError as e:
+            # the engine stopped/crashed under this request (typed
+            # "stopped" payload marker): 503, the retryable answer — a
+            # router fails the request over to another engine; the work
+            # here was cancelled, so a retry cannot double-decode
+            self._cancel_all(handles)
+            self._reply(503, {"error": str(e)})
             return
         except RuntimeError as e:
             self._cancel_all(handles)   # siblings must not keep decoding

@@ -29,7 +29,17 @@ Results land in OVERLOAD_SOAK.json (plan + trace config included; the
 same ``--seed`` reproduces the same arrivals and the same fault
 schedule). Any oracle violation exits 1 — scriptable as a gate.
 
-Run:  python scripts/overload_soak.py              # full (48 requests)
+``--router`` drives the same trace THROUGH the placement router
+(serving/router.py) over TWO fault-wrapped engines (each with the
+prompt-prefix pool on), adding the fleet oracles: each engine's ledger
+closes on its own, the router's ledger closes (every routed request
+exactly one terminal relay), router-relayed rows == client-received
+rows with the engines' summed completions inside the bounded
+error-path discard budget, and nothing double-placed. The committed
+OVERLOAD_SOAK.json is the --router run.
+
+Run:  python scripts/overload_soak.py --router     # full (committed)
+      python scripts/overload_soak.py              # single-engine path
       python scripts/overload_soak.py --quick      # tier-1 smoke
       python scripts/overload_soak.py --seed 3 --load 3.0
 
@@ -156,7 +166,10 @@ def run_soak(args) -> dict:
         h.result(timeout=300)
     warm.stop()
     service_s = (time.monotonic() - t0) / 2   # 2*slots requests = 2 waves
-    capacity = slots / max(1e-6, service_s)
+    # --router doubles the serving silicon (2 engines): the offered
+    # load scales with FLEET capacity so the trace still overloads it
+    capacity = (2 if getattr(args, "router", False) else 1) \
+        * slots / max(1e-6, service_s)
     mean_gap = 1.0 / (args.load * capacity)
     arrivals = np.cumsum(rng.exponential(mean_gap, n))
     arrivals[0] = 0.0
@@ -183,24 +196,26 @@ def run_soak(args) -> dict:
           f"{high_deadline:.1f}s, flood at t+{flood_at:.1f}s",
           flush=True)
 
-    # -- the server under test (fault plan ACTIVE) ----------------------
+    # -- the server(s) under test (fault plan ACTIVE) -------------------
+    # --router: TWO fault-wrapped engines behind the placement router
+    # (serving/router.py) — the carried r12 item "drive the soak
+    # through a router once direction 3 lands". Shed/brownout still
+    # engage PER ENGINE; the router adds failover and the extended
+    # accounting oracles below.
+    n_engines = 2 if getattr(args, "router", False) else 1
     plan_dict = (json.loads(args.plan) if args.plan
                  else default_fault_plan(args.seed, args.queue_capacity,
                                          flood_at))
-    plan = ServeFaultPlan.from_dict(plan_dict)
     serving = ServingConfig(
         n_slots=slots, steps_per_call=args.steps_per_call,
         queue_capacity=args.queue_capacity,
         low_lane_bypass=4,
         brownout_high_frac=0.35, brownout_low_frac=0.15,
         brownout_hold_s=0.1, brownout_max_images=1,
-        request_timeout_s=args.request_timeout_s)
-    metrics = ServingMetrics(n_slots=slots)
-    # the shed predictor is live from the FIRST request: without the
-    # prime, everything before the first harvest admits optimistically
-    # and a fast pass can drain the whole trace without ever shedding —
-    # the overload oracle then fails on box-speed luck, not on a bug
-    metrics.prime_service(service_s)
+        request_timeout_s=args.request_timeout_s,
+        # the router path also soaks the prompt-prefix pool (parity
+        # oracle covers warm admissions bit-for-bit)
+        prefix_cache_mb=4.0 if n_engines > 1 else None)
 
     def pixel_fn(codes):
         return {"pixel_checksum": int(np.asarray(codes).sum())}
@@ -209,23 +224,61 @@ def run_soak(args) -> dict:
         return {"pixel_checksum": int(np.asarray(codes).sum())}
 
     threads_before = set(threading.enumerate())
-    chaos = ServeChaos(plan)
-    pipeline = PixelPipeline(pixel_fn, metrics=metrics,
-                             degraded_fn=degraded_fn, chaos=chaos)
-    # flight recorder (dalle_tpu/obs): the engine records every
-    # request's lifecycle (submit → admit → first_code → harvest →
-    # pixels → complete) in a byte-capped ring; an oracle failure dumps
-    # it as SOAK_FLIGHT.json instead of just exit 1
-    tracer = Tracer(peer="server", ring_bytes=256 * 1024)
-    engine = DecodeEngine(params, cfg, serving, sampling=SAM,
-                          pixel_pipeline=pipeline, metrics=metrics,
-                          chaos=chaos, tracer=tracer).start()
-    httpd = ServingHTTPServer(("127.0.0.1", 0), engine,
-                              request_timeout_s=serving.request_timeout_s)
-    http_thread = threading.Thread(target=httpd.serve_forever,
-                                   daemon=True)
-    http_thread.start()
-    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    engines, chaoses, httpds, http_threads, tracers = [], [], [], [], []
+    for ei in range(n_engines):
+        metrics = ServingMetrics(n_slots=slots)
+        # the shed predictor is live from the FIRST request: without
+        # the prime, everything before the first harvest admits
+        # optimistically and a fast pass can drain the whole trace
+        # without ever shedding — the overload oracle then fails on
+        # box-speed luck, not on a bug
+        metrics.prime_service(service_s)
+        chaos = ServeChaos(ServeFaultPlan.from_dict(plan_dict))
+        pipeline = PixelPipeline(pixel_fn, metrics=metrics,
+                                 degraded_fn=degraded_fn, chaos=chaos)
+        # flight recorder (dalle_tpu/obs): each engine records every
+        # request's lifecycle (submit → admit → first_code → harvest →
+        # pixels → complete) in a byte-capped ring; an oracle failure
+        # dumps the merged rows as SOAK_FLIGHT.json instead of just
+        # exit 1
+        tracer = Tracer(peer=f"server{ei}", ring_bytes=256 * 1024)
+        engine = DecodeEngine(params, cfg, serving, sampling=SAM,
+                              pixel_pipeline=pipeline, metrics=metrics,
+                              chaos=chaos, tracer=tracer).start()
+        httpd = ServingHTTPServer(
+            ("127.0.0.1", 0), engine,
+            request_timeout_s=serving.request_timeout_s)
+        http_thread = threading.Thread(target=httpd.serve_forever,
+                                       daemon=True)
+        http_thread.start()
+        engines.append(engine)
+        chaoses.append(chaos)
+        httpds.append(httpd)
+        http_threads.append(http_thread)
+        tracers.append(tracer)
+    engine_urls = [f"http://127.0.0.1:{h.server_address[1]}"
+                   for h in httpds]
+    router = router_httpd = None
+    if n_engines > 1:
+        from dalle_tpu.serving.router import (Router, RouterHTTPServer,
+                                              engine_record)
+
+        def fetch_records():
+            return {f"eng{i}": engine_record(engines[i], engine_urls[i])
+                    for i in range(n_engines)}
+
+        router = Router(fetch_records, refresh_s=0.25).start()
+        router.refresh_once()
+        router_httpd = RouterHTTPServer(
+            ("127.0.0.1", 0), router,
+            request_timeout_s=args.request_timeout_s)
+        router_thread = threading.Thread(
+            target=router_httpd.serve_forever, daemon=True)
+        router_thread.start()
+        http_threads.append(router_thread)
+        url = f"http://127.0.0.1:{router_httpd.server_address[1]}"
+    else:
+        url = engine_urls[0]
 
     # -- open-loop drive: one client thread per request -----------------
     outcomes = [None] * n
@@ -282,10 +335,18 @@ def run_soak(args) -> dict:
         ready_final = json.loads(e.read())
     except Exception as e:  # noqa: BLE001 - report over traceback
         ready_final = {"error": str(e)}
-    httpd.shutdown()
-    httpd.server_close()
-    engine.stop(drain=True, timeout=60)
-    http_thread.join(timeout=10)
+    if router_httpd is not None:
+        router_httpd.shutdown()
+        router_httpd.server_close()
+    if router is not None:
+        router.stop()
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    for engine in engines:
+        engine.stop(drain=True, timeout=60)
+    for http_thread in http_threads:
+        http_thread.join(timeout=10)
 
     # -- oracles --------------------------------------------------------
     oracles = {}
@@ -300,10 +361,52 @@ def run_soak(args) -> dict:
     oracles["accounting_exhaustive"] = (
         not hung and all(o is not None for o in outcomes))
 
-    snap = engine.stats()
-    oracles["accounting_ledger"] = (
-        snap["submitted"] == snap["completed"] + snap["cancelled"]
-        + snap["failed"] + snap["shed_queued"])
+    engine_snaps = [e.stats() for e in engines]
+    # one summed view for the fleet-level oracles; every per-engine
+    # ledger must ALSO close on its own (oracle below)
+    _SUM_KEYS = ("submitted", "admitted", "completed", "cancelled",
+                 "cancelled_mid_decode", "failed", "shed", "shed_queued",
+                 "browned", "flood_injected", "prefix_hits",
+                 "prefix_misses", "goodput_img_per_s", "img_per_s")
+    snap = {k: sum(s.get(k) or 0 for s in engine_snaps)
+            for k in _SUM_KEYS}
+    snap["mean_occupancy"] = round(
+        sum(s["mean_occupancy"] for s in engine_snaps)
+        / len(engine_snaps), 4)
+    snap["max_queue_depth"] = max(
+        s["max_queue_depth"] for s in engine_snaps)
+    oracles["accounting_ledger"] = all(
+        s["submitted"] == s["completed"] + s["cancelled"]
+        + s["failed"] + s["shed_queued"] for s in engine_snaps)
+    if router is not None:
+        rstats = router.stats()
+        led = rstats["ledger"]
+        rows_received = sum(
+            len(o.get("results", [])) for o in outcomes
+            if o and o["kind"] in ("ok", "browned"))
+        # the router's own ledger closes exactly: every routed request
+        # got exactly one terminal (a 200, a relayed refusal, the
+        # no-engine 503, or a vanished client)
+        oracles["router_ledger_closes"] = (
+            led["requests"] == led["completed"] + led["relayed_errors"]
+            + led["no_engine"] + led["client_gone"])
+        # router-ledger == sum-of-engine-ledgers: every code row the
+        # clients received was relayed by the router exactly once, and
+        # the engines' summed completions exceed the delivered rows
+        # only by the bounded discard budget — an error-path response
+        # (one sibling shed → 429) legitimately discards its already-
+        # completed siblings, but a systematically double-placing
+        # router would inflate engine completions far past it
+        discard_budget = 2 * (led["failovers"] + led["relayed_errors"])
+        oracles["router_sum_of_engine_ledgers"] = (
+            led["result_rows"] == rows_received
+            and 0 <= snap["completed"] - rows_received
+            <= discard_budget)
+        # zero double placement: nothing the router placed is still
+        # outstanding, and no request's codes reached a client twice
+        # (the bit-exact parity oracle pins each received row to its
+        # solo reference; the completion bound above pins the engines)
+        oracles["zero_double_placement"] = not rstats["inflight"]
 
     mismatches = []
     for i, o in enumerate(outcomes):
@@ -327,11 +430,16 @@ def run_soak(args) -> dict:
     oracles["goodput_positive"] = snap["goodput_img_per_s"] > 0 and \
         counts.get("ok", 0) > 0
 
-    # zero orphans: slots, queues, harvests, handles, threads
-    leaked_slots = [s for s in engine._slots if s is not None]
-    leaked_queued = sum(len(q) for q in engine._queues.values())
-    unresolved = [rid for rid, h in engine._handles.items()
-                  if not h.done()]
+    # zero orphans: slots, queues, harvests, handles, threads — on
+    # EVERY engine (and, under --router, the router's refresher too,
+    # which the thread sweep below catches)
+    leaked_slots = [s for e in engines for s in e._slots
+                    if s is not None]
+    leaked_queued = sum(len(q) for e in engines
+                        for q in e._queues.values())
+    unresolved = [rid for e in engines
+                  for rid, h in e._handles.items() if not h.done()]
+    leaked_harvests = any(e._harvests for e in engines)
     deadline_t = time.monotonic() + 15
     live_threads = None
     while time.monotonic() < deadline_t:
@@ -342,13 +450,15 @@ def run_soak(args) -> dict:
             break
         time.sleep(0.1)
     oracles["zero_orphans"] = (not leaked_slots and not leaked_queued
-                               and not engine._harvests
+                               and not leaked_harvests
                                and not unresolved and not live_threads)
-    oracles["faults_fired"] = bool(chaos.injected)
+    oracles["faults_fired"] = any(c.injected for c in chaoses)
 
     ok = all(oracles.values())
     report = {
-        "metric": "overload soak (2x capacity, fault plan active)",
+        "metric": ("overload soak (2x capacity, fault plan active"
+                   + (", routed over 2 engines)" if router is not None
+                      else ")")),
         "quick": bool(args.quick),
         "seed": args.seed,
         "requests": n,
@@ -361,8 +471,9 @@ def run_soak(args) -> dict:
         "low_deadline_s": round(low_deadline, 3),
         "queue_capacity": args.queue_capacity,
         "makespan_s": round(makespan, 2),
+        "n_engines": n_engines,
         "fault_plan": plan_dict,
-        "chaos_injected": dict(chaos.injected),
+        "chaos_injected": [dict(c.injected) for c in chaoses],
         "outcomes": counts,
         "high_lane": {"completed": len(high_lat),
                       "p50_latency_s": round(p50h, 4),
@@ -370,8 +481,14 @@ def run_soak(args) -> dict:
         "server_stats": {k: snap[k] for k in (
             "submitted", "admitted", "completed", "cancelled",
             "cancelled_mid_decode", "failed", "shed", "shed_queued",
-            "browned", "flood_injected", "goodput_img_per_s",
+            "browned", "flood_injected", "prefix_hits",
+            "prefix_misses", "goodput_img_per_s",
             "img_per_s", "mean_occupancy", "max_queue_depth")},
+        "per_engine_stats": [
+            {k: s[k] for k in ("submitted", "completed", "cancelled",
+                               "failed", "shed", "shed_queued",
+                               "browned")}
+            for s in engine_snaps],
         "readyz_final": ready_final,
         "parity_mismatches": mismatches[:8],
         "oracles": oracles,
@@ -379,8 +496,12 @@ def run_soak(args) -> dict:
         # flight-ring contents — popped by main(): a failing run dumps
         # them as SOAK_FLIGHT.json, a passing run drops them (the ring
         # is diagnostic payload, not report payload)
-        "_flight_rows": tracer.dump(),
+        "_flight_rows": [row for t in tracers for row in t.dump()],
     }
+    if router is not None:
+        rstats = router.stats()
+        report["router"] = {"ledger": rstats["ledger"],
+                            "per_engine": rstats["per_engine"]}
     return report
 
 
@@ -404,6 +525,13 @@ def main():
     ap.add_argument("--plan", type=str, default=None,
                     help="override the fault plan (inline ServeFaultPlan "
                          "JSON; default: the seeded soak plan)")
+    ap.add_argument("--router", action="store_true",
+                    help="drive the soak THROUGH the placement router "
+                         "over two fault-wrapped engines (serving/"
+                         "router.py) with the extended accounting "
+                         "oracles: per-engine ledgers close, router "
+                         "ledger == sum of engine ledgers, zero "
+                         "double-placement")
     ap.add_argument("--quick", action="store_true",
                     help="12 requests, 2 slots (tier-1 smoke)")
     ap.add_argument("--out", type=str, default=None)

@@ -65,6 +65,37 @@ def bench_model_config():
                              heads=4, head_dim=32, depth=4)
 
 
+def router_bench_model_config():
+    """The router A/B shape: the flagship's REAL 256-position text
+    segment (the teacher-forced prefix a pool hit skips — the effect
+    this bench measures) over an 8x8 image block at the serve-bench
+    width. The image side is what is shrunk for CPU wall time; the
+    text side is the paper's, so the skipped prefill is the genuine
+    256 decode steps. The resulting text fraction (80% of 320
+    positions vs the flagship's 20% of 1280) overstates the flagship's
+    per-hit saving 4x — SERVING.md's methodology section carries the
+    scaling arithmetic."""
+    return tiny_model_config(text_seq_len=256, image_grid=8, dim=128,
+                             heads=4, head_dim=32, depth=4)
+
+
+def make_zipf_prompts(n, unique, zipf_a, cfg, seed):
+    """A seeded Zipf-distributed prompt trace: ``unique`` distinct
+    prompts with request i drawing prompt ``rank`` with probability
+    ∝ rank^-a — the millions-of-users regime where trending/duplicate
+    prompts dominate and a prefix pool pays. Returns (texts[unique],
+    prompt_of[n])."""
+    rng = np.random.default_rng(seed)
+    texts = [rng.integers(2, cfg.vocab_text, cfg.text_seq_len,
+                          dtype=np.int64).astype(np.int32)
+             for _ in range(unique)]
+    ranks = np.arange(1, unique + 1, dtype=np.float64)
+    probs = ranks ** -zipf_a
+    probs /= probs.sum()
+    prompt_of = rng.choice(unique, size=n, p=probs)
+    return texts, prompt_of.tolist()
+
+
 def build_pixel_fn(cfg):
     """Jitted per-request codes -> pixels + CLIP score at bench scale
     (random weights, decode_bench e2e's trick): VQGAN upconv stack to
@@ -227,6 +258,291 @@ def run_engine(params, cfg, sam, texts, keys, arrivals, slots, chunk,
     }
 
 
+def _drive_http(url, texts, prompt_of, arrivals, timeout_s=600.0):
+    """Open-loop HTTP drive: one client thread per request, arrivals on
+    the seeded schedule, one image per request (seed = the request
+    index, so the same trace produces the same codes on any topology —
+    the router A/B compares throughput, never correctness it did not
+    pin). Returns (rows, makespan_s): each row is the engine's
+    completion accounting (ttft_s / latency_s / prefix_hit)."""
+    import urllib.request
+
+    n = len(prompt_of)
+    rows = [None] * n
+    done_walls = [None] * n
+    t0 = time.monotonic()
+
+    def client(i):
+        delay = t0 + arrivals[i] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        body = json.dumps({"tokens": texts[prompt_of[i]].tolist(),
+                           "seed": i}).encode()
+        req = urllib.request.Request(
+            url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                reply = json.loads(resp.read())
+            rows[i] = reply["results"][0]
+            done_walls[i] = time.monotonic()
+        except Exception as e:  # noqa: BLE001 - a failed request is a
+            rows[i] = {"error": str(e)}   # bench data point, not a crash
+            done_walls[i] = time.monotonic()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 30)
+    return rows, max(w for w in done_walls if w is not None) - t0
+
+
+def _trace_summary(rows, makespan, n):
+    ok = [r for r in rows if r and "error" not in r]
+    lat = [r["latency_s"] for r in ok]
+    ttft = [r["ttft_s"] for r in ok]
+    p50, p95 = percentiles(lat)
+    t50, _ = percentiles(ttft)
+    out = {
+        "completed": len(ok),
+        "img_per_s": round(len(ok) / makespan, 4),
+        "p50_latency_s": round(p50, 4),
+        "p95_latency_s": round(p95, 4),
+        "p50_ttft_s": round(t50, 4),
+        "makespan_s": round(makespan, 3),
+    }
+    # hit-vs-miss TTFT is compared ADMIT-relative (queue wait
+    # subtracted): the effect under measure is the skipped text
+    # prefill, and affinity deliberately queues duplicate prompts on
+    # one engine — submit-relative TTFT would charge the cache for the
+    # queueing its own popularity causes
+    hits = [r["ttft_s"] - r["queue_wait_s"] for r in ok
+            if r.get("prefix_hit")]
+    misses = [r["ttft_s"] - r["queue_wait_s"] for r in ok
+              if r.get("prefix_hit") is False]
+    if hits or misses:
+        out["prefix_hits"] = len(hits)
+        out["prefix_misses"] = len(misses)
+        out["ttft_hit_mean_s"] = (round(float(np.mean(hits)), 4)
+                                  if hits else None)
+        out["ttft_miss_mean_s"] = (round(float(np.mean(misses)), 4)
+                                   if misses else None)
+    return out
+
+
+def _spawn_engine_proc(cfg, slots, steps_per_call, queue_capacity,
+                       prefix_cache_mb=None, boot_timeout_s=240.0):
+    """One REAL serving peer: a ``run_server`` subprocess on an
+    ephemeral port. The router A/B's fleet is processes, not threads —
+    two engines inside one process share one XLA CPU runtime, whose
+    executions serialize (measured: 2 concurrent batch-2 chunk streams
+    cost exactly 2x one stream), so an in-process 'fleet' has HALF the
+    silicon its slot count claims. Subprocesses are also the honest
+    topology: the router places across hosts. ``--random-init`` is
+    deterministic (PRNGKey(0)), so every engine serves the same
+    params."""
+    import subprocess
+    import urllib.request
+
+    port = _free_port()
+    cmd = [sys.executable, "-m", "dalle_tpu.cli.run_server",
+           "--preset", "tiny", "--random-init",
+           "--platform", "cpu",
+           "--text-seq-len", str(cfg.text_seq_len),
+           "--image-grid", str(cfg.image_grid),
+           "--dim", str(cfg.dim), "--heads", str(cfg.heads),
+           "--head-dim", str(cfg.head_dim), "--depth", str(cfg.depth),
+           "--n-slots", str(slots),
+           "--steps-per-call", str(steps_per_call),
+           "--queue-capacity", str(queue_capacity),
+           "--top-k", "32",
+           "--http-port", str(port), "--log-level", "WARNING"]
+    if prefix_cache_mb is not None:
+        cmd += ["--prefix-cache-mb", str(prefix_cache_mb)]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + boot_timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"engine subprocess exited rc={proc.returncode}")
+        try:
+            urllib.request.urlopen(url + "/healthz", timeout=2).read()
+            return proc, url
+        except Exception:  # noqa: BLE001 - still booting
+            time.sleep(0.5)
+    proc.kill()
+    raise RuntimeError("engine subprocess never became healthy")
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stop_engine_proc(proc):
+    import signal as _signal
+
+    proc.send_signal(_signal.SIGTERM)
+    try:
+        proc.wait(timeout=60)
+    except Exception:  # noqa: BLE001 - a wedged engine must not wedge
+        proc.kill()    # the bench
+
+
+def _http_prewarm(url, cfg, slots, warm_prefix_path=False, seed=77):
+    """Warm one engine over HTTP before its timed window: the chunk/
+    admit executables (one wave of a dedicated out-of-Zipf-pool
+    prompt), and — when the engine pools prefixes — the warm-admit
+    scatter (the same prompt again). Compiles must not land inside the
+    measured makespan."""
+    import urllib.request
+
+    rng = np.random.default_rng(seed)
+    warm_prompt = rng.integers(2, cfg.vocab_text, cfg.text_seq_len,
+                               dtype=np.int64).astype(np.int32)
+
+    def one(seed_i):
+        body = json.dumps({"tokens": warm_prompt.tolist(),
+                           "seed": seed_i}).encode()
+        req = urllib.request.Request(
+            url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=600).read()
+
+    threads = [threading.Thread(target=one, args=(9000 + i,),
+                                daemon=True) for i in range(slots)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=630)
+    if warm_prefix_path:
+        one(9100)
+
+
+def run_router_ab(args):
+    """The multi-engine A/B (ROUTER_BENCH.json): ONE seeded Zipf prompt
+    trace against (a) the r9 single engine at ``--slots`` KV slots and
+    (b) the placement router over TWO engine PROCESSES of ``--slots/2``
+    each with the prompt-prefix pool on — same total KV slots, real
+    process-level silicon (see ``_spawn_engine_proc``), same HTTP
+    burst drive. The router row also reports prefix-hit vs miss TTFT
+    per the acceptance contract."""
+    from dalle_tpu.cli.run_router import static_fetch_records
+    from dalle_tpu.serving.router import Router, RouterHTTPServer
+
+    n = 12 if args.quick else args.requests
+    slots = args.slots
+    cfg = router_bench_model_config()
+    texts, prompt_of = make_zipf_prompts(
+        n, args.unique_prompts, args.zipf_a, cfg, args.seed)
+    # FULL BURST (every request at t=0): both rows run saturated for
+    # their whole window, so img/s is sustained throughput — an
+    # open-loop Poisson trace calibrated on this box's 2-4x capacity
+    # wobble kept measuring the arrival rate instead (the SERVE_BENCH
+    # trace-pinning lesson, one step further)
+    arrivals = np.zeros(n)
+    print(f"trace: {n}-request burst over {args.unique_prompts} "
+          f"Zipf(a={args.zipf_a}) prompts", flush=True)
+
+    # -- A: the r9 single engine (no prefix pool), all the slots ------
+    # spawn-through-drive rides one try/finally: a prewarm or drive
+    # failure must never orphan a CPU-burning run_server subprocess
+    # (the r9 session's stray-server lesson)
+    proc, url = _spawn_engine_proc(cfg, slots, args.steps_per_call,
+                                   max(128, 2 * n))
+    try:
+        _http_prewarm(url, cfg, slots)
+        rows, makespan = _drive_http(url, texts, prompt_of, arrivals)
+    finally:
+        _stop_engine_proc(proc)
+    single = _trace_summary(rows, makespan, n)
+    print(f"single: {single}", flush=True)
+
+    # -- B: router over two engine processes at half the slots each,
+    # prefix pool ON, prompt-affinity keeping duplicates where their
+    # prefix lives ------------------------------------------------------
+    per = max(1, slots // 2)
+    procs, urls = [], []
+    rhttpd = router = rth = None
+    try:
+        for _ in range(2):
+            p, u = _spawn_engine_proc(
+                cfg, per, args.steps_per_call, max(128, 2 * n),
+                prefix_cache_mb=args.prefix_cache_mb)
+            procs.append(p)
+            urls.append(u)
+        for u in urls:
+            _http_prewarm(u, cfg, per, warm_prefix_path=True)
+        router = Router(static_fetch_records(urls),
+                        refresh_s=0.25).start()
+        router.refresh_once()
+        rhttpd = RouterHTTPServer(("127.0.0.1", 0), router)
+        rth = threading.Thread(target=rhttpd.serve_forever, daemon=True)
+        rth.start()
+        rows, makespan = _drive_http(
+            f"http://127.0.0.1:{rhttpd.server_address[1]}",
+            texts, prompt_of, arrivals)
+        rstats = router.stats()
+    finally:
+        if rhttpd is not None:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+        if router is not None:
+            router.stop()
+        for p in procs:
+            _stop_engine_proc(p)
+        if rth is not None:
+            rth.join(timeout=10)
+    routed = _trace_summary(rows, makespan, n)
+    routed["router_ledger"] = rstats["ledger"]
+    routed["per_engine"] = rstats["per_engine"]
+    print(f"router: {routed}", flush=True)
+
+    speedup = routed["img_per_s"] / max(1e-9, single["img_per_s"])
+    hit, miss = routed.get("ttft_hit_mean_s"), \
+        routed.get("ttft_miss_mean_s")
+    ttft_ratio = (round(hit / miss, 3)
+                  if hit is not None and miss else None)
+    summary = {
+        "speedup": round(speedup, 3),
+        "ttft_hit_mean_s": hit,
+        "ttft_miss_mean_s": miss,
+        "ttft_hit_over_miss": ttft_ratio,
+        "target_met": bool(speedup >= 1.5 and hit is not None
+                           and miss is not None and hit < miss),
+    }
+    print(f"summary: {summary}", flush=True)
+
+    shared = {
+        "metric": "router A/B img/s (2 engines + prefix cache vs r9 "
+                  "single engine, same total KV slots)",
+        "n_requests": n,
+        "slots_total": slots,
+        "slots_per_engine": per,
+        "unique_prompts": args.unique_prompts,
+        "zipf_a": args.zipf_a,
+        "prefix_cache_mb": args.prefix_cache_mb,
+        "trace": "burst (saturated for the whole window)",
+        "trace_seed": args.seed,
+        "quick": bool(args.quick),
+    }
+    out_path = args.out or os.path.join(os.path.dirname(__file__), "..",
+                                        "ROUTER_BENCH.json")
+    with open(out_path, "a") as f:
+        f.write(json.dumps({**shared, "mode": "single", **single}) + "\n")
+        f.write(json.dumps({**shared, "mode": "router", **routed}) + "\n")
+        f.write(json.dumps({**shared, "mode": "summary", **summary})
+                + "\n")
+    return 0 if summary["target_met"] or args.quick else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=48)
@@ -249,10 +565,25 @@ def main():
                     help="pin the static batch-formation timeout "
                          "(seconds) alongside --mean-gap-s (r8: 0.165)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--router", action="store_true",
+                    help="run the MULTI-ENGINE A/B instead: placement "
+                         "router over 2 engines with the prompt-prefix "
+                         "pool vs the r9 single engine at the same "
+                         "total KV slots, on a seeded Zipf prompt "
+                         "trace -> ROUTER_BENCH.json")
+    ap.add_argument("--unique-prompts", type=int, default=6,
+                    help="distinct prompts in the Zipf pool (--router)")
+    ap.add_argument("--zipf-a", type=float, default=1.5,
+                    help="Zipf exponent of the prompt popularity "
+                         "distribution (--router)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=32.0,
+                    help="per-engine prefix-pool budget (--router)")
     ap.add_argument("--quick", action="store_true",
                     help="8 requests (CI smoke; numbers not meaningful)")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args()
+    if args.router:
+        return run_router_ab(args)
     n = 8 if args.quick else args.requests
     slots = args.slots
 

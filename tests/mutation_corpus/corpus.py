@@ -46,6 +46,19 @@ MUTATIONS: List[Mutation] = [
             "loop wrap-around read)",
     ),
     Mutation(
+        name="engine-cold-admit-rebind-deleted",
+        rule="use-after-donate",
+        path="dalle_tpu/serving/engine.py",
+        anchor="            self._state = _admit_fn(self._cfg, "
+               "len(cp))(",
+        replacement="            _admit_fn(self._cfg, len(cp))(",
+        why="admission partitions into a cold scatter and the prefix-"
+            "cache WARM scatter, both donating EngineState in "
+            "sequence; deleting the cold rebind hands the warm "
+            "dispatch (a function-local read two branches later) the "
+            "deleted pre-scatter buffer",
+    ),
+    Mutation(
         name="trainer-apply-rebind-deleted",
         rule="use-after-donate",
         path="dalle_tpu/swarm/optimizer.py",

@@ -94,6 +94,61 @@ class TestRendezvousAdvertiser:
         adv.stop()                      # never started: no join, no raise
 
 
+class _StubEngine:
+    """The readiness surface ServingAdvertiser publishes."""
+
+    tracer = None
+
+    def readiness(self):
+        return {"queue_depth": 0, "queue_depth_by_lane": {},
+                "queue_capacity": 1, "live_slots": 0, "n_slots": 1,
+                "max_live": 1, "occupancy": 0.0, "service_ema_s": None,
+                "brownout": False, "draining": False, "shed": 0,
+                "browned": 0, "cancelled_mid_decode": 0,
+                "goodput_img_per_s": 0.0, "prefix_hits": 0,
+                "prefix_misses": 0}
+
+
+class TestServingAdvertiser:
+    """serving/router.py's advertiser follows the RendezvousAdvertiser
+    discipline: daemonized, stop() signals AND bounded-joins (an
+    in-flight publish against a torn-down native DHT node is a
+    use-after-free)."""
+
+    def test_stop_joins_bounded(self):
+        from dalle_tpu.serving.router import ServingAdvertiser
+        adv = ServingAdvertiser(_StubDHT(), "t", _StubEngine(),
+                                "http://u", ttl=0.5)
+        assert adv.daemon, "advertiser must not block exit"
+        adv.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        adv.stop(join_timeout=10.0)
+        assert not adv.is_alive(), "stop() must reap the advertiser"
+        assert time.monotonic() - t0 < 5.0, "join must not wait a ttl"
+
+    def test_stop_before_start_is_safe(self):
+        from dalle_tpu.serving.router import ServingAdvertiser
+        ServingAdvertiser(_StubDHT(), "t", _StubEngine(),
+                          "http://u").stop()
+
+
+class TestRouterRefresher:
+    def test_stop_joins_bounded(self):
+        from dalle_tpu.serving.router import Router
+        router = Router(lambda: {}, refresh_s=0.2).start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        router.stop(join_timeout=10.0)
+        assert not router._thread.is_alive(), \
+            "stop() must reap the refresher"
+        assert time.monotonic() - t0 < 5.0
+
+    def test_stop_before_start_is_safe(self):
+        from dalle_tpu.serving.router import Router
+        Router(lambda: {}).stop()       # never started: no join, no raise
+
+
 class TestStateServer:
     def test_stop_joins_bounded(self):
         server = StateServer(_StubDHT(), "test-prefix",
