@@ -1,6 +1,6 @@
 """graftlint: AST-based JAX/concurrency hazard analysis for this repo.
 
-Stdlib-``ast`` only. Three rule families:
+Stdlib-``ast`` only. Four rule families:
 
 - **jax** (per-file): host-sync-in-jit, host-sync-in-hot-loop,
   python-rng-in-device, nondet-pytree, literal-divisor-in-quant —
@@ -19,6 +19,12 @@ Stdlib-``ast`` only. Three rule families:
   donate_argnums/static_argnums, constructor-parameter attribute
   provenance, lowered closures/lambdas, tuple/dict pack–unpack, and
   base-class walking).
+- **race** (whole-program): shared-write-unlocked,
+  lock-inconsistent-access — Eraser-style lockset race detection over
+  a thread-role graph (Thread targets, pool submits, Thread-subclass
+  ``run``, HTTP ``do_*`` dispatch, flooded through the call graph)
+  with happens-before seeding and ``guarded-by``/``handoff`` escape
+  hatches for deliberate lock-free ownership.
 
 Entry points: ``scripts/lint.py`` (CLI with ``--check``/baseline,
 ``--diff``/``--jobs``, JSON/SARIF output, content-hash parse cache) and
@@ -42,4 +48,4 @@ from dalle_tpu.analysis.core import (  # noqa: F401
     save_baseline,
 )
 from dalle_tpu.analysis import (concurrency_rules, flow_rules,  # noqa: F401
-                                jax_rules)
+                                jax_rules, race_rules)

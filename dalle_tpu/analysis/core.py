@@ -295,7 +295,7 @@ def all_rules() -> Dict[str, Rule]:
 def _load_rules() -> None:
     # import for side effect: rule registration
     from dalle_tpu.analysis import (concurrency_rules, flow_rules,  # noqa: F401
-                                    jax_rules)
+                                    jax_rules, race_rules)
 
 
 # -- analysis drivers -----------------------------------------------------
@@ -428,10 +428,14 @@ def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
     files reuse their per-file findings and project summary without
     re-parsing; a split-version partial hit recomputes only the stale
     product. ``jobs`` > 1 fans cache misses over a process pool.
-    ``changed_only``: report per-file findings only for these relative
-    paths (the ``--diff`` mode); the project model is still built over
-    the FULL scope — whole-program rules are only sound over the whole
-    program — so flow findings are always reported wherever they land.
+    ``changed_only``: report findings only for these relative paths
+    (the ``--diff`` mode); the project model is still built over the
+    FULL scope — whole-program rules are only sound over the whole
+    program. Project-rule findings are reported for the changed set
+    PLUS its spawn-dependency closure: thread-role assignment is
+    whole-program, so editing a ``Thread(target=...)`` site changes
+    the race verdicts of the (textually unchanged) target file, and
+    --diff must surface those, not just findings in edited files.
     ``stats``: filled in place with per-rule finding/timing counts and
     cache hit/miss counts (the ``--format json`` budget report).
     """
@@ -530,9 +534,21 @@ def analyze_paths(paths: Iterable[str], root: Optional[str] = None,
             {rel: sm for rel, sm in summaries.items() if sm is not None},
             entries)
         rule_seconds["<project-assembly>"] = _time.monotonic() - t0
+        report_only: Optional[Set[str]] = None
+        if changed_only is not None:
+            # expand the diff set with its spawn-dependency closure: a
+            # changed spawner re-verdicts the target file's thread
+            # roles, so findings landing there must not be filtered out
+            report_only = set(changed_only)
+            deps = project.spawn_dependencies()
+            for rel in changed_only:
+                report_only |= deps.get(rel, set())
         for r in proj_rules:
             t0 = _time.monotonic()
-            findings.extend(f for f in r.fn(project) if f is not None)
+            findings.extend(
+                f for f in r.fn(project)
+                if f is not None
+                and (report_only is None or f.path in report_only))
             rule_seconds[r.id] = rule_seconds.get(r.id, 0.0) \
                 + (_time.monotonic() - t0)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

@@ -44,16 +44,31 @@ intra-package.
 from __future__ import annotations
 
 import ast
-from typing import Any, Dict, List, Optional, Tuple
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from dalle_tpu.analysis.core import _JIT_LEAVES, dotted_name
 
 #: bump when the summary schema or extraction changes — invalidates
 #: cached summaries (cache.py folds this into its summary key; per-file
-#: findings of unchanged rules survive a schema-only bump)
-SUMMARY_SCHEMA = 4
+#: findings of unchanged rules survive a schema-only bump).
+#: v5: assign ops carry a line, subscript stores emit a ``wsub`` write
+#: op, functions record their ``global`` declarations, classes record
+#: their full attribute inventory + race annotations — the thread-role
+#: summary schema the race family (race_rules.py) analyzes.
+SUMMARY_SCHEMA = 5
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: race-family escape hatches, attached to the line of a `self.X = ...`
+#: assignment (or the line above it): `# graftlint: guarded-by=_lock`
+#: asserts every access of X is protected by that lock attribute even
+#: where the analyzer cannot see it; `# graftlint: handoff=<reason>`
+#: declares a deliberately lock-free ownership/handoff discipline
+#: (single-writer mirror, event-gated publication, claim/deliver
+#: single-winner) and exempts the attribute outright.
+_RACE_NOTE_RE = re.compile(
+    r"#\s*graftlint:\s*(guarded-by|handoff)=([A-Za-z0-9_.\-]+)")
 
 #: receiver methods that store an argument INTO the receiver — the
 #: container-escape edge donated-escape tracks (`pending.append(state)`)
@@ -132,6 +147,28 @@ def _is_lock_ctor(value: ast.AST) -> bool:
             in _LOCK_CTORS)
 
 
+def _ann_type(node: Optional[ast.AST]) -> Optional[str]:
+    """A class name carried by a type annotation: plain/dotted names,
+    string annotations, and one ``Optional[...]`` unwrap. Returns None
+    for anything else (unions, generics, non-class names)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        base = (dotted_name(node.value) or "").split(".")[-1]
+        if base == "Optional":
+            return _ann_type(node.slice)
+        return None
+    d = dotted_name(node)
+    if d is not None and d.split(".")[-1][:1].isupper():
+        return d
+    return None
+
+
 # -- flow IR extraction ----------------------------------------------------
 #
 # Ops (JSON dicts, evaluation order within each statement):
@@ -146,7 +183,7 @@ def _is_lock_ctor(value: ast.AST) -> bool:
 #              the immediate-call form donates on THIS call's args
 #       kw:    keyword args whose values are plain dotted names (the
 #              constructor-provenance pass maps them to params)
-#   {"t": "assign", "tg": [dotted, ...], "src":
+#   {"t": "assign", "tg": [dotted, ...], "l": line, "src":
 #        "key"|"name:<d>"|"pack:<d0>,<d1>,..."|"unpack:<d>"|
 #        "item:<d>:<key>"|None}
 #       src tags the RHS: "key" = a fresh PRNGKey/split/fold_in result,
@@ -160,6 +197,12 @@ def _is_lock_ctor(value: ast.AST) -> bool:
 #       store (`d[k] = state`) or a container-store method call
 #       (`pending.append(state)`). Attribute stores (`self.x = state`)
 #       ride the plain assign op (the dotted target IS the holder).
+#   {"t": "wsub",   "n": dotted, "l": line}
+#       a subscript store/delete THROUGH a named holder
+#       (`self._slots[i] = p`, `del self._strikes[pid]`): a *mutation*
+#       of the holder regardless of whether the RHS carries names —
+#       the write edge the race family needs (escape only fires for
+#       named RHS values)
 #   {"t": "closure","n": name|None, "frees": [dotted, ...], "l": line}
 #       a nested def (n = its name) or lambda (n = None) whose body
 #       reads the listed enclosing-scope bindings; the body itself is
@@ -196,6 +239,7 @@ class _Summarizer(ast.NodeVisitor):
     def __init__(self, path: str, source: str):
         self.path = path
         self.module = module_name_for(path)
+        self.lines = source.splitlines()
         self.summary: Dict[str, Any] = {
             "schema": SUMMARY_SCHEMA,
             "path": path,
@@ -281,6 +325,8 @@ class _Summarizer(ast.NodeVisitor):
             "lock_aliases": {},   # Condition(self._lock) sharing
             "jit_attrs": {},      # self.X = jax.jit(...) -> info
             "param_attrs": {},    # self.X = <ctor param> -> param name
+            "attrs": [],          # every self.X ever assigned here
+            "race_free": {},      # attr -> [kind, value] escape hatch
         }
         self.summary["classes"][node.name] = cls
         for item in node.body:
@@ -289,23 +335,57 @@ class _Summarizer(ast.NodeVisitor):
                 self._function(item, qual_prefix=node.name + ".",
                                cls=node.name)
 
+    def _race_note(self, lineno: int, attr: str,
+                   cls: Dict[str, Any]) -> None:
+        """`# graftlint: guarded-by=<lock>` / `handoff=<reason>` on the
+        attribute's assignment line (or the line above) — the race
+        family's declaration-site escape hatch."""
+        for ln in (lineno, lineno - 1):
+            if 0 < ln <= len(self.lines):
+                m = _RACE_NOTE_RE.search(self.lines[ln - 1])
+                if m:
+                    cls["race_free"].setdefault(
+                        attr, [m.group(1), m.group(2)])
+                    return
+
     def _scan_self_assigns(self, meth: ast.AST, cls: Dict[str, Any]
                            ) -> None:
         ctor_params: set = set()
+        ann_types: Dict[str, str] = {}
         if getattr(meth, "name", "") == "__init__":
             a = meth.args
-            ctor_params = {x.arg for x in (a.posonlyargs + a.args
-                                           + a.kwonlyargs)}
+            ctor_args = a.posonlyargs + a.args + a.kwonlyargs
+            ctor_params = {x.arg for x in ctor_args}
+            for x in ctor_args:
+                ty = _ann_type(x.annotation)
+                if ty is not None:
+                    ann_types[x.arg] = ty
         for node in ast.walk(meth):
-            if not isinstance(node, ast.Assign):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], None
+            else:
                 continue
-            value = node.value
-            for t in node.targets:
+            for t in targets:
                 if not (isinstance(t, ast.Attribute)
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"):
                     continue
                 attr = t.attr
+                if attr not in cls["attrs"]:
+                    cls["attrs"].append(attr)
+                self._race_note(node.lineno, attr, cls)
+                if isinstance(node, ast.AnnAssign):
+                    # `self._prefix: Optional[PrefixCache] = None` —
+                    # the annotation carries the attribute's type
+                    ty = _ann_type(node.annotation)
+                    if ty is not None:
+                        cls["attr_types"].setdefault(attr, ty)
+                if value is None:
+                    continue
                 if _is_lock_ctor(value):
                     assert isinstance(value, ast.Call)
                     leaf = (dotted_name(value.func) or "").split(".")[-1]
@@ -323,8 +403,14 @@ class _Summarizer(ast.NodeVisitor):
                         and value.id in ctor_params:
                     # `self.apply_fn = apply_fn`: attribute provenance —
                     # the Project links every construction site's
-                    # argument to this attribute's call sites
+                    # argument to this attribute's call sites. An
+                    # annotated ctor param (`ledger: PeerHealthLedger`)
+                    # also types the attribute, so `self.ledger.strike`
+                    # resolves cross-module like a constructed one.
                     cls["param_attrs"].setdefault(attr, value.id)
+                    ty = ann_types.get(value.id)
+                    if ty is not None:
+                        cls["attr_types"].setdefault(attr, ty)
                     continue
                 calls = []
                 if isinstance(value, ast.Call):
@@ -372,6 +458,7 @@ class _Summarizer(ast.NodeVisitor):
             "jit_locals": emitter.jit_locals,
             "local_locks": emitter.local_locks,
             "is_property": is_property,
+            "globals": emitter.global_names,
             "body": body,
         }
         self.summary["functions"][qual] = rec
@@ -394,7 +481,7 @@ class _Summarizer(ast.NodeVisitor):
             "jit": None, "returns_jit": None,
             "jit_locals": emitter.jit_locals,
             "local_locks": emitter.local_locks,
-            "is_property": False, "body": body,
+            "is_property": False, "globals": [], "body": body,
         }
         self.summary["functions"][qual] = rec
         return rec
@@ -514,6 +601,7 @@ class _BodyEmitter:
         self.returns_jit: Optional[Dict[str, List[int]]] = None
         self.jit_locals: Dict[str, Dict[str, List[int]]] = {}
         self.local_locks: List[str] = []
+        self.global_names: List[str] = []
 
     # -- expressions -------------------------------------------------------
 
@@ -620,7 +708,8 @@ class _BodyEmitter:
             if vd is not None:
                 out.append({"t": "assign",
                             "tg": [e.id for e in targets[0].elts],
-                            "src": "unpack:" + vd})
+                            "src": "unpack:" + vd,
+                            "l": targets[0].lineno})
                 return
         names: List[str] = []
 
@@ -633,13 +722,17 @@ class _BodyEmitter:
             elif isinstance(cur, ast.Subscript):
                 # writing INTO a buffer is a read of the binding,
                 # never a rebind; a named RHS stored through it is a
-                # container escape (`d[k] = state`)
+                # container escape (`d[k] = state`), and the holder is
+                # MUTATED either way — the wsub write edge
                 self.expr(cur.value, out)
                 self.expr(cur.slice, out)
                 holder = dotted_name(cur.value)
                 vs = _value_names(value)
                 if holder is not None and vs:
                     out.append({"t": "escape", "h": holder, "vs": vs,
+                                "l": cur.lineno})
+                if holder is not None:
+                    out.append({"t": "wsub", "n": holder,
                                 "l": cur.lineno})
             else:
                 d = dotted_name(cur)
@@ -683,7 +776,9 @@ class _BodyEmitter:
             if base is not None and k is not None:
                 src = f"item:{base}:{k}"
         if names:
-            out.append({"t": "assign", "tg": names, "src": src})
+            line = getattr(targets[0], "lineno", 0) if targets else 0
+            out.append({"t": "assign", "tg": names, "src": src,
+                        "l": line})
 
     def _record_bindings(self, targets: List[ast.AST],
                          value: Optional[ast.AST]) -> None:
@@ -808,10 +903,17 @@ class _BodyEmitter:
             out.append({"t": "branch",
                         "bs": [self.block(c.body) for c in stmt.cases]})
             return
+        if isinstance(stmt, ast.Global):
+            # no op emitted, but the declaration makes later bare-name
+            # assigns in this body MODULE-GLOBAL writes (race family)
+            for name in stmt.names:
+                if name not in self.global_names:
+                    self.global_names.append(name)
+            return
         if isinstance(stmt, (ast.Break, ast.Continue)):
             out.append({"t": "term"})
             return
-        # Pass, Import, Global, Nonlocal: no ops
+        # Pass, Import, Nonlocal: no ops
 
 
 def summarize_source(path: str, source: str) -> Dict[str, Any]:
@@ -1233,6 +1335,202 @@ class Project:
             return f"{module}:{dotted}"
         return None
 
+    # -- thread roles ------------------------------------------------------
+    #
+    # The race family needs to know, for every function, WHICH threads
+    # can execute it. A "role" is a thread entry point: a function
+    # handed to Thread(target=...), a callable given to a pool's
+    # .submit, a Thread subclass's run(), or an HTTP handler's do_*
+    # dispatch method. Roles propagate through the name-based call
+    # graph to a fixpoint; everything not reachable from a spawn site
+    # runs under the implicit "main" role. A function can carry several
+    # roles (start() paths that also run inside the worker).
+
+    def resolve_fn_key(self, module: str, cls: Optional[str],
+                       qual: str, dotted: str
+                       ) -> Optional[Tuple[str, str]]:
+        """A dotted callee -> a concrete function key ``(module,
+        qual)``: plain fn/method resolution, class -> its __init__,
+        plus the own-nested-def fallback ``resolve_callee`` skips (a
+        worker defined INSIDE the spawning function — ``def run():
+        ...; Thread(target=run)`` — lives at ``{qual}.{dotted}``)."""
+        if "." not in dotted:
+            own = f"{qual}.{dotted}"
+            if self.function(module, own) is not None:
+                return (module, own)
+        r = self.resolve_callee(module, cls, qual, dotted)
+        if r is None:
+            return None
+        if r[0] == "fn":
+            return (r[1], r[2])
+        if r[0] == "class":
+            if self.function(r[1], f"{r[2]}.__init__") is not None:
+                return (r[1], f"{r[2]}.__init__")
+        return None
+
+    def _external_base_leaves(self, module: str, name: str) -> set:
+        """Leaf names of bases NOT resolvable inside the project
+        (stdlib / third-party), across the project-visible MRO — how
+        ``class Gossip(threading.Thread)`` is recognized without
+        importing threading."""
+        leaves: set = set()
+        for m, _n, c in self.cls_mro(module, name):
+            for b in c.get("bases", ()):
+                if self._resolve_class_name(m, b) is None:
+                    leaves.add(b.split(".")[-1])
+        return leaves
+
+    def _call_edges(self, module: str, qual: str, rec: dict
+                    ) -> Set[Tuple[str, str]]:
+        outs: Set[Tuple[str, str]] = set()
+        for op in _iter_ops(rec["body"]):
+            if op["t"] != "call":
+                continue
+            for d in (op.get("fn"), op.get("inner")):
+                if not d:
+                    continue
+                k = self.resolve_fn_key(module, rec["cls"], qual, d)
+                if k is not None:
+                    outs.add(k)
+        return outs
+
+    def _thread_role_pass(self) -> None:
+        if getattr(self, "_roles_cache", None) is not None:
+            return
+        entries: List[Tuple[str, Tuple[str, str]]] = []
+        spawn_deps: Dict[str, Set[str]] = {}
+
+        def note_dep(spawner_path: str, tmod: str) -> None:
+            tpath = self.modules.get(tmod)
+            if tpath is not None and tpath != spawner_path:
+                spawn_deps.setdefault(spawner_path, set()).add(tpath)
+
+        # (a) Thread(target=...)  (b) pool .submit(fn, ...)
+        for path, module, qual, rec in iter_functions(self):
+            for op in _iter_ops(rec["body"]):
+                if op["t"] != "call" or not op.get("fn"):
+                    continue
+                fn = op["fn"]
+                leaf = fn.split(".")[-1]
+                target: Optional[str] = None
+                if leaf == "Thread":
+                    target = (op.get("kw") or {}).get("target")
+                elif leaf == "submit" and "." in fn:
+                    args = op.get("args") or []
+                    target = args[0] if args else None
+                if target is None:
+                    continue
+                key = self.resolve_fn_key(
+                    module, rec["cls"], qual, target)
+                if key is None:
+                    continue
+                entries.append((f"{key[0]}:{key[1]}", key))
+                note_dep(path, key[0])
+        # (c) Thread subclasses: run() is the entry
+        # (d) HTTP handler classes: every do_* method is dispatched on
+        #     the server's handler threads
+        for path, sm in self.files.items():
+            module = sm["module"]
+            for name in sm["classes"]:
+                ext = self._external_base_leaves(module, name)
+                if "Thread" in ext:
+                    for m, n, _c in self.cls_mro(module, name):
+                        if self.function(m, f"{n}.run") is not None:
+                            entries.append(
+                                (f"{module}:{name}.run",
+                                 (m, f"{n}.run")))
+                            note_dep(path, m)
+                            break
+                if any(e.endswith("HTTPRequestHandler") for e in ext):
+                    for q in sm["functions"]:
+                        parts = q.split(".")
+                        if len(parts) == 2 and parts[0] == name \
+                                and parts[1].startswith("do_"):
+                            entries.append(
+                                (f"{module}:{q}", (module, q)))
+        # call-graph edges once, then per-entry BFS
+        edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for _path, module, qual, rec in iter_functions(self):
+            edges[(module, qual)] = self._call_edges(module, qual, rec)
+        roles: Dict[Tuple[str, str], set] = {}
+
+        def flood(role: str, root: Tuple[str, str]) -> None:
+            stack, seen = [root], set()
+            while stack:
+                k = stack.pop()
+                if k in seen:
+                    continue
+                seen.add(k)
+                roles.setdefault(k, set()).add(role)
+                stack.extend(edges.get(k, ()))
+
+        for role, key in entries:
+            flood(role, key)
+        # everything not reached from a spawn site runs on the caller's
+        # thread: flood "main" from every role-less function, so a
+        # helper shared by main and a worker ends up DUAL-role
+        for key in list(edges):
+            if key not in roles:
+                flood("main", key)
+        self._roles_cache = roles
+        self._entries_cache = entries
+        self._spawn_deps_cache = spawn_deps
+
+    def thread_roles(self) -> Dict[Tuple[str, str], set]:
+        """(module, qual) -> set of role ids the function can run
+        under ("main" and/or "{module}:{entry_qual}")."""
+        self._thread_role_pass()
+        return self._roles_cache
+
+    def thread_entries(self) -> List[Tuple[str, Tuple[str, str]]]:
+        """[(role_id, (module, qual))] for every discovered entry."""
+        self._thread_role_pass()
+        return self._entries_cache
+
+    def spawn_dependencies(self) -> Dict[str, Set[str]]:
+        """{spawner path: paths whose functions' ROLE SETS depend on
+        this file's spawn sites} — a --diff change to the spawner must
+        re-verdict the target file too."""
+        self._thread_role_pass()
+        return self._spawn_deps_cache
+
+    # -- race-family attribute queries -------------------------------------
+
+    def attr_defining_class(self, module: str, cls: str, attr: str
+                            ) -> Tuple[str, str]:
+        """The MRO class that assigns ``self.<attr>`` — shared-state
+        identity is anchored there so accesses through a subclass and
+        the base agree on ONE state node."""
+        for m, n, c in self.cls_mro(module, cls):
+            if attr in c.get("attrs", ()):
+                return (m, n)
+        return (module, cls)
+
+    def race_note(self, module: str, cls: str, attr: str
+                  ) -> Optional[List[str]]:
+        """The ``# graftlint: guarded-by=X`` / ``handoff=Y`` annotation
+        on the attribute's init site, if any (MRO-walked)."""
+        for _m, _n, c in self.cls_mro(module, cls):
+            note = c.get("race_free", {}).get(attr)
+            if note is not None:
+                return note
+        return None
+
+    def attr_type_leaf(self, module: str, cls: str, attr: str
+                       ) -> Optional[str]:
+        for _m, _n, c in self.cls_mro(module, cls):
+            ty = c.get("attr_types", {}).get(attr)
+            if ty is not None:
+                return ty.split(".")[-1]
+        return None
+
+    def is_lock_attr(self, module: str, cls: str, attr: str) -> bool:
+        for _m, _n, c in self.cls_mro(module, cls):
+            if attr in c.get("lock_attrs", ()) \
+                    or attr in c.get("lock_aliases", {}):
+                return True
+        return False
+
     # -- suppression -------------------------------------------------------
 
     def suppressed(self, path: str, line: int, rule: str) -> bool:
@@ -1261,3 +1559,18 @@ def iter_functions(project: Project):
     for path, sm in project.files.items():
         for qual, rec in sm["functions"].items():
             yield path, sm["module"], qual, rec
+
+
+def _iter_ops(block: List[dict]):
+    """Every op in a flow-IR block, descending into with/branch/loop
+    bodies (structure-blind iteration for inventory passes)."""
+    for op in block:
+        yield op
+        t = op["t"]
+        if t == "with":
+            yield from _iter_ops(op["b"])
+        elif t == "branch":
+            for b in op["bs"]:
+                yield from _iter_ops(b)
+        elif t == "loop":
+            yield from _iter_ops(op["b"])

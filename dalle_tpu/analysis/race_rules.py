@@ -1,0 +1,475 @@
+"""graftlint race rule family: Eraser-style lockset race detection.
+
+The engine chunk loop, pixel worker, router refresher, StrikeGossip,
+AuditWorker, state_transfer, matchmaking, checkpoint, and obs
+exposition all spawn threads against shared ``self`` state; the
+concurrency family checks lock *usage shapes* (ordering, daemon joins),
+but nothing proved an attribute is consistently guarded at all — the
+``_claim``/``_deliver`` and cancel-vs-complete races of r9/r12 were
+found by hand. This family automates that review:
+
+1. **thread roles** — :meth:`Project.thread_roles` lifts every
+   ``Thread(target=...)`` site, pool ``.submit``, ``Thread`` subclass
+   ``run()``, and HTTP handler ``do_*`` method into a role, floods
+   roles through the name-based call graph, and floods ``"main"`` from
+   every function no spawn site reaches.
+2. **shared-state inventory** — attribute-level reads/writes of
+   ``self.*`` (anchored at the MRO class that assigns the attribute)
+   and declared module globals, kept only when the accessing roles
+   number ≥ 2. Happens-before seeding exempts ``__init__`` accesses,
+   accesses *before* a ``start()``/``submit()`` in the spawning
+   function, and accesses after a ``join()``.
+3. **lockset intersection** — per-access held locks (the lock-order
+   machinery's identities) plus an entry-lockset fixpoint over the
+   call graph (a helper only ever called under ``self._lock`` inherits
+   it). An ident with an unguarded write is ``shared-write-unlocked``;
+   one whose accesses are all locked but share NO common lock is
+   ``lock-inconsistent-access``.
+
+Escape hatches for deliberately lock-free designs, both carrying the
+reviewer's justification in the source:
+
+- ``# graftlint: guarded-by=<lock>`` on the attribute's init line
+  asserts every access happens under ``self.<lock>`` in ways the
+  analysis cannot see (e.g. CAS-style single-winner protocols run
+  under it); the named lock is injected into every access's lockset.
+- ``# graftlint: handoff=<mechanism>`` declares the attribute is
+  transferred between roles by a synchronized mechanism (queue put/get,
+  single-writer mirror read by benign telemetry) and drops it from the
+  inventory.
+
+Known false-negative limits of the name-based role graph are
+documented in LINTS.md (dynamic dispatch, container-carried globals,
+multi-instance self-races, branch-insensitive happens-before flags).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from dalle_tpu.analysis.core import Finding, project_rule
+from dalle_tpu.analysis.project import Project, iter_functions
+
+#: attribute types that synchronize internally — accesses through them
+#: are handoffs, not races
+_SYNC_TYPE_LEAVES = {
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+}
+
+#: receiver-mutating method leaves: `self.x.append(v)` is a WRITE of x
+_MUT_METHS = {
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popleft", "appendleft", "popitem", "clear", "update",
+    "setdefault", "put", "put_nowait", "sort", "reverse",
+}
+
+#: call leaves that flip the happens-before flags in a sequential walk
+_SPAWN_LEAVES = {"start", "submit"}
+_JOIN_LEAVES = {"join"}
+
+# access kinds
+_R, _W = "read", "write"
+
+
+def _mk_finding(project: Project, rule: str, path: str, line: int,
+                message: str) -> Optional[Finding]:
+    if project.suppressed(path, line, rule):
+        return None
+    return Finding(rule=rule, path=path, line=line, message=message,
+                   snippet=project.snippet(path, line))
+
+
+class _Access:
+    __slots__ = ("ident", "kind", "path", "line", "held", "key",
+                 "exempt")
+
+    def __init__(self, ident, kind, path, line, held, key, exempt):
+        self.ident = ident      # ("attr", mod, cls, attr) | ("global", mod, n)
+        self.kind = kind        # _R | _W
+        self.path = path
+        self.line = line
+        self.held = held        # frozenset of LOCAL lock ids (entry
+        #                         lockset is unioned in later)
+        self.key = key          # accessing function (module, qual)
+        self.exempt = exempt    # happens-before exemption
+
+
+def _module_global_writes(project: Project) -> Dict[str, Set[str]]:
+    """module -> names some function declares ``global`` AND assigns —
+    the only bare names race-eligible as module state."""
+    out: Dict[str, Set[str]] = {}
+    for _path, module, _qual, rec in iter_functions(project):
+        gnames = set(rec.get("globals") or ())
+        if not gnames:
+            continue
+        from dalle_tpu.analysis.project import _iter_ops
+        for op in _iter_ops(rec["body"]):
+            if op["t"] == "assign":
+                for tg in op["tg"]:
+                    if tg in gnames:
+                        out.setdefault(module, set()).add(tg)
+    return out
+
+
+def _attr_ident(project: Project, module: str, cls: str,
+                dotted: str) -> Optional[Tuple]:
+    """``self.<...>`` -> the shared-state ident it touches, or None
+    when it is a lock, a synchronized handoff type, or unresolvable.
+    ``self.a.b`` dereferences a's constructed/annotated type so the
+    access lands on the OWNING class's node (``self.ledger.strikes``
+    -> ``PeerHealthLedger.strikes``)."""
+    parts = dotted.split(".")
+    if len(parts) < 2:
+        return None
+    attr = parts[1]
+    if project.is_lock_attr(module, cls, attr):
+        return None
+    if len(parts) >= 3:
+        ty = None
+        for _m, _n, c in project.cls_mro(module, cls):
+            ty = c.get("attr_types", {}).get(attr)
+            if ty is not None:
+                break
+        if ty is not None:
+            r = project._resolve_class_name(module, ty) \
+                or project._resolve_class_name(module, ty.split(".")[-1])
+            if r is not None:
+                return _attr_ident(project, r[0], r[1],
+                                   "self." + ".".join(parts[2:]))
+        # fall through: mutating `self.a.b` at least mutates the object
+        # held in a — account it against a
+    ty_leaf = project.attr_type_leaf(module, cls, attr)
+    if ty_leaf in _SYNC_TYPE_LEAVES:
+        return None
+    dmod, dcls = project.attr_defining_class(module, cls, attr)
+    return ("attr", dmod, dcls, attr)
+
+
+def _scan_function(project: Project, path: str, module: str, qual: str,
+                   rec: dict, global_writes: Dict[str, Set[str]],
+                   accesses: List[_Access],
+                   call_sites: List[Tuple[Tuple[str, str],
+                                          Tuple[str, str],
+                                          FrozenSet[str]]]) -> None:
+    """Collect every shared-state access and every resolved call site
+    (with held locks) from one lowered function body."""
+    from dalle_tpu.analysis.project import _iter_ops
+    cls = rec["cls"]
+    key = (module, qual)
+    is_init = qual.split(".")[-1] == "__init__"
+    gnames = set(rec.get("globals") or ())
+    gmod = global_writes.get(module, set())
+    # bare names locally rebound (without a global decl) are locals
+    local_roots: Set[str] = set(rec["params"])
+    for op in _iter_ops(rec["body"]):
+        if op["t"] == "assign":
+            for tg in op["tg"]:
+                root = tg.split(".")[0]
+                if root not in gnames:
+                    local_roots.add(root)
+    has_spawn = False
+    # receivers of calls that resolve to PROJECT methods: the
+    # summarizer's conservative container-escape op at the same site
+    # (`self.tracer.add(...)`) is a method call, not a container write
+    method_recv: Set[Tuple[int, str]] = set()
+    for op in _iter_ops(rec["body"]):
+        if op["t"] != "call" or not op.get("fn"):
+            continue
+        fn = op["fn"]
+        if "." in fn and fn.split(".")[-1] in _SPAWN_LEAVES:
+            has_spawn = True
+        if fn.startswith("self.") and fn.count(".") >= 2 \
+                and project.resolve_fn_key(module, cls, qual,
+                                           fn) is not None:
+            method_recv.add((op["l"], ".".join(fn.split(".")[:-1])))
+    hb = {"spawned": False, "joined": False}
+
+    def exempt_now() -> bool:
+        # post-join reads, plus anything before the object/thread is
+        # published: the whole of __init__, and the prefix of a
+        # spawning function before its start()/submit()
+        return hb["joined"] or (not hb["spawned"]
+                                and (is_init or has_spawn))
+
+    def attr_access(dotted: str, kind: str, line: int,
+                    held: FrozenSet[str]) -> None:
+        if cls is None or not dotted.startswith("self."):
+            return
+        ident = _attr_ident(project, module, cls, dotted)
+        if ident is None:
+            return
+        accesses.append(_Access(ident, kind, path, line, held, key,
+                                exempt_now()))
+
+    def global_access(name: str, kind: str, line: int,
+                      held: FrozenSet[str]) -> None:
+        if name not in gmod:
+            return
+        if kind == _R and name in local_roots and name not in gnames:
+            return
+        ident = ("global", module, name)
+        accesses.append(_Access(ident, kind, path, line, held, key,
+                                exempt_now()))
+
+    def walk(block: List[dict], held: FrozenSet[str]) -> None:
+        for op in block:
+            t = op["t"]
+            if t == "with":
+                ids = []
+                for name in op["locks"]:
+                    lid = project.lock_id(module, cls, qual, name)
+                    if lid is not None:
+                        ids.append(lid)
+                walk(op["b"], held | frozenset(ids))
+            elif t == "read":
+                n = op["n"]
+                if n.startswith("self."):
+                    attr_access(n, _R, op["l"], held)
+                elif "." not in n:
+                    global_access(n, _R, op["l"], held)
+            elif t == "assign":
+                line = op.get("l", 0)
+                for tg in op["tg"]:
+                    if tg.startswith("self."):
+                        attr_access(tg, _W, line, held)
+                    elif "." not in tg and tg in gnames:
+                        global_access(tg, _W, line, held)
+            elif t == "wsub":
+                n = op["n"]
+                if n.startswith("self."):
+                    attr_access(n, _W, op["l"], held)
+                elif "." not in n:
+                    global_access(n, _W, op["l"], held)
+            elif t == "escape":
+                h = op["h"]
+                if (op["l"], h) in method_recv:
+                    pass
+                elif h.startswith("self."):
+                    attr_access(h, _W, op["l"], held)
+                elif "." not in h:
+                    global_access(h, _W, op["l"], held)
+            elif t == "call":
+                fn = op.get("fn")
+                if fn:
+                    leaf = fn.split(".")[-1]
+                    ck = project.resolve_fn_key(module, cls, qual, fn)
+                    if ck is None and op.get("inner"):
+                        ck = project.resolve_fn_key(
+                            module, cls, qual, op["inner"])
+                    if ck is not None:
+                        call_sites.append((key, ck, held))
+                    elif fn.startswith("self.") \
+                            and leaf in _MUT_METHS \
+                            and fn.count(".") >= 2:
+                        # receiver-mutating CONTAINER method (a project
+                        # method of the same leaf name resolves above
+                        # and is accounted inside the callee): a write
+                        # of the receiver attribute — the read op
+                        # emitted for the receiver covers the read side
+                        attr_access(".".join(fn.split(".")[:-1]), _W,
+                                    op["l"], held)
+                    if "." in fn:
+                        if leaf in _SPAWN_LEAVES:
+                            hb["spawned"] = True
+                        elif leaf in _JOIN_LEAVES:
+                            hb["joined"] = True
+            elif t == "branch":
+                for b in op["bs"]:
+                    walk(b, held)
+            elif t == "loop":
+                walk(op["b"], held)
+
+    walk(rec["body"], frozenset())
+
+
+def _entry_locksets(call_sites, roots: Set[Tuple[str, str]]
+                    ) -> Dict[Tuple[str, str], FrozenSet[str]]:
+    """Fixpoint: the set of locks GUARANTEED held on entry to each
+    function — the intersection over every call site of (caller's
+    entry set | locks held at the site). Roots (thread entries and
+    functions nobody in-project calls) enter with nothing held."""
+    entry: Dict[Tuple[str, str], Optional[FrozenSet[str]]] = {}
+    for r in roots:
+        entry[r] = frozenset()
+    changed = True
+    while changed:
+        changed = False
+        for caller, callee, held in call_sites:
+            ce = entry.get(caller)
+            if ce is None:
+                continue
+            cand = ce | held
+            cur = entry.get(callee)
+            new = cand if cur is None else (cur & cand)
+            if new != cur:
+                entry[callee] = new
+                changed = True
+    return {k: v for k, v in entry.items() if v is not None}
+
+
+def _guard_lock_id(project: Project, ident: Tuple, name: str) -> str:
+    """Lock id a guarded-by=<name> annotation injects: resolved
+    against the defining class when possible so it unifies with locks
+    the walker actually sees held."""
+    if ident[0] == "attr":
+        lid = project._cls_lock_id(ident[1], ident[2], name)
+        if lid is not None:
+            return lid
+        return f"declared:{ident[1]}:{ident[2]}.{name}"
+    return f"declared:{ident[1]}:{name}"
+
+
+def _race_analysis(project: Project) -> List[Tuple[str, str, int, str]]:
+    """Shared analysis for both race rules, memoized on the project:
+    -> [(rule, path, line, message)]."""
+    cached = getattr(project, "_race_cache", None)
+    if cached is not None:
+        return cached
+    roles = project.thread_roles()
+    entries = {k for _r, k in project.thread_entries()}
+    global_writes = _module_global_writes(project)
+    accesses: List[_Access] = []
+    call_sites: List[Tuple] = []
+    for path, module, qual, rec in iter_functions(project):
+        _scan_function(project, path, module, qual, rec, global_writes,
+                       accesses, call_sites)
+    called = {callee for _c, callee, _h in call_sites}
+    roots = {(m, q) for _p, m, q, _r in iter_functions(project)
+             if (m, q) not in called} | entries
+    entry_held = _entry_locksets(call_sites, roots)
+
+    by_ident: Dict[Tuple, List[_Access]] = {}
+    for a in accesses:
+        by_ident.setdefault(a.ident, []).append(a)
+
+    out: List[Tuple[str, str, int, str]] = []
+    for ident, accs in sorted(by_ident.items(),
+                              key=lambda kv: str(kv[0])):
+        # escape hatches
+        guard_inject: Optional[str] = None
+        if ident[0] == "attr":
+            # HTTP handler instances are constructed per CONNECTION:
+            # do_GET/do_POST on the same object never overlap, so self
+            # state is role-private even though the methods are roles
+            ext = project._external_base_leaves(ident[1], ident[2])
+            if any(e.endswith("HTTPRequestHandler") for e in ext):
+                continue
+            note = project.race_note(ident[1], ident[2], ident[3])
+            if note is not None:
+                if note[0] == "handoff":
+                    continue
+                guard_inject = _guard_lock_id(project, ident, note[1])
+        live = [a for a in accs if not a.exempt]
+        if not live:
+            continue
+        locksets: List[FrozenSet[str]] = []
+        for a in live:
+            eff = a.held | entry_held.get(a.key, frozenset())
+            if guard_inject is not None:
+                eff = eff | {guard_inject}
+            locksets.append(eff)
+        ident_roles: Set[str] = set()
+        for a in live:
+            ident_roles |= roles.get(a.key, {"main"})
+        writes = [i for i, a in enumerate(live) if a.kind == _W]
+        if len(ident_roles) < 2 or not writes:
+            continue
+        label = (f"{ident[2]}.{ident[3]}" if ident[0] == "attr"
+                 else f"module global {ident[2]}")
+        role_txt = ", ".join(sorted(ident_roles))
+        hatch = ("guard every access with one lock, or annotate the "
+                 "attribute's init with `# graftlint: guarded-by="
+                 "<lock>` / `# graftlint: handoff=<mechanism>` (with "
+                 "a justification) if the lock-free design is "
+                 "deliberate" if ident[0] == "attr" else
+                 "guard every access with one lock, or suppress the "
+                 "access lines with `# graftlint: disable="
+                 "shared-write-unlocked` and a justification")
+        unlocked_w = [i for i in writes if not locksets[i]]
+        seen_sites: Set[Tuple[str, int]] = set()
+        if unlocked_w:
+            # a counter-access on another role/lock, for the message
+            other = next((live[j] for j in range(len(live))
+                          if j not in unlocked_w), None)
+            for i in unlocked_w:
+                a = live[i]
+                site = (a.path, a.line)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                ctx = ""
+                if other is not None:
+                    olock = (sorted(locksets[live.index(other)])[0]
+                             if locksets[live.index(other)]
+                             else "no lock")
+                    ctx = (f"; also accessed at {other.path}:"
+                           f"{other.line} under {olock}")
+                out.append((
+                    "shared-write-unlocked", a.path, a.line,
+                    f"write to {label} with NO lock held, but the "
+                    f"state is reachable from roles [{role_txt}]"
+                    f"{ctx} — a lost-update/torn-read race; {hatch}"))
+            continue
+        common = locksets[0]
+        for ls in locksets[1:]:
+            common = common & ls
+        if common:
+            continue
+        # no single lock covers every access: report the accesses
+        # missing the dominant lock
+        counts: Dict[str, int] = {}
+        for ls in locksets:
+            for lid in ls:
+                counts[lid] = counts.get(lid, 0) + 1
+        dominant = max(sorted(counts), key=lambda k: counts[k])
+        for i, a in enumerate(live):
+            if dominant in locksets[i]:
+                continue
+            site = (a.path, a.line)
+            if site in seen_sites:
+                continue
+            seen_sites.add(site)
+            held_txt = (", ".join(sorted(locksets[i]))
+                        if locksets[i] else "no lock")
+            out.append((
+                "lock-inconsistent-access", a.path, a.line,
+                f"{a.kind} of {label} under {held_txt}, but most "
+                f"accesses hold {dominant} (roles [{role_txt}]) — no "
+                f"common lock guards this state; {hatch}"))
+    project._race_cache = out
+    return out
+
+
+@project_rule(
+    "shared-write-unlocked", "race", "error",
+    "Eraser-style lockset race: an attribute or module global reachable"
+    " from two or more thread roles (Thread targets, pool submits,"
+    " Thread-subclass run(), HTTP do_* handlers, plus the implicit"
+    " main role, flooded through the call graph) is WRITTEN with no"
+    " lock held. Happens-before seeding exempts __init__, pre-start()"
+    " publication writes, and post-join() reads; `# graftlint:"
+    " guarded-by=<lock>` and `# graftlint: handoff=<mechanism>`"
+    " declare deliberate lock-free ownership.")
+def shared_write_unlocked(project: Project) -> Iterable[Finding]:
+    findings = [
+        _mk_finding(project, rule, path, line, msg)
+        for rule, path, line, msg in _race_analysis(project)
+        if rule == "shared-write-unlocked"]
+    return [f for f in findings if f is not None]
+
+
+@project_rule(
+    "lock-inconsistent-access", "race", "warning",
+    "Eraser-style lockset race: every access to a multi-role attribute"
+    " or module global holds SOME lock, but the intersection across"
+    " accesses is empty — two code paths use different locks for the"
+    " same state, which synchronizes nothing. Locksets include locks"
+    " guaranteed held on entry (call-graph fixpoint), so helpers only"
+    " ever called under a lock inherit it.")
+def lock_inconsistent_access(project: Project) -> Iterable[Finding]:
+    findings = [
+        _mk_finding(project, rule, path, line, msg)
+        for rule, path, line, msg in _race_analysis(project)
+        if rule == "lock-inconsistent-access"]
+    return [f for f in findings if f is not None]

@@ -453,16 +453,21 @@ class DecodeEngine:
         # reset to 0 at admission), so the loop schedules from THIS —
         # never from a blocking device→host pull
         self._pos_host = np.full((s,), total, np.int32)
-        # engine-thread-only slot table: _Pending per occupied slot
+        # engine-thread-only slot table: _Pending per occupied slot;
+        # readiness()/snapshot() take benign stale reads (telemetry)
+        # graftlint: handoff=engine-thread-owned
         self._slots: List[Optional[_Pending]] = [None] * s
         # completions whose code rows are still in flight to the host:
         # sliced (async) right after the next chunk is dispatched and
         # resolved one iteration later, so the device never idles while
-        # the host turns a row into a response
+        # the host turns a row into a response; engine-thread-owned,
+        # foreign reads are telemetry
+        # graftlint: handoff=engine-thread-owned
         self._harvests: List[Tuple[_Pending, jax.Array]] = []
         # engine-thread-only: requests popped from the queue but not yet
         # landed in _slots (the admission window) — swept by the crash-
         # path cancel so a mid-admission failure can't orphan a handle
+        # graftlint: handoff=engine-thread-owned
         self._admitting: List[_Pending] = []
         self._cv = threading.Condition()
         # per-lane FIFO queues, priority order (scheduler.LANES)
@@ -471,7 +476,9 @@ class DecodeEngine:
         # mid-decode cancellations flagged for the engine thread:
         # rid -> reason; processed (slot freed) at the next boundary
         self._cancel_rids: Dict[int, str] = {}  # guarded by _cv
-        # brownout state: engine thread writes, front-end reads (bool)
+        # brownout state: engine thread writes, front-end reads (bool —
+        # a stale read degrades or upgrades one response, by design)
+        # graftlint: handoff=engine-thread-owned
         self._brownout = False
         self._saturated_since: Optional[float] = None
         self._handles: Dict[int, RequestHandle] = {}   # guarded by _cv
@@ -734,7 +741,8 @@ class DecodeEngine:
         exited (clean stop or crash): /healthz flips and the
         orchestrator restarts or reroutes."""
         if self._thread.ident is None:
-            return not self._stopping
+            with self._cv:
+                return not self._stopping
         return self._thread.is_alive()
 
     def readiness(self) -> dict:
@@ -1071,6 +1079,10 @@ class DecodeEngine:
         """Chaos seam: inject any due artificial queue flood as
         synthetic low-lane requests (bounded by queue capacity — a
         flood models pressure, and pressure is what a full queue is)."""
+        # single-writer stale read on the zero-sync loop: stop() sets
+        # _stopping under _cv, this thread only ever observes it late
+        # by one chunk — taking _cv here would serialize the hot loop
+        # graftlint: disable=lock-inconsistent-access
         if self._chaos is None or self._stopping:
             # no synthetic load once a drain has begun: the fault
             # harness must exercise shutdown, not extend it

@@ -64,8 +64,15 @@ class PixelPipeline:
                  maxsize: int = 32):
         self._fn = pixel_fn
         self._degraded_fn = degraded_fn
+        # bind_metrics/bind_chaos/bind_tracer rebind these ONCE
+        # (None -> engine's instance) right after construction; the
+        # worker tolerates the brief None window, so the unsynchronized
+        # single-transition publication is deliberate
+        # graftlint: handoff=bind-once-wiring
         self._metrics = metrics
+        # graftlint: handoff=bind-once-wiring
         self._chaos = chaos
+        # graftlint: handoff=bind-once-wiring
         self._tracer = None
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._thread = threading.Thread(target=self._run,

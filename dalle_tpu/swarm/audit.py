@@ -1214,6 +1214,19 @@ class AuditWorker(threading.Thread):
         self.ring_evictions = 0
         self.last_report: Optional[dict] = None
 
+    def counters(self) -> Dict[str, int]:
+        """Consistent snapshot of the observability counters, under the
+        same lock step() mutates them with — the stats exposition reads
+        THIS, never the bare attributes from the training thread."""
+        with self._lock:
+            return {
+                "audited": self.audited,
+                "failures": self.failures,
+                "omissions": self.omissions,
+                "unserved": self.unserved,
+                "ring_evictions": self.ring_evictions,
+            }
+
     def submit(self, ra: RoundAudit) -> None:
         if ra is None or not ra.begun:
             return

@@ -564,6 +564,9 @@ class DHT:
         destroyed node is a native use-after-free."""
         if self._node:
             self._lib.swarm_node_destroy(self._node)
+            # the ordering contract above IS the happens-before: every
+            # worker thread that dereferences _node is joined first
+            # graftlint: disable=shared-write-unlocked
             self._node = None
 
     def __enter__(self) -> "DHT":

@@ -481,13 +481,24 @@ class StrikeGossip(threading.Thread):
         #: even the capped accusation — attaching bogus proof is
         #: self-discrediting). Without a verifier, proof receipts fold
         #: exactly like plain r13 receipts (capped influence).
+        # armed once by the owner after codec resolution (a single
+        # None -> ProofVerifier transition the run thread tolerates)
+        # graftlint: handoff=bind-once-wiring
         self.verifier = verifier
         self._stop_event = threading.Event()
         self._seen: set = set()     # (issuer, peer, reason, epoch, ref)
-        self.published = 0          # observability counters
+        # observability counters: written by whichever thread drives
+        # publish_once/fold_once (the run loop, or main via step());
+        # foreign reads are telemetry, a lost increment skews a gauge
+        # graftlint: handoff=single-driver-counter
+        self.published = 0
+        # graftlint: handoff=single-driver-counter
         self.folded = 0
+        # graftlint: handoff=single-driver-counter
         self.proofs_published = 0
+        # graftlint: handoff=single-driver-counter
         self.proofs_convicted = 0
+        # graftlint: handoff=single-driver-counter
         self.proofs_rejected = 0
 
     # -- one synchronous round (tests / soak drive this directly) ---------

@@ -205,7 +205,13 @@ class CollaborativeOptimizer:
             # plane; _apply_averaged drains it into the next gradient
             # application. Reaped by shutdown() before the DHT goes
             # down.
+            # audit plane wiring: created here before the round worker
+            # exists; shutdown() clears them only AFTER auditor.stop()
+            # joins (the dht ordering contract) — the in-between reads
+            # from the worker see either None or a live worker
+            # graftlint: handoff=init-then-joined-teardown
             self._auditor = None
+            # graftlint: handoff=init-then-joined-teardown
             self._audit_policy = None
             self._repair = None
             if getattr(cfg, "audit_gather", False):
@@ -1011,11 +1017,14 @@ class CollaborativeOptimizer:
             "ef_lost_rounds": 0,
         }
         if self._auditor is not None:
-            out["parts_audited"] = self._auditor.audited
-            out["audit_fail"] = self._auditor.failures
-            out["audit_omit"] = self._auditor.omissions
-            out["audit_unserved"] = self._auditor.unserved
-            out["ring_evictions"] = self._auditor.ring_evictions
+            # one locked snapshot, not five bare attribute reads racing
+            # the audit thread's increments
+            ac = self._auditor.counters()
+            out["parts_audited"] = ac["audited"]
+            out["audit_fail"] = ac["failures"]
+            out["audit_omit"] = ac["omissions"]
+            out["audit_unserved"] = ac["unserved"]
+            out["ring_evictions"] = ac["ring_evictions"]
         if self._repair is not None:
             snap = self._repair.snapshot()
             out["repairs_applied"] = snap["applied"]

@@ -170,6 +170,9 @@ class RendezvousFile:
                 # silently drop peers
                 global _FLOCK_WARNED
                 if not _FLOCK_WARNED:
+                    # warn-once latch: a racing double-warn is the
+                    # whole failure mode, and it's cosmetic
+                    # graftlint: disable=shared-write-unlocked
                     _FLOCK_WARNED = True
                     logger.warning(
                         "file lock unavailable for %s (%s): rendezvous "

@@ -124,6 +124,111 @@ MUTATIONS: List[Mutation] = [
             "could reintroduce: the holder references the deleted "
             "buffer",
     ),
+    # -- race family (Eraser-style lockset + thread roles) ----------------
+    Mutation(
+        name="engine-start-field-init-moved",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/serving/engine.py",
+        anchor="    def start(self) -> \"DecodeEngine\":\n"
+               "        self._thread.start()\n"
+               "        return self",
+        replacement="    def start(self) -> \"DecodeEngine\":\n"
+                    "        self._thread.start()\n"
+                    "        self._pos_host = np.full(\n"
+                    "            (self._serving.n_slots,),\n"
+                    "            self._cfg.total_seq_len, np.int32)\n"
+                    "        return self",
+        why="moving a field init AFTER the Thread.start() publication "
+            "point races the engine loop's very first chunk against "
+            "the re-initialization — the init-before-start "
+            "happens-before seed no longer covers the write, and "
+            "_pos_host becomes visible to two roles with no lock",
+    ),
+    Mutation(
+        name="engine-take-cancels-lock-dropped",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/serving/engine.py",
+        anchor="    def _take_cancels(self) -> Dict[int, str]:\n"
+               "        with self._cv:\n"
+               "            cancels, self._cancel_rids = "
+               "self._cancel_rids, {}\n"
+               "        return cancels",
+        replacement="    def _take_cancels(self) -> Dict[int, str]:\n"
+                    "        cancels, self._cancel_rids = "
+                    "self._cancel_rids, {}\n"
+                    "        return cancels",
+        why="the r12 cancel-vs-complete ledger: cancel() appends rids "
+            "under _cv from the front-end while the engine thread "
+            "swaps the dict at the boundary — dropping the lock makes "
+            "the swap lose a concurrent cancellation (the request "
+            "decodes to completion against an owner who already gave "
+            "up)",
+    ),
+    Mutation(
+        name="engine-take-cancels-wrong-lock",
+        rule="lock-inconsistent-access",
+        path="dalle_tpu/serving/engine.py",
+        anchor="    def _take_cancels(self) -> Dict[int, str]:\n"
+               "        with self._cv:\n"
+               "            cancels, self._cancel_rids = "
+               "self._cancel_rids, {}\n"
+               "        return cancels",
+        replacement="    def _take_cancels(self) -> Dict[int, str]:\n"
+                    "        with self.metrics._lock:\n"
+                    "            cancels, self._cancel_rids = "
+                    "self._cancel_rids, {}\n"
+                    "        return cancels",
+        why="holding A lock is not holding THE lock: every other "
+            "_cancel_rids access synchronizes on _cv, so a swap under "
+            "metrics._lock synchronizes nothing — the lockset "
+            "intersection across accesses must come up empty even "
+            "though no single access is bare",
+    ),
+    Mutation(
+        name="router-table-refresh-lock-dropped",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/serving/router.py",
+        anchor="        with self._lock:\n"
+               "            self._table = fresh",
+        replacement="        self._table = fresh",
+        why="the refresher thread republishes the placement table "
+            "every period while request threads read it for placement "
+            "— dropping the lock tears the swap against a concurrent "
+            "snapshot (the r18 router's one load-bearing cross-thread "
+            "handoff)",
+    ),
+    Mutation(
+        name="health-remote-strike-lock-dropped",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/swarm/health.py",
+        anchor="        w = weight or STRIKE_WEIGHTS.get(reason, 1.0)\n"
+               "        with self._lock:",
+        replacement="        w = weight or "
+                    "STRIKE_WEIGHTS.get(reason, 1.0)\n"
+                    "        if True:",
+        why="StrikeGossip.run folds verified receipts into the ledger "
+            "through remote_strike (resolved through the "
+            "PeerHealthLedger ctor annotation) while the training "
+            "thread reads scores under _lock — dropping the fold's "
+            "lock loses concurrent strikes from the reputation ledger",
+    ),
+    Mutation(
+        name="engine-readiness-pos-mirror-read",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/serving/engine.py",
+        anchor="        out[\"live_slots\"] = sum(p is not None "
+               "for p in self._slots)",
+        replacement="        out[\"live_slots\"] = sum(p is not None "
+                    "for p in self._slots)\n"
+                    "        out[\"decode_pos_min\"] = "
+                    "int(self._pos_host.min())",
+        why="reading the engine-thread-owned position mirror from the "
+            "probe role drags _pos_host into two roles: unlike _slots "
+            "(annotated handoff: fixed-length list of refs), a numpy "
+            "reduction over a vector the loop mutates in place can "
+            "tear mid-scan — the detector must flag the loop's "
+            "unlocked writes once a second role reads the mirror",
+    ),
 ]
 
 

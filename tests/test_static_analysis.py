@@ -949,6 +949,82 @@ def open_before_lock(path):
         pass
 """,
     ),
+    # v3 race family: Eraser-style lockset over the thread-role graph.
+    # The violating sides spawn a real Thread(target=...) so the state
+    # is reachable from two roles; the idiomatic sides double as the
+    # init-before-start exemption regression (the __init__ writes
+    # BEFORE .start() never count as racy).
+    (
+        "shared-write-unlocked",
+        "dalle_tpu/fake_race.py",
+        """
+import threading
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0               # pre-start init: exempt
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        while True:
+            with self._lock:
+                self.total += 1
+    def reset(self):
+        self.total = 0               # main-role write, no lock: races
+""",
+        """
+import threading
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0               # pre-start init: exempt
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        while True:
+            with self._lock:
+                self.total += 1
+    def reset(self):
+        with self._lock:
+            self.total = 0
+""",
+    ),
+    (
+        "lock-inconsistent-access",
+        "dalle_tpu/fake_race.py",
+        """
+import threading
+class Stats:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.rounds = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        with self._a:
+            self.rounds += 1
+    def snapshot(self):
+        with self._b:                # a lock, but not THE lock: the
+            return self.rounds       # lockset intersection is empty
+""",
+        """
+import threading
+class Stats:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.rounds = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        with self._a:
+            self.rounds += 1
+    def snapshot(self):
+        with self._a:                # same lock everywhere
+            return self.rounds
+""",
+    ),
 ]
 
 
@@ -1119,6 +1195,172 @@ def train(state, grads):
           "args": ["state", "grads"], "l": 4}
     assert proj.donate_positions(
         "dalle_tpu.fake_train", None, "train", op) == [0]
+
+
+# -- race family: happens-before seeds, escape hatches, thread roles ------
+
+_RACE_RULES = ["shared-write-unlocked", "lock-inconsistent-access"]
+
+
+def _race(src):
+    return [(f.rule, f.line) for f in
+            analyze_source(src, path="dalle_tpu/fake_race.py",
+                           rules=_RACE_RULES)]
+
+
+def test_race_post_join_exemption():
+    """A read AFTER .join() has a happens-before edge to every write
+    the joined thread made — the classic fork/join result pickup must
+    stay quiet, and deleting the join must flag the thread's write."""
+    good = """
+import threading
+class Once:
+    def __init__(self):
+        self.result = None
+        self._t = threading.Thread(target=self._run)
+    def _run(self):
+        self.result = 42
+    def wait(self):
+        self._t.start()
+        self._t.join()
+        return self.result
+"""
+    assert _race(good) == []
+    racy = good.replace("        self._t.join()\n", "")
+    assert _race(racy) == [("shared-write-unlocked", 8)]
+
+
+def test_race_queue_handoff_is_exempt():
+    """Synchronized container types (queue.Queue and friends) ARE the
+    happens-before mechanism — attributes holding one never race."""
+    src = """
+import threading
+import queue
+class Pipe:
+    def __init__(self):
+        self.q = queue.Queue()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        self.q.put(1)
+    def take(self):
+        return self.q.get()
+"""
+    assert _race(src) == []
+
+
+def test_race_handoff_annotation():
+    """`# graftlint: handoff=<mechanism>` on the init site declares a
+    protocol-level happens-before the lockset can't see; without it the
+    same shape is flagged at every unlocked access."""
+    noted = """
+import threading
+class Batch:
+    def __init__(self):
+        self.buf = []  # graftlint: handoff=drained-before-start
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        self.buf.append(1)
+    def drain(self):
+        out, self.buf = self.buf, []
+        return out
+"""
+    assert _race(noted) == []
+    bare = noted.replace("  # graftlint: handoff=drained-before-start",
+                         "")
+    assert [r for r, _l in _race(bare)] == \
+        ["shared-write-unlocked", "shared-write-unlocked"]
+
+
+def test_race_guarded_by_annotation():
+    """`# graftlint: guarded-by=<lock>` asserts every access happens
+    under that lock — the declared guard joins every lockset, so the
+    intersection can never come up empty for this attribute."""
+    noted = """
+import threading
+class Mirror:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.view = {"a": 1}  # graftlint: guarded-by=_lock
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        self.view = {"b": 2}
+    def read(self):
+        return self.view
+"""
+    assert _race(noted) == []
+    bare = noted.replace('  # graftlint: guarded-by=_lock', "")
+    assert _race(bare) == [("shared-write-unlocked", 10)]
+
+
+_ROLE_WORKER = """
+import threading
+_lock = threading.Lock()
+pending = []
+def loop():
+    global pending
+    pending = []
+def flush():
+    global pending
+    with _lock:
+        pending = [1]
+def helper():
+    loop()
+"""
+
+_ROLE_SPAWNER = """
+import threading
+from pkg.worker import loop, flush
+def boot():
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    flush()
+    return t
+"""
+
+
+def test_thread_role_pass_on_lowered_ir():
+    """The role substrate directly: cross-module Thread(target=...)
+    discovery, role flooding along the call graph (a function called
+    from a role-less caller ALSO carries "main" — dual-role), and the
+    spawner->target file dependency edge --diff consumes."""
+    from dalle_tpu.analysis.project import Project, summarize_source
+    srcs = {"pkg/worker.py": _ROLE_WORKER,
+            "pkg/spawner.py": _ROLE_SPAWNER}
+    proj = Project({p: summarize_source(p, s) for p, s in srcs.items()},
+                   srcs)
+    assert proj.thread_entries() == [
+        ("pkg.worker:loop", ("pkg.worker", "loop"))]
+    roles = proj.thread_roles()
+    # entry function runs under its own role AND main (helper calls it)
+    assert roles[("pkg.worker", "loop")] == {"main", "pkg.worker:loop"}
+    assert roles[("pkg.worker", "flush")] == {"main"}
+    assert roles[("pkg.spawner", "boot")] == {"main"}
+    assert proj.spawn_dependencies() == {
+        "pkg/spawner.py": {"pkg/worker.py"}}
+
+
+def test_diff_scope_expands_with_spawn_dependencies(tmp_path):
+    """--diff semantics for whole-program verdicts: editing only the
+    SPAWNER must still surface the race findings it induces in the
+    (textually unchanged) target module — role assignment is whole-
+    program, so the changed set expands by its spawn-dependency
+    closure. An unrelated changed set reports nothing."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "worker.py").write_text(_ROLE_WORKER)
+    (pkg / "spawner.py").write_text(_ROLE_SPAWNER)
+    full = analyze_paths([str(pkg)], root=str(tmp_path))
+    assert [(f.rule, f.path) for f in full] == \
+        [("shared-write-unlocked", "pkg/worker.py")]
+    diff = analyze_paths([str(pkg)], root=str(tmp_path),
+                         changed_only={"pkg/spawner.py"})
+    assert [(f.rule, f.path) for f in diff] == \
+        [("shared-write-unlocked", "pkg/worker.py")]
+    assert analyze_paths([str(pkg)], root=str(tmp_path),
+                         changed_only=set()) == []
 
 
 # Mutation sensitivity on the REAL modules lives in the corpus now:
@@ -1358,7 +1600,8 @@ def test_json_format_reports_per_rule_stats(tmp_path, capsys):
     stats = doc["stats"]
     assert set(stats["cache"]) == {"hits", "partial", "misses"}
     for rid in ("use-after-donate", "donated-escape", "lock-order-cycle",
-                "rng-key-reuse"):
+                "rng-key-reuse", "shared-write-unlocked",
+                "lock-inconsistent-access"):
         assert rid in stats["rules"]
         assert set(stats["rules"][rid]) == {"findings", "seconds"}
 
@@ -1418,6 +1661,41 @@ def test_sarif_output_matches_golden():
     assert [r["id"] for r in
             doc2["runs"][0]["tool"]["driver"]["rules"]] \
         == ["silent-except"]
+
+
+_RACE_SARIF_SRC = """
+import threading
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def _run(self):
+        while True:
+            with self._lock:
+                self.total += 1
+    def reset(self):
+        self.total = 0
+"""
+
+
+def test_race_sarif_output_matches_golden():
+    """The race family's machine face, pinned: rule metadata row,
+    error-level mapping, the counter-access context in the message, and
+    a stable fingerprint — CI annotators key on all four."""
+    import json
+    from dalle_tpu.analysis import sarif
+    findings = analyze_sources(
+        {"dalle_tpu/fake_race_sarif.py": _RACE_SARIF_SRC},
+        rules=["shared-write-unlocked"])
+    assert [f.rule for f in findings] == ["shared-write-unlocked"]
+    doc = json.loads(sarif.to_sarif(findings))
+    golden_path = os.path.join(REPO, "tests", "golden",
+                               "graftlint_race.sarif.json")
+    with open(golden_path, "r", encoding="utf-8") as fh:
+        golden = json.load(fh)
+    assert doc == golden
 
 
 def test_repo_scan_is_clean_against_baseline():
