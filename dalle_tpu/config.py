@@ -327,6 +327,22 @@ class CollabConfig:
     # wire_bits knobs pinned; False + 8-bit leaves every round
     # byte-identical to the r14 protocol.
     ef_residuals: bool = False
+    # --- In-collective hop pipelining (DynamiQ arXiv 2602.08923,
+    # EQuARX arXiv 2506.17615: the win is overlapping compressed hops
+    # INSIDE the collective against compute, not just overlapping the
+    # round as a whole). With pipeline_hops the butterfly's legs stop
+    # being strictly sequential: gather-leg frames drain/decode/apply
+    # on a background thread from round start, the owner's averaged
+    # part is served as soon as the reduce finishes (before the scatter
+    # barrier + EF store), and scatter parts are encoded/sent with at
+    # most pipeline_depth parts in flight so encode(part i+1) overlaps
+    # send(part i). OFF leaves every round byte-identical to the
+    # sequential protocol; ON changes only wall-clock placement — the
+    # averaged bytes, EF residuals, and audit transcripts are bit-exact
+    # either way (pinned by tests/test_pipeline.py).
+    pipeline_hops: bool = False
+    # Max scatter parts concurrently in the encode/send window (>=1).
+    pipeline_depth: int = 2
     powersgd_rank: int = 4
     # Run PowerSGD's Gram-Schmidt on the host (bit-stable IEEE f32 loop
     # order) instead of on device. Cross-peer basis agreement needs every

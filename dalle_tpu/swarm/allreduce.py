@@ -164,6 +164,348 @@ def unflatten_tensors(flat: np.ndarray,
     return out
 
 
+class _HopMeter:
+    """Per-(part, leg) hop aggregation for ``report["phases"]["hops"]``
+    plus live per-hop span emission into an obs tracer.
+
+    Spans are emitted per CHUNK as the work completes, under four
+    BOUNDED phase ids (``ar_hop_scatter`` / ``ar_hop_reduce`` /
+    ``ar_hop_gather`` / ``ar_hop_gather_serve`` — part and chunk index
+    ride as span attributes, so the exposition histograms keep bounded
+    cardinality); the report rows aggregate first-start -> last-end
+    wall, total wire bytes and chunk count per (leg, part). Thread-
+    safe by one internal lock: chunks complete on codec/send pool
+    workers, on the reduce drain, and on the pipelined gather drain
+    thread concurrently.
+    """
+
+    def __init__(self, tracer=None, trace: str = "") -> None:
+        self._lock = _threading.Lock()
+        self._rows: Dict[Tuple[str, int], list] = {}
+        self._tracer = tracer
+        self._trace = trace
+
+    def note(self, leg: str, part: int, t0: float, dur_s: float,
+             nbytes: int, hop: int) -> None:
+        tr = self._tracer
+        if tr is not None:
+            tr.add("swarm", "ar_hop_" + leg, self._trace, t0, dur_s,
+                   part=part, hop=hop, bytes=nbytes)
+        with self._lock:
+            row = self._rows.get((leg, part))
+            if row is None:
+                self._rows[(leg, part)] = [t0, t0 + dur_s, nbytes, 1]
+            else:
+                row[0] = min(row[0], t0)
+                row[1] = max(row[1], t0 + dur_s)
+                row[2] += nbytes
+                row[3] += 1
+
+    def rows(self) -> List[dict]:
+        with self._lock:
+            items = sorted(self._rows.items())
+        return [{"part": part, "leg": leg,
+                 "wall_s": round(t1 - t0, 6), "bytes": b, "chunks": n}
+                for (leg, part), (t0, t1, b, n) in items]
+
+
+def _scatter_pipeline(pool, produce, part_tasks, depth, on_part):
+    """Bounded-depth scatter scheduling (``pipeline_hops``): submit the
+    chunk tasks of at most ``depth`` parts at a time; each part's
+    completion launches the next, so encode(part i+1) overlaps
+    send(part i) without the sequential path's submit-everything burst
+    (which queues every chunk of every part up front and lets the pool
+    interleave them arbitrarily). Returns ``(done_event, snapshot)``:
+    the event is set once every chunk of every part completed, after
+    which ``snapshot()`` is stable and complete.
+
+    Completion callbacks run on pool worker threads; the scheduler
+    state lives behind one lock, and the futures list must only be
+    consumed through ``snapshot()`` after the event is set.
+    """
+    done = _threading.Event()
+    futures: List[concurrent.futures.Future] = []
+    lock = _threading.Lock()
+    if not part_tasks:
+        done.set()
+        return done, lambda: []
+    state = {"next": 0, "left": sum(len(a) for _k, a in part_tasks)}
+    remaining = {k: len(a) for k, a in part_tasks}
+
+    def submit_part(idx: int) -> None:
+        _k, args_list = part_tasks[idx]
+
+        def chunk_done(_f, part=_k):
+            launch = None
+            with lock:
+                state["left"] -= 1
+                remaining[part] -= 1
+                part_complete = remaining[part] == 0
+                if part_complete and state["next"] < len(part_tasks):
+                    launch = state["next"]
+                    state["next"] += 1
+                all_done = state["left"] == 0
+            if part_complete and on_part is not None:
+                on_part("scatter", part)
+            if launch is not None:
+                submit_part(launch)
+            if all_done:
+                done.set()
+
+        for a in args_list:
+            f = pool.submit(produce, *a)
+            with lock:
+                futures.append(f)
+            f.add_done_callback(chunk_done)
+
+    first = min(max(1, int(depth)), len(part_tasks))
+    with lock:
+        state["next"] = first
+    for i in range(first):
+        submit_part(i)
+
+    def snapshot() -> List[concurrent.futures.Future]:
+        with lock:
+            return list(futures)
+    return done, snapshot
+
+
+class _GatherPipeline:
+    """Early gather drain for the pipelined butterfly (pipeline_hops).
+
+    Sequential rounds collect gather frames only after the scatter
+    barrier and the EF scatter store; pipelined rounds start THIS
+    drain at round start, so other owners' averaged parts decode and
+    land in the output buffer while the local reduce/scatter legs are
+    still running — the r5 pipelined drain generalized across legs.
+
+    Thread shape: one daemon drain thread recv's the gather tag and
+    applies decoded chunks (decodes run on a private pool); the ROUND
+    thread polls hop progress and finally joins in ``finish()``. The
+    per-part in-flight table ``_parts`` and the completion flags
+    ``_complete`` / ``_dead`` are guarded by ``_cv`` on every thread;
+    ``finish()`` reads the leftover table, the drain's ban verdicts
+    and the progress bit under ``_cv`` BEFORE the join, then hands
+    them to the round thread for the ledger/report merge (the drain
+    never calls ``ban_peer`` itself — the report sink lists are
+    round-thread state). The output buffer and the parts-left mirror
+    are the two deliberate lock-free exceptions, annotated below.
+    """
+
+    def __init__(self, dht, group, out, slices, part_chunks, pending,
+                 sender_to_part, gather_tag, gather_ctx, codec_mod,
+                 pin_gather, decrypt, audit, audited_parts, deadline,
+                 sender_timeout, gather_baseline, meter, on_part):
+        self._dht = dht
+        self._group = group
+        # gather chunks land in non-overlapping [lo, hi) slices of the
+        # round's output buffer, and the round thread reads it only
+        # after finish() joins the drain thread; no two threads ever
+        # touch the same element concurrently
+        # graftlint: handoff=disjoint-slice-writes
+        self._out = out
+        self._slices = slices
+        self._part_chunks = part_chunks
+        self._sender_to_part = sender_to_part
+        self._tag = gather_tag
+        self._ctx = gather_ctx
+        self._codec_mod = codec_mod
+        self._pin = pin_gather
+        self._decrypt = decrypt
+        self._audit = audit
+        self._audited = audited_parts
+        self._deadline = deadline
+        self._sender_timeout = sender_timeout
+        self._baseline = gather_baseline
+        self._meter = meter
+        self._on_part = on_part
+        self._cv = _threading.Condition()
+        # part -> pending chunk ids: the per-part in-flight table,
+        # guarded by _cv on BOTH threads (the drain completes chunks
+        # and pops finished parts; the round thread reads the
+        # leftovers in finish())
+        self._parts: Dict[int, set] = pending
+        self._n0 = len(pending)
+        self._complete = False  # every part landed/abandoned — under _cv
+        self._dead = False      # drain thread exited — under _cv
+        self._stop = False      # round thread abort request — under _cv
+        self._bans: List[Tuple[str, str]] = []  # (peer_id, reason) — _cv
+        self._progressed = False  # any chunk/ban landed — under _cv
+        # the drain thread alone writes this count of parts still
+        # pending; the round thread's hop-progress poll reads it
+        # lock-free and tolerates a stale value (at worst one delayed
+        # progress report) — correctness stays with the _cv-guarded
+        # table above
+        # graftlint: handoff=single-writer-mirror
+        self._parts_left = len(pending)
+        self._thread = _threading.Thread(
+            target=self._drain, name="allreduce-gather-drain",
+            daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def remaining(self) -> int:
+        """Parts still pending — lock-free single-writer mirror, for
+        hop-progress polling only."""
+        return self._parts_left
+
+    def request_stop(self) -> None:
+        """Abort the drain early (crash-path cleanup); the normal path
+        ends through completion/deadline + ``finish()``."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def finish(self) -> Tuple[Dict[int, set], List[Tuple[str, str]],
+                              bool]:
+        """Round-thread side: wait out the drain (it exits on
+        completion, the round deadline, or the no-progress timeout —
+        the same bounds as the sequential collect loop), then hand
+        back the leftover pending table, the bans the drain recorded,
+        and whether any chunk ever landed (the strike-attribution
+        bit)."""
+        with self._cv:
+            while not (self._complete or self._dead):
+                self._cv.wait(timeout=0.5)
+            leftover = {k: set(v) for k, v in self._parts.items()}
+            bans = list(self._bans)
+            progressed = self._progressed
+        self._thread.join()
+        return leftover, bans, progressed
+
+    # -- drain thread --------------------------------------------------
+
+    def _drain(self) -> None:
+        dec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=_pool_workers(4))
+        try:
+            decoding: List[concurrent.futures.Future] = []
+            # anchor the no-progress timer past the senders' own
+            # legitimate stall window, exactly like the sequential
+            # collect loop (owners post their parts late when they
+            # waited out a dead peer)
+            last_progress = max(time.monotonic(), self._baseline)
+            while True:
+                with self._cv:
+                    if self._stop:
+                        break
+                    if not self._parts:
+                        self._complete = True
+                        break
+                now = time.monotonic()
+                if now >= self._deadline or (
+                        not decoding
+                        and now - last_progress >= self._sender_timeout):
+                    break  # dead owners: their parts keep local values
+                still: List[concurrent.futures.Future] = []
+                for f in decoding:
+                    if not f.done():
+                        still.append(f)
+                        continue
+                    if self._apply(f.result()):
+                        last_progress = time.monotonic()
+                decoding = still
+                raw = self._dht.recv(self._tag, timeout=min(
+                    0.2, max(0.05, self._deadline - now)))
+                if raw is not None:
+                    decoding.append(dec_pool.submit(self._decode, raw))
+            # salvage decodes that already completed — without waiting
+            # (the deadline is a promise to the caller, same semantics
+            # as the sequential drain's no-wait salvage)
+            for f in decoding:
+                if f.done():
+                    self._apply(f.result())
+        finally:
+            dec_pool.shutdown(wait=False)
+            with self._cv:
+                self._complete = self._complete or not self._parts
+                self._dead = True
+                self._cv.notify_all()
+
+    def _decode(self, raw_enc: bytes):
+        t_d = time.monotonic()
+        raw = self._decrypt(raw_enc)
+        if raw is None:
+            return None
+        head = _peek(raw, self._group)
+        if head is None:
+            return None
+        part = self._sender_to_part.get(head[0])
+        if part is None:
+            return None
+        with self._cv:
+            live = part in self._parts
+        if not live:
+            return None  # completed part: skip the multi-MB decode
+        parsed = _parse(raw, self._group, self._part_chunks[part],
+                        self._ctx, self._codec_mod, pinned=self._pin)
+        if parsed is None:
+            return None
+        return part, parsed, _HDR.unpack_from(raw)[6], raw, t_d
+
+    def _apply(self, res) -> bool:
+        if res is None:
+            return False
+        part, (status, sender, _w, ci, data), gcodec, raw, t_d = res
+        if status == "bad":
+            # the part OWNER is serving damaged bytes: stop waiting on
+            # it — the part keeps this peer's local values (dead-owner
+            # elasticity), the ban is handed to the round thread
+            dropped = False
+            with self._cv:
+                if part in self._parts:
+                    self._parts.pop(part, None)
+                    self._bans.append(
+                        (self._group.members[sender].peer_id,
+                         "corrupt-chunk"))
+                    self._progressed = True
+                    dropped = True
+                    self._cv.notify_all()
+            if not dropped:
+                return False
+            self._parts_left -= 1
+            logger.warning(
+                "allreduce[pipelined]: part %d owner %s served a "
+                "corrupt/truncated chunk — keeping local values for "
+                "that part", part,
+                self._group.members[sender].peer_id[:16])
+            return True
+        plo, _phi = self._slices[part]
+        pclo, pchi = self._part_chunks[part][ci]
+        done_part = False
+        with self._cv:
+            pend_set = self._parts.get(part)
+            if pend_set is None or ci not in pend_set:
+                return False  # duplicate chunk or completed part
+            pend_set.discard(ci)
+            self._progressed = True
+            if not pend_set:
+                self._parts.pop(part, None)
+                done_part = True
+                self._cv.notify_all()
+        # lock-free by design: chunks write disjoint slices (see the
+        # _out handoff note above)
+        self._out[plo + pclo:plo + pchi] = data
+        if self._audit is not None and part in self._audited:
+            self._audit.note_gather_codec(part, ci, gcodec)
+            self._audit.note_gather_frame(part, ci, raw)
+        if self._meter is not None:
+            self._meter.note("gather", part, t_d,
+                             time.monotonic() - t_d, len(raw), ci)
+        if done_part:
+            self._parts_left -= 1
+            if self._audit is not None and part in self._audited:
+                # retain the exact bytes this member will live with —
+                # the replay's comparison target (the final chunk's
+                # write above happens-before this read: same thread)
+                alo, ahi = self._slices[part]
+                self._audit.note_gathered(part, self._out[alo:ahi])
+            if self._on_part is not None:
+                self._on_part("gather", part)
+        return True
+
+
 def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   tensors: Sequence[np.ndarray], weight: float,
                   allreduce_timeout: float = 60.0,
@@ -181,7 +523,12 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                   gather_codec: Optional[int] = None,
                   ef_scatter=None,
                   ef_gather=None,
-                  pin_codec: bool = False
+                  pin_codec: bool = False,
+                  pipeline_hops: bool = False,
+                  pipeline_depth: int = 2,
+                  tracer=None,
+                  trace: str = "",
+                  progress=None
                   ) -> List[np.ndarray]:
     """Weighted-average ``tensors`` across the group; returns new arrays.
 
@@ -318,6 +665,42 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     the served (quantized) part bit-exactly — see swarm/error_feedback
     .py's determinism contract; the fresh error is still stored. With
     both EF legs None, rounds are byte-identical to the r14 protocol.
+
+    ``pipeline_hops`` rebuilds the round's INSIDE as a per-part
+    pipeline (CollabConfig.pipeline_hops): a background drain collects
+    and applies gather frames from round start (other owners serve
+    their parts as soon as their reduces finish — waiting for the
+    local scatter barrier to even LOOK at them is pure exposed wall);
+    this owner's part is served the moment its reduce completes,
+    before the scatter barrier and the EF scatter store; and scatter
+    parts are encoded/sent with at most ``pipeline_depth`` parts in
+    flight, so encode(part i+1) overlaps send(part i). OFF keeps the
+    sequential protocol byte-identical; ON changes only wall-clock
+    placement — the wire bytes, averaged values, EF residuals and
+    audit transcripts are bit-exact either way because every protocol
+    ordering that feeds bytes (audit-post-before-serve, EF compensate
+    -> encode -> store, per-part chunk dedup, recorded accumulation
+    order) is preserved, only moved earlier. Client-mode members and
+    weight-0 assistants always run the sequential path (they collect
+    via mailbox pulls / not at all).
+
+    ``tracer`` / ``trace`` (optional obs.trace.Tracer + protocol trace
+    id) emit live per-hop spans — phase ids ``ar_hop_scatter`` /
+    ``ar_hop_reduce`` / ``ar_hop_gather`` / ``ar_hop_gather_serve``
+    with (part, hop, bytes) attributes — from inside the round, in
+    BOTH modes, so cross-peer timelines can prove (not infer) hop/
+    compute overlap. When either a tracer or a ``report`` is given,
+    ``report["phases"]["hops"]`` also receives aggregated per-(leg,
+    part) rows ``{part, leg, wall_s, bytes, chunks}``.
+
+    ``progress`` (optional callable ``(leg, part)``) is invoked on
+    part-granular completion events — scatter part fully sent
+    (pipelined mode only: the sequential burst submit has no per-part
+    completion), own part reduced, a gathered part fully applied — so
+    the caller's round thread can expose hop-granular progress while
+    parts are still in flight. It is called from pool/drain threads
+    and must be thread-safe; exceptions are swallowed (a progress sink
+    must never kill the wire round).
     """
     from dalle_tpu.swarm.crypto import maybe_decrypt, maybe_encrypt
     gkey = group.group_key
@@ -347,7 +730,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     if tamper is not None:
         tensors, frame_weight = tamper(epoch, tensors, weight,
                                        prefix=prefix)
-    phases: Dict[str, float] = {}
+    # wall time per protocol phase (floats), plus — when hop metering
+    # is armed — the per-(leg, part) "hops" row list
+    phases: Dict[str, object] = {}
     corrupt_senders: List[str] = []
     timeout_senders: List[str] = []
     screened_senders: List[str] = []
@@ -360,6 +745,21 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         report["timeout_senders"] = timeout_senders
         report["screened_senders"] = screened_senders
         report["overweight_senders"] = overweight_senders
+    # per-hop observability: armed by either sink (the tracer gets live
+    # spans, the report gets aggregated rows); None keeps the hot paths
+    # free of even the timestamp reads
+    meter = (_HopMeter(tracer, trace)
+             if (tracer is not None or report is not None) else None)
+
+    def note_part(leg: str, part: int) -> None:
+        if progress is None:
+            return
+        try:
+            progress(leg, part)
+        except Exception:  # noqa: BLE001 — a progress sink must never
+            # kill the wire round
+            logger.debug("allreduce: progress hook failed",
+                         exc_info=True)
 
     def ban_peer(peer_id: str, reason: str, strike: bool = True) -> None:
         """Cross-round memory of an in-round ban: one ledger strike per
@@ -491,6 +891,49 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     def fetch_chunk(addr: str, tag: int, timeout: float) -> Optional[bytes]:
         return maybe_decrypt(gkey, dht.fetch(addr, tag, timeout=timeout))
 
+    # --- pipelined mode (pipeline_hops): arm the early gather drain
+    # and the dedicated serve pools before any leg starts. The output
+    # buffer must exist NOW (the drain applies other owners' parts into
+    # it from round start); ``flat`` is final at this point — nothing
+    # below mutates it — so the copy is byte-identical to the
+    # sequential path's later one. Client-mode members (mailbox pulls)
+    # and weight-0 assistants (no collection at all) keep the
+    # sequential path. On a crash mid-round the drain self-terminates
+    # at the deadline (daemon thread) and the pools' idle workers exit
+    # when the executor is collected — cleanup needs no global
+    # try/finally.
+    pipe = None
+    serve_pool = serve_codec_pool = None
+    out: Optional[np.ndarray] = None
+    if (pipeline_hops and weight > 0 and bool(me.addr)
+            and len(owners) > 1):
+        out = flat.copy()
+        part_chunks_all = {k: _chunk_slices(hi_ - lo_, chunk_elems)
+                           for k, (lo_, hi_) in enumerate(slices)}
+        pend0 = {owner_index[m.peer_id]: set(range(len(
+            part_chunks_all[owner_index[m.peer_id]])))
+            for m in owners if m.peer_id != me.peer_id}
+        sender_to_part_all = {
+            group.members.index(m): owner_index[m.peer_id]
+            for m in owners}
+        serve_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=_pool_workers(8))
+        serve_codec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=_pool_workers(4))
+        pipe = _GatherPipeline(
+            dht=dht, group=group, out=out, slices=slices,
+            part_chunks=part_chunks_all, pending=pend0,
+            sender_to_part=sender_to_part_all,
+            gather_tag=_tag(prefix, epoch, "gather", me.peer_id),
+            gather_ctx=gather_ctx, codec_mod=codec_mod,
+            pin_gather=pin_gather,
+            decrypt=lambda b: maybe_decrypt(gkey, b),
+            audit=audit, audited_parts=audited_parts,
+            deadline=deadline, sender_timeout=sender_timeout,
+            gather_baseline=gather_baseline, meter=meter,
+            on_part=note_part)
+        pipe.start()
+
     # Device-codec parts: the whole part is quantized in ONE device call,
     # shared lazily by its chunk producers (the first pool task to need
     # it pays the dispatch, so part encodes overlap the wire exactly like
@@ -532,9 +975,11 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
     # the codec). chunk_idx places each frame; order is irrelevant.
     scatter_enc_codec = _enc_codec_for(codec)
 
-    def produce_scatter(addr: str, tag: int, ctx: bytes, lo: int, clo: int,
-                        chi: int, ci: int, n_chunks: int, enc_get
+    def produce_scatter(addr: str, tag: int, ctx: bytes, part: int,
+                        lo: int, clo: int, chi: int, ci: int,
+                        n_chunks: int, enc_get
                         ) -> Tuple[str, int, bytes, bool]:
+        t_c0 = time.monotonic()
         nelem = chi - clo
         c = part_codec(nelem)
         if enc_get is not None and c == scatter_enc_codec:
@@ -546,7 +991,156 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                            group.my_index, frame_weight, nelem, c,
                            payload, chunk=ci, n_chunks=n_chunks)
         wire_body = maybe_encrypt(gkey, body)
-        return addr, tag, wire_body, send_raw(addr, tag, wire_body)
+        ok = send_raw(addr, tag, wire_body)
+        if meter is not None:
+            meter.note("scatter", part, t_c0,
+                       time.monotonic() - t_c0, len(wire_body), ci)
+        return addr, tag, wire_body, ok
+
+    # --- the serve seam, shared by both modes ---------------------------
+    # pre_serve(): transcript post -> EF second stage -> chaos tamper
+    # seam, in THAT order (the ordering is part of the audit contract).
+    # start_serve(): compress + local-apply + sign + encrypt this
+    # owner's averaged part per chunk and fan the sends out. The
+    # sequential path calls both between the scatter barrier and the
+    # gather collect (the historical protocol point); the pipelined
+    # path calls both the moment the reduce finishes, so the serve
+    # overlaps the scatter barrier and the EF scatter store — same
+    # bytes, earlier wall-clock.
+    ef_gather_active = False
+    send_lock = _threading.Lock()
+    g_futures: List[concurrent.futures.Future] = []
+    g_sends: List[Tuple[str, int, bytes]] = []
+    g_produce: List[concurrent.futures.Future] = []
+
+    def pre_serve() -> None:
+        nonlocal averaged_mine, ef_gather_active
+        # serve the audit transcript BEFORE the part: any member that
+        # completes the gather can immediately fetch the honest record
+        # the owner signed (the post is mailbox-local, no round-trips)
+        if retain_mine and averaged_mine is not None:
+            t_post = time.monotonic()
+            try:
+                if not audit.post_transcript(dht):
+                    # a False post (native mailbox rc != 0, chaos
+                    # fault) is the same outcome as the raise below:
+                    # members that gathered this part will strike
+                    # audit-timeout — the owner deserves a local
+                    # diagnostic either way
+                    logger.warning(
+                        "allreduce: audit transcript post rejected by "
+                        "the mailbox — part %d's challenge will go "
+                        "unserved", my_part)
+            except Exception:  # noqa: BLE001 - an unserved transcript
+                # only costs THIS owner audit-timeout strikes; the
+                # round must not die for it
+                logger.warning("allreduce: audit transcript post "
+                               "failed", exc_info=True)
+            phases["audit_post_s"] = round(time.monotonic() - t_post, 3)
+        # EF second stage (DynamiQ): the owner carries its own residual
+        # into the gather re-quantize — SUSPENDED on audit-challenged
+        # parts, so the replay's codec round-trip of the replayed
+        # average stays bit-exact without any private residual entering
+        # a transcript (a buffer a hostile owner could fabricate to
+        # "explain" a wrong part; the deterministic challenge means
+        # owner and auditors agree on the suspension at round start).
+        # The fresh error is still stored after the serve.
+        ef_gather_active = (ef_gather is not None and my_part is not None
+                            and averaged_mine is not None and weight > 0)
+        if ef_gather_active and my_part not in audited_parts:
+            glo_, ghi_ = slices[my_part]
+            averaged_mine = ef_gather.compensate_slice(
+                averaged_mine, glo_, ghi_, flat.size)
+        # hostile-owner chaos seam (swarm/chaos.py wrong_gather_part):
+        # an active op rewrites the part THIS owner is about to serve —
+        # after the honest average and after the transcript, which is
+        # exactly the attack shape the replay audit convicts
+        tamper_part = getattr(dht, "tamper_gather_part", None)
+        if (tamper_part is not None and my_part is not None
+                and averaged_mine is not None):
+            averaged_mine = tamper_part(epoch, my_part, averaged_mine,
+                                        prefix=prefix)
+
+    def start_serve(g_pool, g_codec_pool) -> None:
+        # averaged_mine is None only for a member that received no
+        # usable contributions (or a screen-withheld round): withhold
+        # the part — receivers fall back to local values
+        if my_part is None or averaged_mine is None:
+            return
+        slo, _shi = slices[my_part]
+        serve_chunks = _chunk_slices(averaged_mine.size, chunk_elems)
+        have_clients = any(not m.addr and m.weight > 0
+                           for m in group.members)
+        # weight-0 assistants never drain their gather tag (they skip
+        # collection) — pushing to them would pile full-size parts
+        # into their native recv queue every round, unbounded
+        push_to = [m for m in group.members
+                   if m.peer_id != me.peer_id and m.addr
+                   and m.weight > 0]
+
+        # device backend: the averaged part is quantized in one device
+        # call shared by its chunk producers, and the local apply reads
+        # the device dequantize of the same buffers
+        gather_enc_codec = _enc_codec_for(eff_gather)
+        gather_enc_get = (lazy_part_enc(averaged_mine, 0,
+                                        averaged_mine.size,
+                                        gather_enc_codec)
+                          if use_device
+                          and _part_aligned(gather_enc_codec)
+                          else None)
+
+        def produce_gather(ci: int, clo: int, chi: int) -> None:
+            # compress + local-apply + sign + encrypt on a codec
+            # worker; the sends fan out through the send pool, so the
+            # codec of chunk i+1 overlaps the wire of chunk i AND the
+            # collection (drain thread / receive loop) runs meanwhile
+            t_c0 = time.monotonic()
+            nelem = chi - clo
+            c = gather_part_codec(nelem)
+            # apply the same lossy wire bytes locally so all members
+            # end the round with byte-identical values for this part
+            # (chunks write disjoint slices of out: thread-safe)
+            if gather_enc_get is not None \
+                    and c == gather_enc_codec:
+                enc = gather_enc_get()
+                wire = device_codec.part_payload(enc, clo, chi)
+                out[slo + clo:slo + chi] = device_codec.part_decode(
+                    enc, clo, chi)
+            else:
+                piece = averaged_mine[clo:chi]
+                wire = codec_mod.compress(piece, c)
+                out[slo + clo:slo + chi] = codec_mod.decompress(
+                    wire, c, nelem)
+            body = _make_frame(dht.identity, gather_ctx,
+                               group.group_hash, group.my_index, 1.0,
+                               nelem, c, wire,
+                               chunk=ci, n_chunks=len(serve_chunks))
+            # the gather body is receiver-independent: encrypt ONCE
+            # per chunk, not once per recipient (the scatter path must
+            # stay per-receiver, its bodies differ)
+            wire_body = maybe_encrypt(gkey, body)
+            with send_lock:
+                for m in push_to:
+                    gtag = _tag(prefix, epoch, "gather", m.peer_id)
+                    g_sends.append((m.addr, gtag, wire_body))
+                    g_futures.append(g_pool.submit(
+                        send_raw, m.addr, gtag, wire_body))
+            if have_clients:
+                # client-mode members can't receive pushes: publish
+                # each chunk of the averaged part in this owner's
+                # mailbox for them to pull (per-chunk tags)
+                dht.post(_tag(prefix, epoch, f"mailbox{ci}",
+                              me.peer_id),
+                         wire_body,
+                         expiration_time=time.time()
+                         + 2 * allreduce_timeout)
+            if meter is not None:
+                meter.note("gather_serve", my_part, t_c0,
+                           time.monotonic() - t_c0, len(wire_body), ci)
+
+        for ci, (clo, chi) in enumerate(serve_chunks):
+            g_produce.append(
+                g_codec_pool.submit(produce_gather, ci, clo, chi))
 
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=_pool_workers(8)) as pool, \
@@ -555,6 +1149,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
         futures = []
         scatter_to = list(enumerate(owners)) if weight > 0 else []
         scatter_encs: Dict[int, object] = {}  # part -> lazy EncodedPart
+        part_tasks: List[Tuple[int, List[tuple]]] = []
         for k, owner in scatter_to:
             if k == my_part:
                 continue
@@ -566,10 +1161,24 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                        if use_device and _part_aligned(scatter_enc_codec)
                        else None)
             scatter_encs[k] = enc_get
-            for ci, (clo, chi) in enumerate(chunks):
-                futures.append(pool.submit(
-                    produce_scatter, owner.addr, tag, ctx,
-                    lo, clo, chi, ci, len(chunks), enc_get))
+            part_tasks.append((k, [
+                (owner.addr, tag, ctx, k, lo, clo, chi, ci, len(chunks),
+                 enc_get)
+                for ci, (clo, chi) in enumerate(chunks)]))
+        scatter_sched = None
+        if pipe is not None:
+            # bounded-depth per-part scheduling: encode(part i+1)
+            # overlaps send(part i), at most pipeline_depth parts in
+            # the encode/send window
+            scatter_sched = _scatter_pipeline(
+                pool, produce_scatter, part_tasks, pipeline_depth,
+                note_part)
+        else:
+            # sequential burst submit (the historical path): every
+            # chunk of every part queued up front, pool order decides
+            for _k, args_list in part_tasks:
+                for a in args_list:
+                    futures.append(pool.submit(produce_scatter, *a))
         t_built = time.monotonic()
         phases["scatter_build_s"] = round(t_built - t0, 3)
 
@@ -676,12 +1285,14 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # accelerator from this same pool — the drain structure
                 # is backend-independent). The decrypted signed frame
                 # rides along for the audit transcript's retention.
+                t_d = time.monotonic()
                 raw = maybe_decrypt(gkey, raw_enc)
                 if raw is None:
                     return None
                 return raw, _parse(raw, group, my_chunks, my_ctx,
                                    codec_mod, pinned=pin_scatter,
-                                   defer_codec=codec if fused else None)
+                                   defer_codec=codec if fused else None
+                                   ), t_d
 
             banned_reduce = 0  # corrupt-banned senders (no data applied)
 
@@ -689,7 +1300,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 nonlocal acc, total_w, banned_reduce
                 if item is None:
                     return False
-                raw, parsed = item
+                raw, parsed, t_d = item
                 if parsed is None:
                     return False
                 status, sender, w, ci, data = parsed
@@ -769,6 +1380,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     clo, chi = my_chunks[ci]
                     bufs[sender][clo:chi] = data
                 got[sender].add(ci)
+                if meter is not None:
+                    meter.note("reduce", my_part, t_d,
+                               time.monotonic() - t_d, len(raw), ci)
                 if ci == 0:
                     wts[sender] = w
                 if retain_mine:
@@ -1008,8 +1622,26 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 audit.note_self(dht.identity, my_ctx, group.group_hash,
                                 group.my_index, weight, mine, my_chunks)
             phases["reduce_s"] = round(time.monotonic() - t_built, 3)
+            note_part("reduce", my_part)
 
+        if pipe is not None:
+            # pipelined: serve this owner's averaged part NOW — the
+            # serve codec+sends overlap the scatter barrier, the send
+            # retry pass and the EF store below (the sequential path
+            # reaches the same two calls after them — same bytes,
+            # earlier wall-clock; the transcript post stays ahead of
+            # the part's first served chunk in BOTH modes)
+            pre_serve()
+            start_serve(serve_pool, serve_codec_pool)
         t_wait = time.monotonic()
+        if scatter_sched is not None:
+            # the bounded-depth scheduler may still be launching parts
+            # from chunk callbacks: wait for the last part's completion
+            # callback, then snapshot the full futures list for the
+            # barrier + retry pass below
+            scatter_sched[0].wait(timeout=max(
+                5.0, deadline - time.monotonic() + 10.0))
+            futures = scatter_sched[1]()
         concurrent.futures.wait(futures)
         # One application-layer retry for scatter sends that failed: the
         # wire layer never resends a mutating frame after a lost reply
@@ -1089,141 +1721,100 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 ef_scatter.store(flat, [decoded])
             phases["ef_scatter_s"] = round(time.monotonic() - t_ef, 3)
 
-    # serve the audit transcript BEFORE the part: any member that
-    # completes the gather can immediately fetch the honest record the
-    # owner signed (the post is mailbox-local, no wire round-trips)
-    if retain_mine and averaged_mine is not None:
-        t_post = time.monotonic()
-        try:
-            if not audit.post_transcript(dht):
-                # a False post (native mailbox rc != 0, chaos fault)
-                # is the same outcome as the raise below: members
-                # that gathered this part will strike audit-timeout —
-                # the owner deserves a local diagnostic either way
-                logger.warning(
-                    "allreduce: audit transcript post rejected by the "
-                    "mailbox — part %d's challenge will go unserved",
-                    my_part)
-        except Exception:  # noqa: BLE001 - an unserved transcript only
-            # costs THIS owner audit-timeout strikes; the round must
-            # not die for it
-            logger.warning("allreduce: audit transcript post failed",
-                           exc_info=True)
-        phases["audit_post_s"] = round(time.monotonic() - t_post, 3)
-    # EF second stage (DynamiQ): the owner carries its own residual into
-    # the gather re-quantize — SUSPENDED on audit-challenged parts, so
-    # the replay's codec round-trip of the replayed average stays
-    # bit-exact without any private residual entering a transcript (a
-    # buffer a hostile owner could fabricate to "explain" a wrong part;
-    # the deterministic challenge means owner and auditors agree on the
-    # suspension at round start). The fresh error is still stored below.
-    ef_gather_active = (ef_gather is not None and my_part is not None
-                        and averaged_mine is not None and weight > 0)
-    if ef_gather_active and my_part not in audited_parts:
-        glo, ghi = slices[my_part]
-        averaged_mine = ef_gather.compensate_slice(
-            averaged_mine, glo, ghi, flat.size)
-    # hostile-owner chaos seam (swarm/chaos.py wrong_gather_part): an
-    # active op rewrites the part THIS owner is about to serve — after
-    # the honest average and after the transcript, which is exactly
-    # the attack shape the replay audit convicts
-    tamper_part = getattr(dht, "tamper_gather_part", None)
-    if (tamper_part is not None and my_part is not None
-            and averaged_mine is not None):
-        averaged_mine = tamper_part(epoch, my_part, averaged_mine,
-                                    prefix=prefix)
-
-    # --- gather: averaged part i -> everyone; collect the rest ----------
-    # an assistant's return value is meaningless (it collects nothing and
-    # its caller discards it) — skip the full-size copy; gather-send's
-    # local writes land in ``flat``, which is already this call's own
-    # buffer (flatten_tensors concatenates into a fresh array)
-    out = flat.copy() if weight > 0 else flat
+    if pipe is None:
+        # sequential mode: the serve prep (transcript post -> EF gather
+        # compensate -> tamper seam) runs HERE, the historical protocol
+        # point — pipelined rounds already ran it inside the scatter
+        # block, right after the reduce finished
+        pre_serve()
+        # --- gather: averaged part i -> everyone; collect the rest ------
+        # an assistant's return value is meaningless (it collects nothing
+        # and its caller discards it) — skip the full-size copy; gather-
+        # send's local writes land in ``flat``, which is already this
+        # call's own buffer (flatten_tensors concatenates into a fresh
+        # array)
+        out = flat.copy() if weight > 0 else flat
 
     t_gather = time.monotonic()
-    send_lock = _threading.Lock()
+    if pipe is not None:
+        # --- pipelined gather tail ---------------------------------------
+        # the drain thread has been collecting other owners' parts since
+        # before the scatter — by now most frames have already decoded
+        # and applied. The serve (start_serve above) is racing on its own
+        # pools. All that remains: join the drain, merge its verdicts,
+        # and flush the serve.
+        leftover, drain_bans, progressed = pipe.finish()
+        for peer_id, reason in drain_bans:
+            # verdicts reached on the drain thread are applied HERE, on
+            # the caller thread — ban_peer mutates the ledger and the
+            # report, neither of which the drain touches directly
+            ban_peer(peer_id, reason)
+            if report is not None:
+                report["complete"] = False
+            logger.warning(
+                "allreduce: part owner %s served a corrupt/truncated "
+                "chunk — keeping local values for that part",
+                peer_id[:16])
+        # chunks never received keep this peer's local values (owner died
+        # mid-round): degraded but well-defined. Same strike attribution
+        # as the sequential sweep — owners silent with zero gather data
+        # point at the local node as much as at them.
+        blame_owners = progressed
+        for k in leftover:
+            ban_peer(owners[k].peer_id, "gather-timeout",
+                     strike=blame_owners)
+        if leftover and report is not None:
+            report["complete"] = False
+        concurrent.futures.wait(g_produce)
+        for f in g_produce:
+            f.result()  # surface codec bugs instead of dropping the part
+        if ef_gather_active:
+            # the served values are now fully applied locally in ``out``
+            # (the exact wire bytes' dequantize): record this round's
+            # gather quantization error against the compensated (or, on
+            # a challenged part, raw) average actually encoded
+            glo, ghi = slices[my_part]
+            ef_gather.store_slice(averaged_mine, out[glo:ghi],
+                                  glo, ghi, flat.size)
+        concurrent.futures.wait(g_futures)
+        # same application-layer retry as scatter: gather chunks are
+        # de-duplicated by (part, chunk_idx) at every receiver
+        retries = [s for f, s in zip(g_futures, g_sends)
+                   if not f.cancelled() and not f.result()]
+        if retries and time.monotonic() < deadline:
+            retry_futs = [serve_pool.submit(send_raw, *s)
+                          for s in retries]
+            concurrent.futures.wait(retry_futs)
+            still_failed = sum(1 for f in retry_futs
+                               if f.done() and not f.result())
+            if still_failed:
+                logger.warning(
+                    "allreduce: %d/%d gather chunk send(s) "
+                    "undeliverable after retry", still_failed,
+                    len(retry_futs))
+        serve_pool.shutdown(wait=False)
+        serve_codec_pool.shutdown(wait=False)
+        phases["gather_s"] = round(time.monotonic() - t_gather, 3)
+        if meter is not None:
+            hop_rows = meter.rows()
+            if hop_rows:
+                phases["hops"] = hop_rows
+        if weight == 0:
+            return [np.array(t, np.float32, copy=False) for t in tensors]
+        t_out = time.monotonic()
+        result = unflatten_tensors(out, tensors)
+        phases["unflatten_s"] = round(time.monotonic() - t_out, 3)
+        return result
+
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=_pool_workers(8)) as pool, \
             concurrent.futures.ThreadPoolExecutor(
                 max_workers=_pool_workers(4)) as codec_pool, \
             concurrent.futures.ThreadPoolExecutor(
                 max_workers=_pool_workers(4)) as dec_pool:
-        futures = []
-        sends = []
-        produce_futs = []
         # averaged_mine is None only for an assistant that received no
         # contributions: withhold the part (see the reduce phase)
-        if my_part is not None and averaged_mine is not None:
-            lo, hi = slices[my_part]
-            my_chunks = _chunk_slices(averaged_mine.size, chunk_elems)
-            have_clients = any(not m.addr and m.weight > 0
-                               for m in group.members)
-            # weight-0 assistants never drain their gather tag (they skip
-            # collection) — pushing to them would pile full-size parts
-            # into their native recv queue every round, unbounded
-            push_to = [m for m in group.members
-                       if m.peer_id != me.peer_id and m.addr
-                       and m.weight > 0]
-
-            # device backend: the averaged part is quantized in one
-            # device call shared by its chunk producers, and the local
-            # apply reads the device dequantize of the same buffers
-            gather_enc_codec = _enc_codec_for(eff_gather)
-            gather_enc_get = (lazy_part_enc(averaged_mine, 0,
-                                            averaged_mine.size,
-                                            gather_enc_codec)
-                              if use_device
-                              and _part_aligned(gather_enc_codec)
-                              else None)
-
-            def produce_gather(ci: int, clo: int, chi: int) -> None:
-                # compress + local-apply + sign + encrypt on a codec
-                # worker; the sends fan out through the send pool, so the
-                # codec of chunk i+1 overlaps the wire of chunk i AND the
-                # receive thread starts collecting other parts at once
-                nelem = chi - clo
-                c = gather_part_codec(nelem)
-                # apply the same lossy wire bytes locally so all members
-                # end the round with byte-identical values for this part
-                # (chunks write disjoint slices of out: thread-safe)
-                if gather_enc_get is not None \
-                        and c == gather_enc_codec:
-                    enc = gather_enc_get()
-                    wire = device_codec.part_payload(enc, clo, chi)
-                    out[lo + clo:lo + chi] = device_codec.part_decode(
-                        enc, clo, chi)
-                else:
-                    piece = averaged_mine[clo:chi]
-                    wire = codec_mod.compress(piece, c)
-                    out[lo + clo:lo + chi] = codec_mod.decompress(
-                        wire, c, nelem)
-                body = _make_frame(dht.identity, gather_ctx,
-                                   group.group_hash, group.my_index, 1.0,
-                                   nelem, c, wire,
-                                   chunk=ci, n_chunks=len(my_chunks))
-                # the gather body is receiver-independent: encrypt ONCE
-                # per chunk, not once per recipient (the scatter path must
-                # stay per-receiver, its bodies differ)
-                wire_body = maybe_encrypt(gkey, body)
-                with send_lock:
-                    for m in push_to:
-                        gtag = _tag(prefix, epoch, "gather", m.peer_id)
-                        sends.append((m.addr, gtag, wire_body))
-                        futures.append(pool.submit(send_raw, m.addr, gtag,
-                                                   wire_body))
-                if have_clients:
-                    # client-mode members can't receive pushes: publish
-                    # each chunk of the averaged part in this owner's
-                    # mailbox for them to pull (per-chunk tags)
-                    dht.post(_tag(prefix, epoch, f"mailbox{ci}",
-                                  me.peer_id),
-                             wire_body,
-                             expiration_time=time.time()
-                             + 2 * allreduce_timeout)
-
-            for ci, (clo, chi) in enumerate(my_chunks):
-                produce_futs.append(
-                    codec_pool.submit(produce_gather, ci, clo, chi))
+        start_serve(pool, codec_pool)
 
         # weight-0 assistants collect no result at all (nothing to apply
         # it to — and a routable assistant must NOT fall into the
@@ -1249,6 +1840,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             def decode_gather(raw_enc: bytes):
                 # decrypt+verify+decompress on a decode worker; the
                 # receive thread keeps draining the wire meanwhile
+                t_d = time.monotonic()
                 raw = maybe_decrypt(gkey, raw_enc)
                 if raw is None:
                     return None
@@ -1274,12 +1866,13 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 # The raw signed frame rides along for audited parts —
                 # it is the owner-signed half of a proof receipt and
                 # the served bytes the repair plane corrects.
-                return part, parsed, _HDR.unpack_from(raw)[6], raw
+                return part, parsed, _HDR.unpack_from(raw)[6], raw, t_d
 
             def apply_gather(res) -> bool:
                 if res is None:
                     return False
-                part, (status, sender, _w, ci, data), gcodec, raw = res
+                part, (status, sender, _w, ci, data), gcodec, raw, t_d \
+                    = res
                 if part not in pending:
                     return False  # completed part
                 if status == "bad":
@@ -1307,6 +1900,9 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 pclo, pchi = part_chunks[part][ci]
                 out[plo + pclo:plo + pchi] = data
                 pending[part].discard(ci)
+                if meter is not None:
+                    meter.note("gather", part, t_d,
+                               time.monotonic() - t_d, len(raw), ci)
                 if audit is not None and part in audited_parts:
                     audit.note_gather_codec(part, ci, gcodec)
                     audit.note_gather_frame(part, ci, raw)
@@ -1317,6 +1913,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         # with — the replay's comparison target
                         alo, ahi = slices[part]
                         audit.note_gathered(part, out[alo:ahi])
+                    note_part("gather", part)
                 return True
 
             decoding: List[concurrent.futures.Future] = []
@@ -1382,6 +1979,7 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 for k in list(pending):
                     owner = owners[k]
                     for ci in sorted(pending[k]):
+                        t_f0 = time.monotonic()
                         raw = fetch_chunk(
                             owner.addr,
                             _tag(prefix, epoch, f"mailbox{ci}",
@@ -1419,16 +2017,22 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         clo, chi = part_chunks[k][pci]
                         out[lo + clo:lo + chi] = data
                         pending[k].discard(pci)
+                        if meter is not None:
+                            meter.note("gather", k, t_f0,
+                                       time.monotonic() - t_f0,
+                                       len(raw), pci)
                         if audit is not None and k in audited_parts:
                             audit.note_gather_codec(
                                 k, pci, _HDR.unpack_from(raw)[6])
                             audit.note_gather_frame(k, pci, raw)
                         last_progress = time.monotonic()
                     if not pending.get(k):
-                        if (k in pending and audit is not None
-                                and k in audited_parts):
-                            alo, ahi = slices[k]
-                            audit.note_gathered(k, out[alo:ahi])
+                        if k in pending:
+                            if (audit is not None
+                                    and k in audited_parts):
+                                alo, ahi = slices[k]
+                                audit.note_gathered(k, out[alo:ahi])
+                            note_part("gather", k)
                         pending.pop(k, None)
                 if pending:
                     time.sleep(0.1)
@@ -1443,8 +2047,8 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             if pending and report is not None:
                 report["complete"] = False
 
-        concurrent.futures.wait(produce_futs)
-        for f in produce_futs:
+        concurrent.futures.wait(g_produce)
+        for f in g_produce:
             f.result()  # surface codec bugs instead of dropping the part
         if ef_gather_active:
             # the served values are now fully applied locally in ``out``
@@ -1454,10 +2058,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             glo, ghi = slices[my_part]
             ef_gather.store_slice(averaged_mine, out[glo:ghi],
                                   glo, ghi, flat.size)
-        concurrent.futures.wait(futures)
+        concurrent.futures.wait(g_futures)
         # same application-layer retry as scatter: gather chunks are
         # de-duplicated by (part, chunk_idx) at every receiver
-        retries = [s for f, s in zip(futures, sends)
+        retries = [s for f, s in zip(g_futures, g_sends)
                    if not f.cancelled() and not f.result()]
         if retries and time.monotonic() < deadline:
             retry_futs = [pool.submit(send_raw, *s) for s in retries]
@@ -1473,6 +2077,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                     "after retry", still_failed, len(retry_futs))
 
     phases["gather_s"] = round(time.monotonic() - t_gather, 3)
+    if meter is not None:
+        hop_rows = meter.rows()
+        if hop_rows:
+            phases["hops"] = hop_rows
     if weight == 0:
         # assistants discard the result: skip the unflatten copies
         return [np.array(t, np.float32, copy=False) for t in tensors]

@@ -33,6 +33,19 @@ once and then frames each CHUNK_ELEMS wire chunk by pure byte slicing
 part-level blocks ARE the chunk-level blocks), and :func:`part_decode`
 dequantizes the part's own lossy bytes on device for the gather phase's
 local apply. The host never touches a float of codec math.
+
+Chunk-order independence is what lets the r19 pipelined butterfly
+(``pipeline_hops``) reorder this work freely: a part is quantized in
+ONE device call whose result every chunk producer shares (the
+``lazy_part_enc`` memo in allreduce.py), ``part_payload`` /
+``part_decode`` are pure slices of that one encode, and
+:func:`fused_accumulate` folds each sender's chunks into the
+accumulator only once that sender's contribution is COMPLETE — so
+chunks arriving out of order across parts and legs can never change
+a byte of codec output, only when it is produced. (Accumulation
+ORDER across senders remains arrival-order, as before the pipeline —
+recorded per round by the r14 audit transcript and replayed in that
+recorded order.)
 """
 
 from __future__ import annotations

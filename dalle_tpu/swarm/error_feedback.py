@@ -41,6 +41,19 @@ the replayed average is then bit-exact, and no residual — which an
 owner could fabricate to "explain" a wrong part — ever appears in a
 transcript. The round's fresh quantization error is still stored, so
 an audited round costs one carry, not the whole feedback loop.
+
+Ordering under the r19 pipelined butterfly (``pipeline_hops``): chunks
+now encode, ship and decode out of order across parts, but every EF
+touchpoint stays pinned to a ROUND seam, not to wire arrival —
+``compensate`` runs once before the first scatter encode, ``store``
+after the scatter barrier (all owner decodes final), and the gather
+pair brackets the serve (``compensate_slice`` at pre-serve, BEFORE the
+first served chunk; ``store_slice`` after the drain hands the round
+thread its output). Whatever order parts complete in, the residual
+math sees the same values in the same order as the sequential
+protocol — which is what keeps pipelined rounds bit-exact
+(tests/test_pipeline.py) and the audit carry-over semantics above
+unchanged.
 """
 
 from __future__ import annotations

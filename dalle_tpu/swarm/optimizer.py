@@ -75,6 +75,23 @@ class _PendingRound:
         self.hidden_s = 0.0                   # round wall hidden from chip
         self.done = threading.Event()
         self.thread: Optional[threading.Thread] = None
+        # hop-granular progress (pipeline_hops): run_allreduce's
+        # progress hook bumps these from codec/drain threads while the
+        # training thread polls hop_progress() between grad steps —
+        # the in-flight round stops presenting as one opaque wall
+        self._hop_lock = threading.Lock()
+        self.hops = {"scatter": 0, "reduce": 0, "gather": 0}
+
+    def note_hop(self, leg: str, part: int) -> None:
+        """run_allreduce ``progress`` sink — called from pool/drain
+        threads on part-granular completion events; thread-safe."""
+        with self._hop_lock:
+            if leg in self.hops:
+                self.hops[leg] += 1
+
+    def hop_progress(self) -> dict:
+        with self._hop_lock:
+            return dict(self.hops)
 
 
 class _FollowerEMA:
@@ -301,6 +318,13 @@ class CollaborativeOptimizer:
         # a wire_bits run is a PINNED run: receivers reject codec
         # flapping (run_allreduce pin_codec)
         self._pin_codec = wb_r is not None or wb_g is not None
+        # Per-part pipelined butterfly (r19): OFF keeps every wire round
+        # byte-identical; ON moves wall-clock only (allreduce.py's
+        # pipeline_hops contract). Grad rounds only — PowerSGD factor
+        # rounds and state averaging keep the sequential protocol (they
+        # are latency-insensitive and run rarely).
+        self._pipeline_hops = bool(getattr(cfg, "pipeline_hops", False))
+        self._pipeline_depth = int(getattr(cfg, "pipeline_depth", 2))
         if ef_on:
             from dalle_tpu.swarm.error_feedback import ErrorFeedback
             self._ef_scatter = ErrorFeedback()
@@ -431,8 +455,24 @@ class CollaborativeOptimizer:
         if self._grad_acc is None:
             self._grad_acc = jax.tree.map(
                 lambda g: jnp.zeros(g.shape, jnp.float32), grads)
-        self._grad_acc = self._accumulate(
-            self._grad_acc, grads, float(batch_size))
+        if self.tracer is not None and self._pending is not None:
+            # overlap proof (r19): while a round is in flight, the
+            # accumulate becomes a span on the ROUND's trace id, so the
+            # merged cross-peer timeline shows compute strictly
+            # concurrent with in-round hop spans. The block_until_ready
+            # pins the span's wall to the device work — values are
+            # untouched, and recorder-off rounds skip all of it.
+            t_acc = time.monotonic()
+            self._grad_acc = self._accumulate(
+                self._grad_acc, grads, float(batch_size))
+            jax.block_until_ready(self._grad_acc)
+            self.tracer.add(
+                "swarm", "accumulate",
+                self._round_trace(self._pending.epoch), t_acc,
+                time.monotonic() - t_acc, samples=int(batch_size))
+        else:
+            self._grad_acc = self._accumulate(
+                self._grad_acc, grads, float(batch_size))
         self.local_samples += int(batch_size)
         if self._pending is not None:
             # round in flight: report the FROZEN pre-round progress (pure
@@ -534,6 +574,10 @@ class CollaborativeOptimizer:
                **attrs)
         t = t_start
         for name, dur in ((rep or {}).get("phases") or {}).items():
+            if not isinstance(dur, (int, float)):
+                # the per-hop rows ride the same dict under "hops";
+                # their live spans were already emitted in-round
+                continue
             phase = "ar_" + (name[:-2] if name.endswith("_s") else name)
             tr.add("swarm", phase, trace, t, dur)
             t += dur
@@ -621,7 +665,12 @@ class CollaborativeOptimizer:
                         audit=ra, gather_codec=self._gather_codec,
                         ef_scatter=self._ef_scatter,
                         ef_gather=self._ef_gather,
-                        pin_codec=self._pin_codec, report=rep)
+                        pin_codec=self._pin_codec, report=rep,
+                        pipeline_hops=self._pipeline_hops,
+                        pipeline_depth=self._pipeline_depth,
+                        tracer=self.tracer,
+                        trace=self._round_trace(pending.epoch),
+                        progress=pending.note_hop)
                     if ra is not None:
                         self._auditor.submit(ra)
                     self._trace_allreduce(
@@ -681,6 +730,7 @@ class CollaborativeOptimizer:
             **pending.timings, **self._apply_timings,
             "overlapped_steps": pending.overlapped_steps,
             "hidden_s": round(pending.hidden_s, 4),
+            "round_hops": pending.hop_progress(),
             "robust": self.robustness_snapshot(),
         }
         logger.info(
@@ -688,6 +738,23 @@ class CollaborativeOptimizer:
             "ran during the %.2fs round, %s)", self.local_epoch,
             pending.group_size, pending.overlapped_steps, pending.hidden_s,
             self.last_timings)
+
+    def round_progress(self) -> Optional[dict]:
+        """Hop-granular progress of the in-flight overlapped round, or
+        None when no round is pending: part-completion counts per leg
+        ({"scatter", "reduce", "gather"}) plus the epoch and the grad
+        steps overlapped so far — the training loop's window into a
+        round that no longer presents as one opaque wall. Counts only
+        advance on pipelined rounds' scatter leg (the sequential burst
+        submit has no per-part completion), but reduce/gather tick in
+        both modes."""
+        p = self._pending
+        if p is None:
+            return None
+        prog = p.hop_progress()
+        prog["epoch"] = p.epoch
+        prog["overlapped_steps"] = p.overlapped_steps
+        return prog
 
     def finalize(self) -> bool:
         """Block until an in-flight overlapped round (if any) is applied.
@@ -809,7 +876,11 @@ class CollaborativeOptimizer:
                     audit=ra, gather_codec=self._gather_codec,
                     ef_scatter=self._ef_scatter,
                     ef_gather=self._ef_gather,
-                    pin_codec=self._pin_codec, report=rep)
+                    pin_codec=self._pin_codec, report=rep,
+                    pipeline_hops=self._pipeline_hops,
+                    pipeline_depth=self._pipeline_depth,
+                    tracer=self.tracer,
+                    trace=self._round_trace(self.local_epoch))
                 if ra is not None:
                     self._auditor.submit(ra)
                 self._trace_allreduce(

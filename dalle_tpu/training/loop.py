@@ -143,6 +143,19 @@ def train_loop(task: TrainingTask,
             epoch_before = collab.local_epoch
             did_global = collab.step(grads,
                                      batch_size=task.local_batch_size)
+            # hop-granular round visibility (r19): while an overlapped
+            # round is in flight the loop keeps accumulating — surface
+            # which parts have already landed instead of one opaque
+            # "round pending" wall (debug level: this fires every step)
+            if logger.isEnabledFor(logging.DEBUG):
+                prog = collab.round_progress()
+                if prog is not None:
+                    logger.debug(
+                        "round in flight (epoch %d): scatter=%d "
+                        "reduce=%d gather=%d parts done, %d grad steps "
+                        "overlapped", prog["epoch"], prog["scatter"],
+                        prog["reduce"], prog["gather"],
+                        prog["overlapped_steps"])
             rolled_back = False
             if did_global and ckpt is not None:
                 epoch = collab.local_epoch
@@ -228,9 +241,11 @@ def train_loop(task: TrainingTask,
                             proofs_rejected=robust["proofs_rejected"]),
                         expiration=task.collab_cfg.metrics_expiration)
                 logger.info(
-                    "epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f",
+                    "epoch %d: mean_loss=%.4f mini_steps=%d sps=%.1f%s",
                     report.epoch, report.loss, report.mini_steps,
-                    report.samples_per_second)
+                    report.samples_per_second,
+                    (" hops=%s" % (collab.last_timings["round_hops"],)
+                     if "round_hops" in collab.last_timings else ""))
                 if on_epoch is not None:
                     on_epoch(report)
                 loss_sum, mini_steps = 0.0, 0

@@ -246,7 +246,8 @@ class SoakPeer:
                  ef: bool = False,
                  repair: bool = False,
                  aux_rounds: Optional[List[str]] = None,
-                 inject_fault: bool = False):
+                 inject_fault: bool = False,
+                 pipeline: bool = False):
         self.name = name
         self.node = node
         # flight recorder (dalle_tpu/obs): every peer records its round
@@ -270,6 +271,9 @@ class SoakPeer:
         # convergence oracle bit-exact through real quantization.
         self.wire_codec = wire_codec
         self.full_scale = _FULL_SCALE.get(wire_codec)
+        # r19 pipelined butterfly on the GRAD rounds (aux rounds keep
+        # the sequential protocol, mirroring the optimizer's gating)
+        self.pipeline = pipeline
         if ef:
             from dalle_tpu.swarm.error_feedback import ErrorFeedback
             self.ef_scatter = ErrorFeedback()
@@ -403,7 +407,9 @@ class SoakPeer:
                                 audit=ra, ef_scatter=self.ef_scatter,
                                 ef_gather=self.ef_gather,
                                 pin_codec=self.wire_codec
-                                != compression.NONE)
+                                != compression.NONE,
+                                pipeline_hops=self.pipeline,
+                                tracer=self.tracer, trace=trace)
                         averaged = out[0]
                 except Exception as e:  # noqa: BLE001 - degraded epoch
                     # a failed round is an ALONE-equivalent epoch (the
@@ -644,7 +650,7 @@ def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
                   deadline: float, mt: float, at: float,
                   violations: List[str],
                   wire_codec: int = compression.NONE,
-                  ef: bool = False) -> None:
+                  ef: bool = False, pipeline: bool = False) -> None:
     boot = None
     with peers_lock:
         for p in peers:
@@ -675,7 +681,7 @@ def _spawn_joiner(peers: List[SoakPeer], peers_lock: threading.Lock,
                     target_epochs=target_epochs, deadline=deadline,
                     matchmaking_time=mt, allreduce_timeout=at,
                     state=arrays[0].astype(np.float32), epoch=epoch,
-                    wire_codec=wire_codec, ef=ef)
+                    wire_codec=wire_codec, ef=ef, pipeline=pipeline)
     with peers_lock:
         peers.append(peer)
     peer.start()
@@ -715,6 +721,7 @@ def run_soak(args) -> dict:
                               matchmaking_time=args.matchmaking_time,
                               allreduce_timeout=args.allreduce_timeout,
                               wire_codec=wire_codec, ef=args.ef,
+                              pipeline=args.pipeline,
                               inject_fault=(i == 0 and getattr(
                                   args, "inject_oracle_failure",
                                   False))))
@@ -737,7 +744,7 @@ def run_soak(args) -> dict:
                 args=(peers, peers_lock, f"joiner{n_joined}", prefix,
                       args.epochs, deadline, args.matchmaking_time,
                       args.allreduce_timeout, violations, wire_codec,
-                      args.ef),
+                      args.ef, args.pipeline),
                 daemon=True, name=f"soak-join{n_joined}")
             jt.start()
             join_threads.append(jt)
@@ -802,7 +809,8 @@ def run_soak(args) -> dict:
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
                        "deadline": args.deadline,
-                       "wire_bits": args.wire_bits, "ef": args.ef},
+                       "wire_bits": args.wire_bits, "ef": args.ef,
+                       "pipeline": args.pipeline},
             "schedule": schedule, "elapsed_s": elapsed,
             "artifacts": {"trace": trace_path, "flight": flight_path},
             "peers": results, "violations": violations,
@@ -851,7 +859,8 @@ def _byzantine_pass(args, schedule: dict, attacks_on: bool,
                  allreduce_timeout=args.allreduce_timeout,
                  screen=GradientScreen(ScreenPolicy()),
                  max_peer_weight=100.0, gossip=True,
-                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef)
+                 wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef,
+                 pipeline=args.pipeline)
         for i, node in enumerate(nodes)]
     for p in peers:
         p.start()
@@ -944,7 +953,8 @@ def run_byzantine(args) -> dict:
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
                        "deadline": args.deadline,
-                       "wire_bits": args.wire_bits, "ef": args.ef},
+                       "wire_bits": args.wire_bits, "ef": args.ef,
+                       "pipeline": args.pipeline},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "artifacts": {"flight": flight_path},
@@ -1040,6 +1050,7 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
                  max_peer_weight=100.0, gossip=True,
                  audit_policy=policy,
                  wire_codec=_WIRE_CODECS[args.wire_bits], ef=args.ef,
+                 pipeline=args.pipeline,
                  repair=repair_on and audits_on,
                  aux_rounds=aux_by_peer.get(i))
         for i, node in enumerate(nodes)]
@@ -1302,7 +1313,8 @@ def run_hostile(args) -> dict:
                        "matchmaking_time": args.matchmaking_time,
                        "allreduce_timeout": args.allreduce_timeout,
                        "deadline": args.deadline,
-                       "wire_bits": args.wire_bits, "ef": args.ef},
+                       "wire_bits": args.wire_bits, "ef": args.ef,
+                       "pipeline": args.pipeline},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "artifacts": {"flight": flight_path},
@@ -1354,6 +1366,14 @@ def main(argv=None) -> int:
                              "legs (default ON — the r15 gates run "
                              "with EF armed; requires --wire-bits 8/4)")
     parser.add_argument("--no-ef", dest="ef", action="store_false")
+    parser.add_argument("--pipeline", dest="pipeline",
+                        action="store_true", default=False,
+                        help="run grad rounds on the r19 pipelined "
+                             "butterfly (pipeline_hops) — screening, "
+                             "audit replay and repair must stay green "
+                             "under out-of-order part completion")
+    parser.add_argument("--no-pipeline", dest="pipeline",
+                        action="store_false")
     parser.add_argument("--inject-oracle-failure", action="store_true",
                         help="TESTING the failure-dump path: peer0 "
                              "corrupts its final apply so the "

@@ -229,6 +229,66 @@ MUTATIONS: List[Mutation] = [
             "tear mid-scan — the detector must flag the loop's "
             "unlocked writes once a second role reads the mirror",
     ),
+    Mutation(
+        name="allreduce-inflight-table-lock-dropped",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/swarm/allreduce.py",
+        anchor="        done_part = False\n"
+               "        with self._cv:\n"
+               "            pend_set = self._parts.get(part)\n"
+               "            if pend_set is None or ci not in pend_set:\n"
+               "                return False  # duplicate chunk or "
+               "completed part\n"
+               "            pend_set.discard(ci)\n"
+               "            self._progressed = True\n"
+               "            if not pend_set:\n"
+               "                self._parts.pop(part, None)\n"
+               "                done_part = True\n"
+               "                self._cv.notify_all()",
+        replacement="        done_part = False\n"
+                    "        pend_set = self._parts.get(part)\n"
+                    "        if pend_set is None or ci not in pend_set:\n"
+                    "            return False  # duplicate chunk or "
+                    "completed part\n"
+                    "        pend_set.discard(ci)\n"
+                    "        self._progressed = True\n"
+                    "        if not pend_set:\n"
+                    "            self._parts.pop(part, None)\n"
+                    "            done_part = True",
+        why="the r19 pipelined gather's per-part in-flight table: the "
+            "drain thread completes chunks and pops finished parts "
+            "while the round thread snapshots the leftovers in "
+            "finish() under the same _cv — dropping the drain-side "
+            "lock races the pop against the snapshot (a part could be "
+            "both 'gathered' and 'timed out' in the same round)",
+    ),
+    Mutation(
+        name="allreduce-completion-flag-bare-read",
+        rule="lock-inconsistent-access",
+        path="dalle_tpu/swarm/allreduce.py",
+        anchor="        with self._cv:\n"
+               "            while not (self._complete or self._dead):\n"
+               "                self._cv.wait(timeout=0.5)\n"
+               "            leftover = {k: set(v) for k, v in "
+               "self._parts.items()}\n"
+               "            bans = list(self._bans)\n"
+               "            progressed = self._progressed\n"
+               "        self._thread.join()",
+        replacement="        while not (self._complete or self._dead):\n"
+                    "            time.sleep(0.05)\n"
+                    "        with self._cv:\n"
+                    "            leftover = {k: set(v) for k, v in "
+                    "self._parts.items()}\n"
+                    "            bans = list(self._bans)\n"
+                    "            progressed = self._progressed\n"
+                    "        self._thread.join()",
+        why="turning finish()'s condition-variable wait into a bare "
+            "busy-spin reads the drain's completion flags with no lock "
+            "while every write to them happens under _cv — the lockset "
+            "intersection across accesses comes up empty (and the read "
+            "is BEFORE the join, so the post-join exemption must not "
+            "swallow it)",
+    ),
 ]
 
 

@@ -635,6 +635,26 @@ class TestChurnSoak:
                if p["survivor"]]
         assert len(set(fps)) == 1 and len(fps) >= 3  # 2 survivors + joiner
 
+    def test_fast_soak_pipelined(self, tmp_path):
+        """Tier-1 churn soak on the r19 PIPELINED wire (pipeline_hops):
+        the same liveness + convergence oracles must stay green when
+        parts complete out of order — kills and a join included."""
+        from scripts.churn_soak import main
+        out = tmp_path / "CHURN_SOAK.json"
+        rc = main(["--peers", "3", "--epochs", "4", "--joins", "1",
+                   "--kills", "1", "--seed", "9",
+                   "--matchmaking-time", "1.2", "--allreduce-timeout", "5",
+                   "--deadline", "120", "--pipeline", "--out", str(out)])
+        assert rc == 0, f"pipelined churn soak violation (see {out})"
+        import json
+        report = json.loads(out.read_text())
+        assert report["pass"] is True
+        assert report["violations"] == []
+        assert report["params"]["pipeline"] is True
+        fps = [p["fingerprint"] for p in report["peers"]
+               if p["survivor"]]
+        assert len(set(fps)) == 1 and len(fps) >= 3
+
     @pytest.mark.slow
     def test_full_soak(self, tmp_path):
         """The full-size soak (>=5 peers, kills + join + partition) —

@@ -1,0 +1,355 @@
+"""r19 pipelined butterfly: hide the collective behind compute.
+
+Covers the per-part pipeline added in r19 (ISSUE 19):
+
+- the bounded-depth scatter scheduler (``_scatter_pipeline``) as a unit:
+  depth=1 fully serializes parts, completion launches the next part, and
+  the done-event/snapshot contract holds;
+- transparency: ``pipeline_hops=False`` rounds stay byte-identical to
+  rounds that never pass the knob (the r18 wire);
+- bit-exactness: pipelined honest rounds on the pinned u4 wire with
+  error feedback produce byte-identical averages to sequential rounds,
+  and leave byte-identical EF residuals;
+- the r14 audit replays a PIPELINED round clean at ``frac=1.0`` — the
+  out-of-order fused accumulation must replay in recorded order;
+- observability: ``report["phases"]["hops"]`` rows and live
+  ``ar_hop_*`` tracer spans appear in BOTH modes (satellite of r19);
+- the optimizer's hop-progress plumbing (``_PendingRound.note_hop`` /
+  ``round_progress``).
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_tpu.config import CollabConfig
+from dalle_tpu.obs.trace import Tracer
+from dalle_tpu.swarm import DHT, Identity, compression
+from dalle_tpu.swarm.allreduce import (_scatter_pipeline, flatten_tensors,
+                                       run_allreduce)
+from dalle_tpu.swarm.audit import AuditPolicy, RoundAudit, audit_round
+from dalle_tpu.swarm.error_feedback import make_pair
+from dalle_tpu.swarm.health import PeerHealthLedger
+from dalle_tpu.swarm.identity import Ed25519PrivateKey
+from dalle_tpu.swarm.matchmaking import make_group
+
+U4 = compression.UNIFORM4BIT
+U8 = compression.UNIFORM8BIT
+
+
+def _det_swarm(n, base=171):
+    nodes = []
+    for i in range(n):
+        peers = [nodes[0].visible_address] if nodes else []
+        ident = Identity(Ed25519PrivateKey.from_private_bytes(
+            bytes([base + i]) * 32))
+        nodes.append(DHT(initial_peers=peers, identity=ident,
+                         rpc_timeout=2.0))
+    return nodes
+
+
+def _run_threads(fns, timeout=60):
+    results = [None] * len(fns)
+    errors = []
+
+    def wrap(i, fn):
+        try:
+            results[i] = fn()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _round(nodes, prefix, epoch, tensors, *, pipelined, efs=None,
+           codec=U4, gather_codec=None, ras=None, ledgers=None,
+           tracers=None, chunk_elems=4096, explicit_off=True):
+    """One full-group round; returns (results, reports). With
+    ``explicit_off=False`` and ``pipelined=False`` the knob is omitted
+    entirely (the pre-r19 call shape) for the transparency check."""
+    n = len(nodes)
+    reports = [dict() for _ in range(n)]
+
+    def peer(i):
+        g = make_group(nodes[i], prefix, epoch=epoch, weight=1.0,
+                       matchmaking_time=2.0, min_group_size=n)
+        assert g is not None and g.size == n
+        kw = {}
+        if pipelined or explicit_off:
+            kw["pipeline_hops"] = pipelined
+        if efs is not None:
+            kw.update(ef_scatter=efs[i][0], ef_gather=efs[i][1])
+        if ras is not None:
+            kw["audit"] = ras[i]
+        if ledgers is not None:
+            kw["ledger"] = ledgers[i]
+        if tracers is not None:
+            kw.update(tracer=tracers[i], trace=f"{prefix}:grads:{epoch}")
+        return run_allreduce(
+            nodes[i], g, prefix, epoch, tensors[i], weight=1.0,
+            allreduce_timeout=10.0, sender_timeout=2.0, codec=codec,
+            gather_codec=gather_codec, pin_codec=True,
+            chunk_elems=chunk_elems, report=reports[i], **kw)
+
+    results = _run_threads([lambda i=i: peer(i) for i in range(n)])
+    return results, reports
+
+
+def _tensors(n, size=9000, seed=11):
+    rng = np.random.RandomState(seed)
+    return [[(rng.randn(size) * (1 + i)).astype(np.float32)]
+            for i in range(n)]
+
+
+# -- the bounded-depth scatter scheduler, as a unit ------------------------
+
+class TestScatterScheduler:
+    def test_empty_tasks_complete_immediately(self):
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            done, snap = _scatter_pipeline(pool, lambda: None, [], 2, None)
+            assert done.is_set() and snap() == []
+
+    def test_depth_one_serializes_parts(self):
+        """depth=1: every chunk of part k completes before any chunk of
+        part k+1 STARTS — part-completion is what launches the next."""
+        events, lock = [], threading.Lock()
+
+        def produce(part, chunk):
+            with lock:
+                events.append((part, chunk))
+            time.sleep(0.002)
+
+        tasks = [(k, [(k, c) for c in range(3)]) for k in range(4)]
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            done, snap = _scatter_pipeline(pool, produce, tasks, 1, None)
+            assert done.wait(timeout=10)
+        futures = snap()
+        assert len(futures) == 12 and all(f.done() for f in futures)
+        starts = [events.index((k, c)) for k in range(4) for c in range(3)]
+        for k in range(3):
+            last_of_k = max(starts[k * 3:(k + 1) * 3])
+            first_of_next = min(starts[(k + 1) * 3:(k + 2) * 3])
+            assert last_of_k < first_of_next, events
+
+    def test_depth_bounds_inflight_parts(self):
+        """With depth=2 and a wide pool, chunks of at most 2 distinct
+        parts ever run concurrently: the scheduler admits at most
+        ``depth`` incomplete parts and a new one launches only when a
+        prior part's last chunk completes."""
+        lock = threading.Lock()
+        running, max_seen = {}, [0]
+
+        def produce(part, _chunk):
+            with lock:
+                running[part] = running.get(part, 0) + 1
+                live = sum(1 for c in running.values() if c > 0)
+                max_seen[0] = max(max_seen[0], live)
+            time.sleep(0.005)
+            with lock:
+                running[part] -= 1
+
+        tasks = [(k, [(k, c) for c in range(2)]) for k in range(5)]
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            done, _snap = _scatter_pipeline(pool, produce, tasks, 2, None)
+            assert done.wait(timeout=10)
+        assert max_seen[0] <= 2, max_seen[0]
+
+    def test_on_part_fires_once_per_part(self):
+        calls, lock = [], threading.Lock()
+
+        def on_part(leg, part):
+            with lock:
+                calls.append((leg, part))
+
+        tasks = [(k, [(k, c) for c in range(2)]) for k in range(3)]
+        with concurrent.futures.ThreadPoolExecutor(3) as pool:
+            done, _ = _scatter_pipeline(
+                pool, lambda *_a: time.sleep(0.001), tasks, 2, on_part)
+            assert done.wait(timeout=10)
+        assert sorted(calls) == [("scatter", 0), ("scatter", 1),
+                                 ("scatter", 2)]
+
+
+# -- transparency + bit-exactness ------------------------------------------
+
+class TestPipelinedRound:
+    def test_off_is_byte_identical_to_pre_change_call(self):
+        """pipeline_hops=False must be indistinguishable from never
+        passing the knob: same bytes out of the same inputs."""
+        nodes = _det_swarm(2, base=141)
+        try:
+            tensors = _tensors(2, size=5000, seed=3)
+            res_a, _ = _round(nodes, "off-a", 0, tensors,
+                              pipelined=False, explicit_off=False)
+            res_b, _ = _round(nodes, "off-b", 1, tensors,
+                              pipelined=False, explicit_off=True)
+            for a, b in zip(res_a, res_b):
+                assert flatten_tensors(a).tobytes() == \
+                    flatten_tensors(b).tobytes()
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_pipelined_bit_exact_u4_ef(self):
+        """Pipelined honest rounds on the pinned u4 wire with error
+        feedback: byte-identical averages AND byte-identical EF
+        residuals vs the sequential protocol (fresh EF per mode, same
+        gradients — only the scheduling differs).
+
+        The gradients are integers in [-7, 7] with every u4 block's
+        max forced to 7 (scale exactly 1.0, dequantize exact), so the
+        fused accumulation is order-INDEPENDENT: the comparison
+        isolates the pipeline's arithmetic from the pre-existing
+        arrival-order f32 nondeterminism that both modes share (and
+        that the r14 audit covers by replaying in recorded order)."""
+        nodes = _det_swarm(3, base=151)
+        try:
+            rng = np.random.RandomState(7)
+            tensors = []
+            for i in range(3):
+                g = rng.randint(-7, 8, size=9000).astype(np.float32)
+                g[::128] = 7.0  # every 1024-block hits max|x| == 7
+                tensors.append([g])
+            efs_seq = [make_pair() for _ in range(3)]
+            efs_pip = [make_pair() for _ in range(3)]
+            res_s, reps_s = _round(nodes, "bx", 0, tensors,
+                                   pipelined=False, efs=efs_seq,
+                                   gather_codec=U4)
+            res_p, reps_p = _round(nodes, "bx", 1, tensors,
+                                   pipelined=True, efs=efs_pip,
+                                   gather_codec=U4)
+            assert all(r["complete"] for r in reps_s + reps_p)
+            flats = [flatten_tensors(r) for r in res_s + res_p]
+            for f in flats[1:]:
+                assert flats[0].tobytes() == f.tobytes()
+            # identical residuals: the pipeline reordered WORK, not math
+            for (ss, sg), (ps, pg) in zip(efs_seq, efs_pip):
+                for seq_ef, pip_ef in ((ss, ps), (sg, pg)):
+                    rs, rp = (seq_ef.residual_host(),
+                              pip_ef.residual_host())
+                    if rs is None:
+                        assert rp is None
+                    else:
+                        assert rs.tobytes() == rp.tobytes()
+            # the feedback loop is LIVE on the gather leg: averages are
+            # thirds, so re-quantizing them has genuinely nonzero error
+            # (the scatter leg is exact by construction here)
+            assert any(ga.residual_host() is not None
+                       and np.abs(ga.residual_host()).max() > 0
+                       for _sc, ga in efs_pip)
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_pipelined_round_replays_clean_under_full_audit(self):
+        """frac=1.0 audit over a PIPELINED u8/u4+EF round: out-of-order
+        part completion must still post transcripts before first serve
+        and replay bit-exactly — zero strikes for honest owners."""
+        nodes = _det_swarm(3, base=161)
+        efs = [make_pair() for _ in range(3)]
+        policy = AuditPolicy(frac=1.0, fetch_timeout=2.0)
+        try:
+            for epoch in (0, 1):  # live residuals by the second round
+                tensors = _tensors(3, size=6000, seed=20 + epoch)
+                ras = [RoundAudit("pa", epoch, policy) for _ in range(3)]
+                ledgers = [PeerHealthLedger() for _ in range(3)]
+                res, reps = _round(nodes, "pa", epoch, tensors,
+                                   pipelined=True, efs=efs, codec=U8,
+                                   gather_codec=U4, ras=ras,
+                                   ledgers=ledgers)
+                assert all(r["complete"] for r in reps)
+                for i in range(3):
+                    rep = audit_round(nodes[i], ras[i], ledgers[i])
+                    assert rep["audited"], (epoch, i, rep)
+                    assert not rep["failed"] and not rep["unserved"] \
+                        and not rep["omitted"], (epoch, i, rep)
+                    assert ledgers[i].snapshot() == {}
+                flats = [flatten_tensors(r) for r in res]
+                for f in flats[1:]:
+                    assert flats[0].tobytes() == f.tobytes()
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+
+# -- observability: hop rows + spans ---------------------------------------
+
+class TestHopObservability:
+    def test_hop_rows_and_spans_both_modes(self):
+        nodes = _det_swarm(3, base=181)
+        try:
+            tensors = _tensors(3, size=9000, seed=5)
+            for epoch, pipelined in ((0, False), (1, True)):
+                tracers = [Tracer(peer=f"p{i}") for i in range(3)]
+                _res, reps = _round(nodes, "obs", epoch, tensors,
+                                    pipelined=pipelined, tracers=tracers)
+                for i, rep in enumerate(reps):
+                    hops = rep["phases"].get("hops")
+                    assert hops, (pipelined, i, rep["phases"])
+                    for row in hops:
+                        assert {"part", "leg", "wall_s", "bytes",
+                                "chunks"} <= set(row)
+                        assert row["wall_s"] >= 0 and row["chunks"] >= 1
+                    legs = {r["leg"] for r in hops}
+                    assert {"scatter", "reduce"} <= legs, (pipelined,
+                                                           legs)
+                    assert legs & {"gather", "gather_serve"}, legs
+                    phases = {row["phase"] for row in tracers[i].dump()}
+                    assert any(p.startswith("ar_hop_") for p in phases), \
+                        (pipelined, phases)
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+
+# -- optimizer plumbing ----------------------------------------------------
+
+class TestProgressPlumbing:
+    def test_config_defaults_off(self):
+        cfg = CollabConfig()
+        assert cfg.pipeline_hops is False
+        assert cfg.pipeline_depth == 2
+
+    def test_pending_round_hop_counters(self):
+        from dalle_tpu.swarm.optimizer import _PendingRound
+        p = _PendingRound(0, None, [], 1.0, 1)
+        assert p.hop_progress() == {"scatter": 0, "reduce": 0,
+                                    "gather": 0}
+        p.note_hop("scatter", 0)
+        p.note_hop("scatter", 1)
+        p.note_hop("gather", 2)
+        p.note_hop("bogus-leg", 0)  # unknown legs are dropped, not kept
+        prog = p.hop_progress()
+        assert prog == {"scatter": 2, "reduce": 0, "gather": 1}
+        prog["scatter"] = 99  # a copy, not the live dict
+        assert p.hop_progress()["scatter"] == 2
+
+    def test_round_progress_none_without_pending(self):
+        import dataclasses
+
+        from dalle_tpu.swarm.optimizer import CollaborativeOptimizer
+
+        class _S:
+            params = {"w": np.zeros(4, np.float32)}
+            opt_state = ()
+
+        class _Role:
+            swarm_enabled = False
+
+        cfg = dataclasses.replace(CollabConfig(), pipeline_hops=True)
+        opt = CollaborativeOptimizer(None, cfg, _S(), lambda s, g: s,
+                                     serve_state=False, role=_Role())
+        assert opt._pipeline_hops is True
+        assert opt._pipeline_depth == 2
+        assert opt.round_progress() is None
