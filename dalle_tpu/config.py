@@ -435,10 +435,38 @@ class CollabConfig:
     # ({run}_state) ride the same butterfly and, with this on, the
     # same challenge/transcript/replay machinery (each phase under its
     # own prefix). Convictions there strike + gossip proof-carrying
-    # receipts; repair stays scoped to the gradient rounds (factor/
-    # state corrections live in spaces the gradient plane cannot
-    # absorb — CHAOS.md "Round repair").
+    # receipts.
     audit_aux_phases: bool = True
+    # r20 aux-phase REPAIR: a replayed-bytes-mismatch conviction in a
+    # PowerSGD factor round or in state averaging queues its
+    # honest - served correction into the factor buffers / the
+    # averaged-state application (same pre-step-exact /
+    # bounded-staleness split as gradient repair, each phase drained
+    # at its own application site). Requires repair_convicted and
+    # audit_aux_phases; False keeps factor/state convictions
+    # detection + proof, byte-identical to r19.
+    repair_aux_phases: bool = True
+    # r20 evidence by reference (swarm/audit.EvidencePlane): evidence
+    # bundles too large to embed inline in a proof receipt
+    # (PROOF_MAX_BYTES) are parked chunked in the issuer's mailbox and
+    # the receipt carries a sha256 digest + mailbox descriptor;
+    # verifiers fetch under the hard byte/time budgets below
+    # (hash-check before any sized allocation), replay, and re-serve
+    # verified bundles for failover. Off: over-budget convictions
+    # degrade to the capped r13 accusation exactly as in r19.
+    proof_by_reference: bool = True
+    # hard per-bundle byte budget a verifier will fetch (an oversize
+    # descriptor claim is rejected before any allocation or I/O); the
+    # flagship 502 MB part's bundle (~2x part bytes: transcript
+    # frames + gather frames) sizes the default
+    proof_fetch_max_bytes: int = 2 << 30
+    # hard wall-clock budget for one bundle fetch, covering every
+    # retry and failover server — the gossip fold blocks at most this
+    # long per by-reference receipt
+    proof_fetch_budget_s: float = 30.0
+    # per-chunk mailbox-read attempts (exponential backoff between)
+    # before a server is abandoned for the next one
+    proof_fetch_retries: int = 3
     # Plausible-lead bound on progress-record EPOCH claims (the epoch
     # twin of the sample cap): a peer's claimed epoch may lead this
     # node's local epoch by at most this margin in the aggregate —

@@ -1275,8 +1275,56 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
             # an honest owner's audit transcript unreplayable (the
             # replay re-derives the same chunk-0 weight)
             wts: Dict[int, float] = {}
+            # r20 deterministic pipelined fold: with the r19 pipeline
+            # on, the drain lands chunks in arrival order, which made
+            # the f32 accumulation order — and therefore the round's
+            # output bytes — a per-run artifact. Pipelined rounds now
+            # BUFFER each completed contribution and fold at the round
+            # seam in roster index order, so the same seeded schedule
+            # produces bit-identical bytes across runs and the audit
+            # transcript's recorded order is a pinned roster-derived
+            # invariant instead of a transcript artifact. Sequential
+            # rounds keep the streaming accumulate untouched (byte
+            # transparency), and the screened path already folds in
+            # sorted sender order.
+            det_fold = pipe is not None and not screen_active
+            det_buf: Dict[int, Tuple[float, object]] = {}
             my_tag = _tag(prefix, epoch, "scatter", me.peer_id)
             my_ctx = _sign_ctx(prefix, epoch, "scatter", me.peer_id)
+
+            def fold_contrib(sender: int, w: float, payload) -> None:
+                # one contribution into the accumulator — the SAME
+                # f32 ops whether called streaming (sequential mode)
+                # or from the roster-ordered seam fold (pipelined)
+                nonlocal acc, total_w
+                if fused:
+                    chunks_b = payload
+                    if all(isinstance(p, (bytes, bytearray))
+                           for p in chunks_b):
+                        acc = device_codec.fused_accumulate(
+                            acc, chunks_b, codec, n_mine, w)
+                    else:
+                        # a sender in some OTHER codec (unpinned
+                        # rounds accept it, r14 semantics): decode
+                        # on the host and add the host-multiplied
+                        # contribution to the device accumulator —
+                        # the add is the same IEEE f32 op either
+                        # way, so parity with the host path holds
+                        seg = np.zeros(n_mine, np.float32)
+                        for ci2, (clo2, chi2) in enumerate(my_chunks):
+                            p = chunks_b[ci2]
+                            seg[clo2:chi2] = (
+                                codec_mod.decompress(
+                                    bytes(p), codec, chi2 - clo2)
+                                if isinstance(p, (bytes, bytearray))
+                                else p)
+                        acc = device_codec.add_contrib(
+                            acc, seg * np.float32(w))
+                else:
+                    acc += payload * w
+                total_w += w
+                if retain_mine:
+                    audit.note_applied(sender)
 
             def decode_reduce(raw_enc: bytes):
                 # decrypt+verify+decompress off the receive thread: the
@@ -1406,31 +1454,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                         payloads = bufs.pop(sender)
                         chunks_b = [payloads[i]
                                     for i in range(len(my_chunks))]
-                        if all(isinstance(p, (bytes, bytearray))
-                               for p in chunks_b):
-                            acc = device_codec.fused_accumulate(
-                                acc, chunks_b, codec, n_mine, w)
+                        if det_fold:
+                            det_buf[sender] = (w, chunks_b)
                         else:
-                            # a sender in some OTHER codec (unpinned
-                            # rounds accept it, r14 semantics): decode
-                            # on the host and add the host-multiplied
-                            # contribution to the device accumulator —
-                            # the add is the same IEEE f32 op either
-                            # way, so parity with the host path holds
-                            seg = np.zeros(n_mine, np.float32)
-                            for ci2, (clo2, chi2) in \
-                                    enumerate(my_chunks):
-                                p = chunks_b[ci2]
-                                seg[clo2:chi2] = (
-                                    codec_mod.decompress(
-                                        bytes(p), codec, chi2 - clo2)
-                                    if isinstance(p, (bytes, bytearray))
-                                    else p)
-                            acc = device_codec.add_contrib(
-                                acc, seg * np.float32(w))
-                        total_w += w
-                        if retain_mine:
-                            audit.note_applied(sender)
+                            fold_contrib(sender, w, chunks_b)
                     else:
                         seg = bufs.pop(sender)
                         if screen is not None \
@@ -1454,11 +1481,10 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                                 "quorum the drop is unstruck",
                                 pid[:16],
                                 screen.policy.abs_norm_ceiling)
+                        elif det_fold:
+                            det_buf[sender] = (w, seg)
                         else:
-                            acc += seg * w
-                            total_w += w
-                            if retain_mine:
-                                audit.note_applied(sender)
+                            fold_contrib(sender, w, seg)
                     got.pop(sender)
                     expected.discard(sender)
                 return True
@@ -1498,6 +1524,16 @@ def run_allreduce(dht: DHT, group: AveragingGroup, prefix: str, epoch: int,
                 for f in decoding:
                     if f.done():
                         apply_reduce(f.result())
+            if det_buf:
+                # the round seam: every buffered contribution folds in
+                # roster index order, whatever order the drain landed
+                # them — the accumulation sequence (and the audit
+                # transcript's applied order) is now a function of the
+                # roster alone
+                for s in sorted(det_buf):
+                    w_s, payload = det_buf[s]
+                    fold_contrib(s, w_s, payload)
+                det_buf.clear()
             # strike attribution: a no-show while OTHER senders' data
             # landed here is that peer's fault; zero data from anyone
             # (including the only peer of a 2-peer swarm) is equally

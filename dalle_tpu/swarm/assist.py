@@ -196,15 +196,22 @@ class AveragingAssistant(threading.Thread):
                     "f32 parts pool)", self._n_elements,
                     self._n_elements * 4 / 1e6)
         # last epoch this assistant is DONE with — set on "assisted" AND
-        # on "empty" (a group formed; whatever it was, this epoch's
-        # announces are spent): re-joining the same epoch would only
-        # matchmake against the round's stale announces and burn another
-        # window, possibly costing trainers an elasticity timeout each
-        # time (ADVICE r4). "idle" keeps retrying — the epoch's real
-        # round may simply not have started yet, and camping through the
-        # window is how the assistant's announce makes the roster.
+        # on a CONFIRMED "empty" (a group formed; whatever it was, this
+        # epoch's announces are spent): re-joining the same epoch would
+        # only matchmake against the round's stale announces and burn
+        # another window, possibly costing trainers an elasticity
+        # timeout each time (ADVICE r4). One exception (r20): the FIRST
+        # "empty" on an epoch gets one retry before the epoch is marked
+        # handled — an assistant that matchmade a beat early can form a
+        # stragglers-only group and see nothing parseable while the
+        # epoch's REAL round is still ahead; writing the epoch off on
+        # that single sample forfeits an assist a second window often
+        # wins. "idle" keeps retrying — the epoch's real round may
+        # simply not have started yet, and camping through the window
+        # is how the assistant's announce makes the roster.
         last_handled = -1
         empty_streak = 0
+        retried_epoch = -1
         while not self._stop_event.is_set():
             try:
                 progress = tracker.global_progress(force_refresh=True)
@@ -232,6 +239,15 @@ class AveragingAssistant(threading.Thread):
                     logger.info("assisted epoch %d (total %d rounds)",
                                 progress.epoch, self.rounds_assisted)
                 elif outcome == "empty":
+                    if retried_epoch != progress.epoch:
+                        # first empty on this epoch: retry once before
+                        # permanently marking it handled
+                        retried_epoch = progress.epoch
+                        logger.info(
+                            "assist round for epoch %d was empty — "
+                            "retrying once before writing the epoch "
+                            "off", progress.epoch)
+                        continue
                     empty_streak += 1
                     last_handled = progress.epoch
                     if empty_streak >= 3:

@@ -778,13 +778,20 @@ def replay_transcript(tr: dict, *, group, prefix: str, epoch: int,
 
 
 def build_proof_evidence(ra: RoundAudit, part: int,
-                         transcript_blob: bytes) -> Optional[bytes]:
+                         transcript_blob: bytes,
+                         limit: Optional[int] = None) -> Optional[bytes]:
     """The evidence bundle for one ``replayed-bytes-mismatch``
     conviction: the owner-signed transcript + the owner-signed gather
     frames this member applied, plus the (group-hash-authenticated)
     roster a verifier needs to rebuild the round context. None when the
     retention is incomplete (a partial frame set cannot prove a
-    mismatch to a third party)."""
+    mismatch to a third party).
+
+    ``limit`` caps the built bundle: None means ``PROOF_MAX_BYTES``
+    (the inline-receipt bound — without an evidence plane a larger
+    blob would only be built for the gossip to drop), <= 0 means
+    unbounded (the r20 by-reference plane serves bundles of any size
+    from the issuer's mailbox)."""
     import msgpack
 
     from dalle_tpu.swarm.health import PROOF_MAX_BYTES
@@ -795,16 +802,16 @@ def build_proof_evidence(ra: RoundAudit, part: int,
                                      ra.chunk_elems))
     if set(frames) != set(range(n_chunks)):
         return None
+    cap = PROOF_MAX_BYTES if limit is None else limit
     body = sum(len(b) for b in frames.values()) + len(transcript_blob)
-    if body > PROOF_MAX_BYTES:
-        # flagship-size parts cannot ship inline evidence: skip
-        # BUILDING the multi-hundred-MB blob the gossip would only
-        # drop against the cap — the conviction degrades to the r13
-        # capped receipt (evidence-by-reference is the named future
-        # work, ROADMAP r16 residuals)
+    if cap > 0 and body > cap:
+        # flagship-size parts cannot ship inline evidence and no
+        # by-reference plane is armed: skip BUILDING the
+        # multi-hundred-MB blob the gossip would only drop against
+        # the cap — the conviction degrades to the r13 capped receipt
         logger.warning(
             "proof evidence for part %d is %d bytes (> %d): receipt "
-            "will carry no proof", part, body, PROOF_MAX_BYTES)
+            "will carry no proof", part, body, cap)
         return None
     return msgpack.packb({
         "v": 1,
@@ -823,6 +830,441 @@ def build_proof_evidence(ra: RoundAudit, part: int,
 def _chunk_slices_for(n: int, chunk_elems: int):
     from dalle_tpu.swarm.allreduce import _chunk_slices
     return _chunk_slices(n, chunk_elems)
+
+
+# -- evidence by reference (r20) -------------------------------------------
+#
+# Past PROOF_MAX_BYTES a receipt cannot embed its evidence, and before
+# r20 it degraded to the capped r13 accusation — a flagship-scale
+# (hundreds of MB) conviction could never ship its proof. Now the
+# receipt carries a ~100-byte DESCRIPTOR instead: the bundle's sha256
+# digest, its exact size/chunking, and the issuer's mailbox address.
+# The issuer parks the chunked bundle in its mailbox
+# (state_transfer-style framing: the same (chunk_idx, n_chunks) header
+# the transcript plane uses, under digest-derived tags), and any
+# verifier fetches, hash-checks BEFORE any sized allocation or parse,
+# then replays under the existing all-or-nothing predicate. A peer
+# that verified a fetched bundle re-serves it from its own mailbox and
+# advertises under ``{prefix}_evsrv`` so later verifiers fail over
+# when the issuer churns out. Unfetchable or digest-mismatched
+# evidence has NO ledger effect (the receipt is dropped outright); an
+# issuer that cannot park the bundle at all (unroutable, mailbox post
+# failure) falls back to publishing the plain r13 capped accusation.
+
+#: how long a parked evidence bundle stays fetchable; re-posted by the
+#: serving worker while retained, so the effective window is the
+#: retention, not one TTL
+EVIDENCE_SERVE_TTL = 300.0
+
+#: sanity bounds a descriptor must satisfy before any fetch I/O — the
+#: receipt plane is attacker-writable
+_EVREF_MAX_CHUNKS = 65536
+_EVREF_MAX_CHUNK_BYTES = 64 << 20  # the native frame cap
+
+
+def evidence_servers_key(prefix: str) -> str:
+    """The DHT key under which verified re-servers advertise
+    (subkey ``{digest_hex}.{peer_id}`` -> mailbox address)."""
+    return f"{prefix}_evsrv"
+
+
+def _evidence_tag(digest: bytes, chunk: int) -> int:
+    d = hashlib.sha256(b"evidence:" + digest
+                       + struct.pack(">I", chunk)).digest()
+    return int.from_bytes(d[:8], "big")
+
+
+def parse_evidence_ref(obj: dict, max_bytes: int) -> Optional[dict]:
+    """STRICT-validate a by-reference descriptor. None on anything
+    malformed or over budget — notably an oversize claim is rejected
+    HERE, before any allocation or wire I/O happens for it."""
+    try:
+        digest = bytes(obj["digest"])
+        size = int(obj["size"])
+        n_chunks = int(obj["n_chunks"])
+        chunk = int(obj["chunk"])
+        addr = str(obj["addr"])
+    # attacker-writable plane: malformed is exactly "unverifiable"
+    # graftlint: disable=silent-except
+    except Exception:  # noqa: BLE001 - any parse failure = reject
+        return None
+    if len(digest) != 32 or not 0 < size <= max_bytes:
+        return None
+    if not 1024 <= chunk <= _EVREF_MAX_CHUNK_BYTES:
+        return None
+    if n_chunks != (size + chunk - 1) // chunk \
+            or n_chunks > _EVREF_MAX_CHUNKS:
+        return None
+    if len(addr) > 256:
+        return None
+    return {"digest": digest, "size": size, "n_chunks": n_chunks,
+            "chunk": chunk, "addr": addr}
+
+
+class EvidencePlane:
+    """Serve + fetch half of the by-reference proof plane.
+
+    **Serve** (issuer side): :meth:`publish` chunks a bundle into this
+    peer's mailbox under digest-derived tags, advertises this peer
+    under :func:`evidence_servers_key`, retains the bundle (bounded
+    bytes, oldest-first eviction) and returns the msgpack descriptor
+    the receipt embeds; None when the mailbox post fails — the caller
+    degrades to the capped accusation. A verifier that replayed a
+    fetched bundle to a conviction calls ``publish(..., reserve=True)``
+    so the evidence survives the issuer churning out (failover).
+
+    **Fetch** (verifier side): :meth:`fetch` resolves a validated
+    descriptor to the full bundle. Requests are deduplicated by digest
+    in an in-flight table and executed by ONE background worker
+    thread, so the caller's wait is hard-bounded by ``budget_s`` even
+    when a mailbox read wedges; each candidate server (issuer first,
+    then advertised re-servers) is pulled completely and independently
+    — chunks are never mixed across servers, a half-poisoned server
+    cannot corrupt a fetch another server could have satisfied — with
+    capped per-chunk retries and exponential backoff. The assembled
+    bytes are length- and sha256-checked against the descriptor BEFORE
+    they are returned (and so before any parse or sized allocation
+    downstream).
+
+    Thread roles: public methods run on their callers (gossip thread,
+    tests); ``_run`` is the fetch/refresh worker. Every shared field
+    below is guarded by ``_cv`` through *visible* ``with self._cv:``
+    blocks — deliberately NOT ``guarded-by`` annotations, so
+    graftlint's lockset analysis proves the guarding rather than
+    trusting a declaration (and a dropped lock is a lint error, not a
+    silent regression). The worker thread is started last in
+    ``__init__`` so field initialization happens-before its first read.
+    """
+
+    def __init__(self, dht, prefix: str, *,
+                 max_bytes: int = 1 << 30, budget_s: float = 30.0,
+                 retries: int = 3, fetch_timeout: float = 2.0,
+                 chunk_bytes: int = 8 << 20,
+                 serve_ttl: float = EVIDENCE_SERVE_TTL,
+                 serve_max_bytes: int = 1 << 30, tracer=None):
+        self._dht = dht
+        self.prefix = prefix
+        self.max_bytes = int(max_bytes)
+        self.budget_s = float(budget_s)
+        self.retries = max(1, int(retries))
+        self.fetch_timeout = float(fetch_timeout)
+        self.chunk_bytes = int(chunk_bytes)
+        self.serve_ttl = float(serve_ttl)
+        self.serve_max_bytes = int(serve_max_bytes)
+        self._tracer = tracer
+        self._cv = threading.Condition()
+        # digest -> retained bundle bytes this peer serves (issuer or
+        # verified re-server); insertion-ordered for byte eviction
+        self._served: Dict[bytes, bytes] = {}
+        self._served_bytes = 0
+        # digest -> in-flight fetch job (dedup: concurrent verifiers of
+        # the same bundle share one wire fetch)
+        self._inflight: Dict[bytes, dict] = {}
+        self._jobs: deque = deque()
+        self._stop = False
+        # observability counters (surfaced as proof_fetch_* in the
+        # robustness snapshot) — written under _cv from both roles
+        self.fetch_attempted = 0
+        self.fetch_ok = 0
+        self.fetch_failed = 0
+        self.fetch_timeouts = 0
+        self.fetch_failover = 0
+        self.fetch_cached = 0
+        self.fetch_bytes = 0
+        self.published = 0
+        self.reserved = 0
+        self.publish_failed = 0
+        self._refresh_due = time.monotonic() + self.serve_ttl / 4
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="evidence-fetch")
+        self._thread.start()
+
+    # -- serve half (issuer / verified re-server) ----------------------
+
+    def publish(self, bundle: bytes, reserve: bool = False
+                ) -> Optional[bytes]:
+        """Park ``bundle`` in this peer's mailbox and return the
+        descriptor bytes a receipt embeds; None when the post or the
+        advertisement fails (the caller falls back to the capped
+        accusation). Idempotent per digest — re-publishing refreshes
+        the TTL instead of duplicating retention."""
+        import msgpack
+        bundle = bytes(bundle)
+        digest = hashlib.sha256(bundle).digest()
+        addr = getattr(self._dht, "visible_address", "")
+        if not addr:
+            with self._cv:
+                self.publish_failed += 1
+            logger.warning("evidence publish: no reachable mailbox "
+                           "address — receipt degrades to the capped "
+                           "accusation")
+            return None
+        step = self.chunk_bytes
+        pieces = [bundle[o:o + step]
+                  for o in range(0, len(bundle), step)] or [b""]
+        if not self._post_chunks(digest, pieces):
+            with self._cv:
+                self.publish_failed += 1
+            return None
+        self._advertise(digest, addr)
+        with self._cv:
+            if digest not in self._served:
+                self._retain_locked(digest, bundle)
+            if reserve:
+                self.reserved += 1
+            else:
+                self.published += 1
+        from dalle_tpu.obs.trace import span
+        with span(self._tracer, "swarm", "proof_serve",
+                  f"{self.prefix}:evidence:{digest.hex()[:12]}",
+                  bytes=len(bundle), chunks=len(pieces),
+                  reserve=bool(reserve)):
+            pass
+        return msgpack.packb(
+            {"v": 2, "byref": 1, "digest": digest, "size": len(bundle),
+             "n_chunks": len(pieces), "chunk": step, "addr": addr},
+            use_bin_type=True)
+
+    def _post_chunks(self, digest: bytes, pieces: List[bytes]) -> bool:
+        exp = time.time() + self.serve_ttl
+        ok = True
+        for ci, piece in enumerate(pieces):
+            body = _TCHDR.pack(ci, len(pieces)) + piece
+            try:
+                ok = self._dht.post(_evidence_tag(digest, ci), body,
+                                    exp) and ok
+            # a raising post is a failing post: the descriptor must
+            # not name chunks nobody can fetch
+            # graftlint: disable=silent-except
+            except Exception:  # noqa: BLE001 - degrade, don't die
+                ok = False
+        return ok
+
+    def _advertise(self, digest: bytes, addr: str) -> None:
+        from dalle_tpu.swarm.dht import get_dht_time
+        try:
+            self._dht.store(
+                evidence_servers_key(self.prefix),
+                f"{digest.hex()}.{self._dht.peer_id}", addr,
+                expiration_time=get_dht_time() + self.serve_ttl)
+        # advertisement is best-effort: the issuer addr in the
+        # descriptor still serves
+        # graftlint: disable=silent-except
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _retain_locked(self, digest: bytes, bundle: bytes) -> None:
+        # caller holds _cv
+        self._served[digest] = bundle
+        self._served_bytes += len(bundle)
+        while self._served_bytes > self.serve_max_bytes \
+                and len(self._served) > 1:
+            old, blob = next(iter(self._served.items()))
+            del self._served[old]
+            self._served_bytes -= len(blob)
+
+    # -- fetch half (verifier side) ------------------------------------
+
+    def fetch(self, ref: dict) -> Optional[bytes]:
+        """Resolve a :func:`parse_evidence_ref`-validated descriptor to
+        the full, digest-checked bundle; None on any failure within
+        the hard time budget. Never raises."""
+        digest = ref["digest"]
+        deadline = time.monotonic() + self.budget_s
+        with self._cv:
+            cached = self._served.get(digest)
+            if cached is not None:
+                self.fetch_cached += 1
+                return cached
+            job = self._inflight.get(digest)
+            if job is None:
+                job = {"ref": dict(ref), "deadline": deadline,
+                       "done": False, "blob": None, "failover": False}
+                self._inflight[digest] = job
+                self._jobs.append(job)
+                self.fetch_attempted += 1
+                self._cv.notify_all()
+            else:
+                # a later caller may extend the worker's patience, never
+                # shrink it under the first caller
+                job["deadline"] = max(job["deadline"], deadline)
+            while not job["done"]:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(timeout=min(0.2, left))
+            if not job["done"]:
+                self.fetch_timeouts += 1
+                return None
+            return job["blob"]
+
+    # -- worker --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            job = None
+            with self._cv:
+                while not self._jobs and not self._stop:
+                    self._cv.wait(timeout=0.2)
+                    if time.monotonic() >= self._refresh_due:
+                        break
+                if self._stop and not self._jobs:
+                    return
+                if self._jobs:
+                    job = self._jobs.popleft()
+            if job is None:
+                self._refresh_serves()
+                continue
+            from dalle_tpu.obs.trace import span
+            digest = job["ref"]["digest"]
+            with span(self._tracer, "swarm", "proof_fetch",
+                      f"{self.prefix}:evidence:{digest.hex()[:12]}",
+                      size=job["ref"]["size"]) as sp:
+                blob = self._fetch_job(job)
+                sp.set(ok=blob is not None,
+                       failover=bool(job.get("failover")))
+            with self._cv:
+                job["blob"] = blob
+                job["done"] = True
+                self._inflight.pop(digest, None)
+                if blob is not None:
+                    self.fetch_ok += 1
+                    self.fetch_bytes += len(blob)
+                    if job.get("failover"):
+                        self.fetch_failover += 1
+                    self._retain_locked(digest, blob)
+                else:
+                    self.fetch_failed += 1
+                self._cv.notify_all()
+
+    def _servers_for(self, ref: dict) -> List[str]:
+        servers = [ref["addr"]] if ref["addr"] else []
+        try:
+            ads = self._dht.get(evidence_servers_key(self.prefix)) or {}
+        # the advert plane is best-effort; the issuer addr remains
+        # graftlint: disable=silent-except
+        except Exception:  # noqa: BLE001
+            ads = {}
+        want = ref["digest"].hex() + "."
+        for sk in sorted(ads):
+            skey = sk.decode() if isinstance(sk, bytes) else str(sk)
+            if not skey.startswith(want):
+                continue
+            v = ads[sk].value
+            if isinstance(v, str) and v and v not in servers:
+                servers.append(v)
+        return servers
+
+    def _fetch_job(self, job: dict) -> Optional[bytes]:
+        ref = job["ref"]
+        servers = self._servers_for(ref)
+        for si, server in enumerate(servers):
+            with self._cv:
+                if self._stop:
+                    return None
+            if time.monotonic() >= job["deadline"]:
+                return None  # hard time budget
+            blob = self._pull_from(server, ref, job)
+            if blob is not None:
+                if si > 0:
+                    job["failover"] = True
+                return blob
+            logger.warning(
+                "evidence fetch: server %s could not satisfy digest "
+                "%s — %s", server, ref["digest"].hex()[:12],
+                "failing over" if si + 1 < len(servers)
+                else "giving up")
+        return None
+
+    def _pull_from(self, addr: str, ref: dict, job: dict
+                   ) -> Optional[bytes]:
+        """One server, pulled completely: per-chunk capped retries with
+        exponential backoff, the CLAIMED size as the byte budget, and
+        the digest check before any caller sees a byte."""
+        digest, size = ref["digest"], ref["size"]
+        n_chunks, step = ref["n_chunks"], ref["chunk"]
+        pieces: List[bytes] = []
+        got = 0
+        for ci in range(n_chunks):
+            body = None
+            backoff = 0.1
+            for attempt in range(self.retries):
+                left = job["deadline"] - time.monotonic()
+                if left <= 0:
+                    return None
+                try:
+                    raw = self._dht.fetch(
+                        addr, _evidence_tag(digest, ci),
+                        timeout=min(self.fetch_timeout,
+                                    max(0.1, left)))
+                # a raising transport is a missing chunk (retry/fail)
+                # graftlint: disable=silent-except
+                except Exception:  # noqa: BLE001
+                    raw = None
+                if raw is not None and len(raw) >= _TCHDR.size:
+                    gci, gn = _TCHDR.unpack_from(raw)
+                    if gci == ci and gn == n_chunks \
+                            and len(raw) - _TCHDR.size <= step:
+                        body = bytes(raw[_TCHDR.size:])
+                        break
+                if attempt + 1 < self.retries:
+                    time.sleep(min(backoff, max(
+                        0.0, job["deadline"] - time.monotonic())))
+                    backoff *= 2
+            if body is None:
+                return None
+            got += len(body)
+            if got > size:
+                return None  # stream over the claimed size: poisoned
+            pieces.append(body)
+        blob = b"".join(pieces)
+        if len(blob) != size:
+            return None  # truncated stream
+        if hashlib.sha256(blob).digest() != digest:
+            return None  # forged/substituted content
+        return blob
+
+    def _refresh_serves(self) -> None:
+        """Periodic TTL refresh of every retained bundle's mailbox
+        chunks + advertisement, so a bundle outlives one serve TTL for
+        as long as it stays retained."""
+        with self._cv:
+            self._refresh_due = time.monotonic() + self.serve_ttl / 4
+            batch = list(self._served.items())
+        addr = getattr(self._dht, "visible_address", "")
+        for digest, bundle in batch:
+            step = self.chunk_bytes
+            pieces = [bundle[o:o + step]
+                      for o in range(0, len(bundle), step)] or [b""]
+            self._post_chunks(digest, pieces)
+            if addr:
+                self._advertise(digest, addr)
+
+    def counters(self) -> Dict[str, int]:
+        with self._cv:
+            return {
+                "attempted": self.fetch_attempted,
+                "ok": self.fetch_ok,
+                "failed": self.fetch_failed,
+                "timeouts": self.fetch_timeouts,
+                "failover": self.fetch_failover,
+                "cached": self.fetch_cached,
+                "bytes": self.fetch_bytes,
+                "published": self.published,
+                "reserved": self.reserved,
+                "publish_failed": self.publish_failed,
+            }
+
+    def stop(self, join_timeout: Optional[float] = 10.0) -> None:
+        """Signal AND (bounded) join before the owner tears the DHT
+        down — an in-flight mailbox read on a destroyed native node is
+        a use-after-free."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if join_timeout is not None and self._thread.is_alive() \
+                and threading.current_thread() is not self._thread:
+            self._thread.join(timeout=join_timeout)
 
 
 class _ProofMember:
@@ -877,7 +1319,8 @@ class ProofVerifier:
                  max_peer_weight: Optional[float] = None,
                  gather_codec: Optional[int] = None,
                  pinned: Optional[int] = None,
-                 phase_overrides: Optional[Dict[str, dict]] = None):
+                 phase_overrides: Optional[Dict[str, dict]] = None,
+                 fetcher: Optional["EvidencePlane"] = None):
         self.run_prefix = run_prefix
         self.frac = frac
         self.chunk_elems = chunk_elems
@@ -887,6 +1330,10 @@ class ProofVerifier:
         self.max_peer_weight = max_peer_weight
         self.gather_codec = gather_codec
         self.pinned = pinned
+        #: optional by-reference resolver (r20 EvidencePlane): without
+        #: it, a by-reference receipt is rejected (fail-safe — no
+        #: ledger effect), exactly like any other unverifiable proof
+        self.fetcher = fetcher
         #: phase -> {codec/gather_codec/pinned/screen/...} replay-knob
         #: overrides: the auxiliary phases (PowerSGD factors, state
         #: averaging) run the same butterfly under DIFFERENT codec
@@ -919,6 +1366,40 @@ class ProofVerifier:
         from dalle_tpu.swarm.allreduce import _parse, _sign_ctx
         try:
             obj = msgpack.unpackb(bytes(proof), raw=False)
+        # the proof plane is attacker-writable; malformed evidence is
+        # exactly "unverifiable"
+        # graftlint: disable=silent-except
+        except Exception:  # noqa: BLE001 - any parse failure = reject
+            return self._reject("malformed evidence")
+        fetched: Optional[bytes] = None
+        if isinstance(obj, dict) and obj.get("byref"):
+            # r20 evidence by reference: the receipt carried a digest +
+            # mailbox descriptor instead of inline bytes. Resolve it —
+            # validation (oversize claims die before any allocation),
+            # budgeted fetch with failover, digest check — then judge
+            # the fetched bundle under the unchanged all-or-nothing
+            # predicate below. Any fetch failure is a rejection with
+            # zero ledger effect.
+            if self.fetcher is None:
+                return self._reject(
+                    "by-reference evidence with no fetch plane armed")
+            ref = parse_evidence_ref(obj, self.fetcher.max_bytes)
+            if ref is None:
+                return self._reject(
+                    "malformed or over-budget evidence reference")
+            fetched = self.fetcher.fetch(ref)
+            if fetched is None:
+                return self._reject(
+                    "evidence unfetchable within budget (digest "
+                    f"{ref['digest'].hex()[:12]})")
+            try:
+                obj = msgpack.unpackb(fetched, raw=False)
+            # fetched bytes matched the signed digest but do not
+            # parse: the ISSUER parked garbage — still just a reject
+            # graftlint: disable=silent-except
+            except Exception:  # noqa: BLE001
+                return self._reject("fetched evidence does not parse")
+        try:
             prefix = str(obj["prefix"])
             p_epoch = int(obj["epoch"])
             part = int(obj["part"])
@@ -951,12 +1432,16 @@ class ProofVerifier:
         # plausibility bounds BEFORE any sized allocation: the proof
         # plane is attacker-writable, and the claimed part size must
         # be payable by the evidence itself (even the densest codec
-        # spends >= half a byte per element on its gather frames; the
-        # receipt is capped at PROOF_MAX_BYTES) — without this, a tiny
-        # receipt claiming part_elems ~ 1e13 would have the gossip
-        # worker attempt a multi-TB np.empty per poll
+        # spends >= half a byte per element on its gather frames; an
+        # inline receipt is capped at PROOF_MAX_BYTES, a fetched
+        # bundle at its digest-checked actual size, which the fetch
+        # budget already bounded) — without this, a tiny receipt
+        # claiming part_elems ~ 1e13 would have the gossip worker
+        # attempt a multi-TB np.empty per poll
         from dalle_tpu.swarm.health import PROOF_MAX_BYTES
-        if part_elems > 2 * PROOF_MAX_BYTES or len(members) > 4096:
+        bound = max(PROOF_MAX_BYTES,
+                    len(fetched) if fetched is not None else 0)
+        if part_elems > 2 * bound or len(members) > 4096:
             return self._reject("implausible round context")
         # roster authentication: the group hash bound into every signed
         # frame header commits to the member ids — the ONE formula
@@ -1033,13 +1518,21 @@ class ProofVerifier:
             return self._reject("served bytes match the replay "
                                 "(no contradiction)")
         self.verified += 1
+        if fetched is not None and self.fetcher is not None:
+            # this peer just REPLAYED the fetched bundle to a
+            # conviction: re-serve it from its own mailbox and
+            # advertise, so later verifiers fail over here when the
+            # issuer churns out (best-effort — a failed re-post only
+            # loses the failover, never the conviction)
+            self.fetcher.publish(fetched, reserve=True)
         return prefix
 
 
 # -- the audit pass (auditor side) -----------------------------------------
 
 def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1,
-                repair=None) -> dict:
+                repair=None,
+                evidence_limit: Optional[int] = None) -> dict:
     """Audit every challenged part this peer fully gathered: fetch the
     owner's transcript, replay it, bit-compare, and strike. Also runs
     the sender-side omission check for parts this peer's own
@@ -1137,9 +1630,9 @@ def audit_round(dht, ra: RoundAudit, ledger, *, jobs: int = 1,
         elif status == "failed":
             evidence = None
             if honest is not None and blob is not None:
-                evidence = build_proof_evidence(ra, p, blob)
-                if repair is not None and repair.accept_prefix in (
-                        None, ra.prefix):
+                evidence = build_proof_evidence(ra, p, blob,
+                                                limit=evidence_limit)
+                if repair is not None and repair.accepts(ra.prefix):
                     # the copies are built only for a plane that will
                     # take them, and "repaired" reports what the plane
                     # actually ACCEPTED (an overflow drop is not a
@@ -1195,7 +1688,8 @@ class AuditWorker(threading.Thread):
 
     def __init__(self, dht, ledger, *, period: float = 0.5,
                  jobs: int = 1, repair=None,
-                 max_bytes: int = MAX_BYTES):
+                 max_bytes: int = MAX_BYTES,
+                 evidence_limit: Optional[int] = None):
         super().__init__(daemon=True, name="audit-worker")
         self.dht = dht
         self.ledger = ledger
@@ -1203,6 +1697,10 @@ class AuditWorker(threading.Thread):
         self.jobs = jobs
         self.repair = repair
         self.max_bytes = max_bytes
+        #: forwarded to build_proof_evidence: None keeps the inline
+        #: PROOF_MAX_BYTES cap, <= 0 builds unbounded bundles for the
+        #: by-reference plane (r20)
+        self.evidence_limit = evidence_limit
         self._stop_event = threading.Event()
         self._lock = threading.Lock()
         self._pending: deque = deque()
@@ -1266,7 +1764,8 @@ class AuditWorker(threading.Thread):
                 ra = self._pending.popleft()
                 self._pending_bytes -= ra.retained_bytes()
             rep = audit_round(self.dht, ra, self.ledger,
-                              jobs=self.jobs, repair=self.repair)
+                              jobs=self.jobs, repair=self.repair,
+                              evidence_limit=self.evidence_limit)
             with self._lock:
                 self.audited += len(rep["audited"])
                 self.failures += len(rep["failed"])

@@ -4,8 +4,11 @@ The paper's core claim is that a swarm of elastic, unreliable volunteers
 behaves like one synchronous data-parallel trainer. The failure paths
 that make that true — sender bans in ``allreduce.py``, confirm-wait
 deadlines in ``matchmaking.py``, the ALONE-epoch fallback in
-``optimizer.py``, server failover in ``state_transfer.py`` — need to be
-*drivable*, not just reachable by ad-hoc peer kills. This module wraps a
+``optimizer.py``, server failover in ``state_transfer.py``, the
+evidence-fetch budget/failover/zero-ledger-effect rules in
+``audit.EvidencePlane`` (its mailbox posts and fetches ride the same
+``post``/``fetch`` ops this wrapper faults) — need to be *drivable*,
+not just reachable by ad-hoc peer kills. This module wraps a
 :class:`~dalle_tpu.swarm.dht.DHT` with a seeded, declarative
 :class:`FaultPlan` that injects message drop / delay / duplication,
 payload corruption / truncation, per-peer bandwidth throttling, timed

@@ -109,13 +109,18 @@ GOSSIP_REASONS = frozenset({
 _MAX_EVENTS = 4096
 _MAX_SEEN = 8192
 
-#: largest evidence bundle a receipt will embed. Proof-carrying
+#: largest evidence bundle a receipt will embed INLINE. Proof-carrying
 #: receipts (swarm/audit.py build_proof_evidence) ship the owner-signed
-#: transcript + gather frames inline so any peer can replay them;
-#: beyond this bound (flagship-scale parts) the receipt degrades to the
-#: plain r13 capped accusation — the conviction still lands through
-#: local corroboration, just not by proof alone. Sized under the
-#: native 64 MiB frame cap with headroom for the DHT record plane.
+#: transcript + gather frames inline so any peer can replay them.
+#: Beyond this bound (flagship-scale parts) the receipt carries a
+#: by-REFERENCE descriptor instead — the bundle's sha256 digest + the
+#: issuer's mailbox reference (swarm/audit.EvidencePlane, r20) — and
+#: verifiers fetch, hash-check and replay the parked bundle. Only when
+#: no evidence store is armed, or the issuer cannot park the bundle
+#: (unroutable peer, mailbox post failure), does the receipt degrade
+#: to the plain r13 capped accusation — the conviction still lands
+#: through local corroboration, just not by proof alone. Sized under
+#: the native 64 MiB frame cap with headroom for the DHT record plane.
 PROOF_MAX_BYTES = 4 << 20
 
 
@@ -485,6 +490,13 @@ class StrikeGossip(threading.Thread):
         # None -> ProofVerifier transition the run thread tolerates)
         # graftlint: handoff=bind-once-wiring
         self.verifier = verifier
+        #: optional by-reference evidence store (swarm/audit
+        #: .EvidencePlane): with it armed, evidence too large to embed
+        #: is parked in this issuer's mailbox and the receipt carries
+        #: the descriptor; without it (or when parking fails) the
+        #: over-budget receipt degrades to the capped r13 accusation
+        # graftlint: handoff=bind-once-wiring
+        self.evidence_store = None
         self._stop_event = threading.Event()
         self._seen: set = set()     # (issuer, peer, reason, epoch, ref)
         # observability counters: written by whichever thread drives
@@ -500,6 +512,8 @@ class StrikeGossip(threading.Thread):
         self.proofs_convicted = 0
         # graftlint: handoff=single-driver-counter
         self.proofs_rejected = 0
+        # graftlint: handoff=single-driver-counter
+        self.proofs_by_reference = 0
 
     # -- one synchronous round (tests / soak drive this directly) ---------
 
@@ -515,11 +529,22 @@ class StrikeGossip(threading.Thread):
                 continue  # self-verdicts are local bookkeeping only
             proof = (evidence if evidence is not None
                      and len(evidence) <= PROOF_MAX_BYTES else None)
+            if evidence is not None and proof is None \
+                    and self.evidence_store is not None:
+                # r20 evidence by reference: park the oversize bundle
+                # in this issuer's mailbox and embed the ~100-byte
+                # descriptor under the receipt signature instead
+                proof = self.evidence_store.publish(evidence)
+                if proof is not None:
+                    self.proofs_by_reference += 1
             if evidence is not None and proof is None:
+                # stonewalled: no store armed, or the park failed —
+                # the r13 capped accusation is the floor
                 logger.warning(
                     "strike evidence too large to embed (%d > %d "
-                    "bytes): receipt degrades to the capped "
-                    "accusation", len(evidence), PROOF_MAX_BYTES)
+                    "bytes) and not parkable by reference: receipt "
+                    "degrades to the capped accusation",
+                    len(evidence), PROOF_MAX_BYTES)
             receipt = make_receipt(self.dht.identity, self.prefix,
                                    peer, reason, epoch, proof=proof)
             sub = f"{self.dht.peer_id}.{peer}.{reason}.{epoch}"

@@ -231,8 +231,10 @@ class CollaborativeOptimizer:
             # graftlint: handoff=init-then-joined-teardown
             self._audit_policy = None
             self._repair = None
+            self._evidence = None
             if getattr(cfg, "audit_gather", False):
-                from dalle_tpu.swarm.audit import AuditPolicy, AuditWorker
+                from dalle_tpu.swarm.audit import (AuditPolicy, AuditWorker,
+                                                   EvidencePlane)
                 self._audit_policy = AuditPolicy(
                     frac=cfg.audit_frac, ttl=cfg.audit_ttl)
                 if getattr(cfg, "repair_convicted", False) \
@@ -243,12 +245,45 @@ class CollaborativeOptimizer:
                     # with), and a plane nothing drains would just
                     # retain part-sized copies — don't create one
                     from dalle_tpu.swarm.repair import RepairPlane
+                    prefixes = [f"{cfg.run_id}_grads"]
+                    if getattr(cfg, "repair_aux_phases", False):
+                        # r20: factor and state convictions queue
+                        # corrections too, drained at their own phase's
+                        # application site (prefix-scoped — a factor
+                        # correction never lands in a gradient vector)
+                        prefixes += [f"{cfg.run_id}_grads_p",
+                                     f"{cfg.run_id}_grads_q",
+                                     f"{cfg.run_id}_state"]
                     self._repair = RepairPlane(
-                        accept_prefix=f"{cfg.run_id}_grads")
+                        accept_prefix=tuple(prefixes))
+                if getattr(cfg, "proof_by_reference", False) \
+                        and self._gossip is not None:
+                    # Evidence-by-reference plane (r20): bundles past
+                    # PROOF_MAX_BYTES ride the receipt as digest +
+                    # mailbox reference; this plane serves ours and
+                    # fetches theirs (budgeted, hash-checked,
+                    # failover-capable). Without gossip nothing ever
+                    # publishes or resolves a reference — skip it.
+                    self._evidence = EvidencePlane(
+                        dht, cfg.run_id,
+                        max_bytes=getattr(cfg, "proof_fetch_max_bytes",
+                                          2 << 30),
+                        budget_s=getattr(cfg, "proof_fetch_budget_s",
+                                         30.0),
+                        retries=getattr(cfg, "proof_fetch_retries", 3),
+                        tracer=self.tracer)
+                    # bind-once wiring before the gossip worker's first
+                    # over-budget publish can look at it
+                    self._gossip.evidence_store = self._evidence
                 self._auditor = AuditWorker(
                     dht, self.ledger, repair=self._repair,
                     max_bytes=getattr(cfg, "audit_ring_bytes",
-                                      AuditWorker.MAX_BYTES))
+                                      AuditWorker.MAX_BYTES),
+                    # with the by-reference plane armed, evidence has no
+                    # inline size cap — oversized bundles publish by
+                    # reference instead of degrading to capped accusation
+                    evidence_limit=0 if self._evidence is not None
+                    else None)
                 self._auditor.start()
         else:
             self.ledger = None
@@ -258,6 +293,7 @@ class CollaborativeOptimizer:
             self._auditor = None
             self._audit_policy = None
             self._repair = None
+            self._evidence = None
         self.on_after_global_step: List[Callable[[], None]] = []
         self.on_load_state_from_peers: List[Callable[[], None]] = []
         # Wire-codec execution backend (swarm/device_codec.py): "device"
@@ -358,7 +394,11 @@ class CollaborativeOptimizer:
                     "powersgd": {"gather_codec": None, "pinned": None},
                     "state": {"codec": self._state_codec,
                               "gather_codec": None, "pinned": None},
-                })
+                },
+                # r20: receipts whose evidence rides by reference are
+                # resolved through the fetch plane before replay; with
+                # no plane armed they are dropped without ledger effect
+                fetcher=self._evidence)
         self._grad_acc = None
         self._accumulate = jax.jit(
             lambda acc, g, s: jax.tree.map(
@@ -970,15 +1010,19 @@ class CollaborativeOptimizer:
                 # the factor rounds are audited like any butterfly
                 # round (r16): a challenged factor-part owner serves a
                 # transcript under the phase prefix, and a conviction
-                # gossips a proof-carrying receipt. No repair — factor
-                # corrections live in projection space; a corrupted
-                # factor round's blast radius is this epoch's
-                # reconstruction, bounded like IncompleteRound's.
+                # gossips a proof-carrying receipt. Since r20 they are
+                # REPAIRED too (cfg.repair_aux_phases): a replayed-
+                # bytes-mismatch conviction queues its honest-minus-
+                # served correction under this phase's prefix, and the
+                # drain below patches the averaged factor bytes before
+                # the compressor reconstructs from them — the same
+                # pre-step-exact / bounded-staleness split as gradient
+                # repair, confined to projection space.
+                prefix = f"{self.cfg.run_id}_grads_{phase}"
                 ra = self._new_round_audit(self.local_epoch,
                                            f"grads_{phase}")
                 out = run_allreduce(
-                    self.dht, group,
-                    f"{self.cfg.run_id}_grads_{phase}",
+                    self.dht, group, prefix,
                     self.local_epoch, tensors, weight=weight,
                     allreduce_timeout=budget / 2,
                     codec=self._grad_codec,
@@ -991,6 +1035,12 @@ class CollaborativeOptimizer:
                     self._auditor.submit(ra)
                 if not rep.get("complete", False):
                     ok = 0
+                if (ok and out is not None and self._repair is not None
+                        and self._repair.accepts(prefix)
+                        and self._repair.pending(prefix)):
+                    out = [np.array(a, np.float32, copy=True)
+                           for a in out]
+                    self._repair.apply(out, prefix=prefix)
             if sharded:
                 ok = broadcast_decision(ok)
             if not ok:
@@ -1024,7 +1074,9 @@ class CollaborativeOptimizer:
         round — it must survive the reconcile."""
         t0 = time.monotonic()
         from dalle_tpu.parallel.multihost import process_count
-        if (self._repair is not None and self._repair.pending()
+        grads_prefix = f"{self.cfg.run_id}_grads"
+        if (self._repair is not None
+                and self._repair.pending(grads_prefix)
                 and process_count() == 1):
             # Round repair (swarm/repair.py): drain queued corrections
             # into the vector this step applies. A correction whose
@@ -1034,10 +1086,12 @@ class CollaborativeOptimizer:
             # later step as a bounded-staleness compensation. Single-
             # process peers only — a multi-host slice would need the
             # correction broadcast to stay in lockstep, and its
-            # followers run no auditor to agree with.
+            # followers run no auditor to agree with. Drained under the
+            # grads prefix only (r20): factor/state corrections land at
+            # their own phase's application site, never here.
             averaged = [np.array(a, np.float32, copy=True)
                         for a in averaged]
-            self._repair.apply(averaged)
+            self._repair.apply(averaged, prefix=grads_prefix)
         grads_tree = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(a) for a in averaged])
         self.state = self.apply_step(self.state, grads_tree)
@@ -1084,7 +1138,10 @@ class CollaborativeOptimizer:
             "repairs_applied": 0, "repairs_exact": 0,
             "repairs_pending": 0,
             "proofs_published": 0, "proofs_convicted": 0,
-            "proofs_rejected": 0,
+            "proofs_rejected": 0, "proofs_by_reference": 0,
+            "proof_fetch_attempted": 0, "proof_fetch_ok": 0,
+            "proof_fetch_failed": 0, "proof_fetch_timeouts": 0,
+            "proof_fetch_failover": 0, "proof_fetch_bytes": 0,
             "ef_lost_rounds": 0,
         }
         if self._auditor is not None:
@@ -1105,6 +1162,10 @@ class CollaborativeOptimizer:
             out["proofs_published"] = self._gossip.proofs_published
             out["proofs_convicted"] = self._gossip.proofs_convicted
             out["proofs_rejected"] = self._gossip.proofs_rejected
+            out["proofs_by_reference"] = self._gossip.proofs_by_reference
+        if self._evidence is not None:
+            for k, v in self._evidence.counters().items():
+                out[f"proof_fetch_{k}"] = v
         for ef in (self._ef_scatter, self._ef_gather):
             if ef is not None:
                 out["ef_lost_rounds"] += ef.lost_rounds
@@ -1200,6 +1261,20 @@ class CollaborativeOptimizer:
                     audit=ra)
                 if ra is not None:
                     self._auditor.submit(ra)
+                state_prefix = f"{self.cfg.run_id}_state"
+                if (averaged is not None and self._repair is not None
+                        and self._repair.accepts(state_prefix)
+                        and self._repair.pending(state_prefix)):
+                    # r20 aux repair: a convicted state-averaging round
+                    # queues its correction under the state prefix;
+                    # drain it into the averaged floats BEFORE the
+                    # requantize/adopt below so the repaired bytes are
+                    # what lands in params/moments (pre-step exact when
+                    # this is the convicted round itself, bounded-
+                    # staleness compensation otherwise)
+                    averaged = [np.array(a, np.float32, copy=True)
+                                for a in averaged]
+                    self._repair.apply(averaged, prefix=state_prefix)
         if not broadcast_decision(0 if averaged is None else 1):
             return
         if floats is None:  # follower of a slice whose coordinator averaged
@@ -1305,6 +1380,12 @@ class CollaborativeOptimizer:
             # node is a use-after-free (dht.shutdown ordering contract)
             self._gossip.stop()
             self._gossip = None
+        if self._evidence is not None:
+            # after the gossip worker (its publish path posts through
+            # this plane), before the DHT dies (same ordering contract:
+            # an in-flight evidence fetch needs a live node)
+            self._evidence.stop()
+            self._evidence = None
         if self._auditor is not None:
             # same ordering contract: an in-flight transcript fetch on
             # a destroyed native node is a use-after-free

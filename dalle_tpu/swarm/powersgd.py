@@ -64,11 +64,15 @@ aggregation machinery under their own prefixes (``{run}_grads_p`` /
 (which every peer would then orthogonalize into a corrupted shared
 basis) is convicted by transcript replay exactly like a gradient-part
 owner, and the conviction gossips as a proof-carrying receipt
-(swarm/audit.py, CHAOS.md "Round repair"). Factor rounds are audited
-but not REPAIRED: a correction lives in projection space and cannot be
-scattered into the gradient accumulator; the blast radius of one wrong
-factor round is this epoch's reconstruction — the same bound the
-:class:`IncompleteRound` fallback already accepts.
+(swarm/audit.py, CHAOS.md "Round repair"). Since r20 factor rounds are
+REPAIRED as well (``CollabConfig.repair_aux_phases``): the conviction's
+``honest - served`` correction is queued under the phase's own prefix
+and the optimizer's reduce callback drains it into the averaged factor
+bytes before reconstruction — in projection space, where the correction
+actually lives, never scattered into the gradient accumulator. With aux
+repair off the blast radius of one wrong factor round stays this
+epoch's reconstruction — the same bound the :class:`IncompleteRound`
+fallback already accepts.
 
 Compression: a (m x n) tensor costs r*(m+n) floats on the wire instead of
 m*n — at the flagship's 1024x1024 blocks and rank 4 that is 128x less

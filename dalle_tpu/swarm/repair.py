@@ -169,16 +169,25 @@ class RepairPlane:
 
     The AuditWorker (or the soak's synchronous audit) ``submit()``s
     actions as convictions land; the optimizer ``drain()``s them at its
-    next gradient application and patches the averaged vector before
-    the jitted apply. ``accept_prefix`` scopes the plane to one round
-    family — repair covers the main gradient all-reduce; PowerSGD
-    factor rounds and state averaging are audited (convicted, proof-
-    gossiped) but not repaired, their corrections live in factor/state
-    space the gradient plane cannot absorb (CHAOS.md "Round repair").
+    next application site and patches the averaged vector before the
+    consuming step. ``accept_prefix`` scopes the plane to the round
+    families it repairs — a single prefix, a tuple of prefixes, or
+    None for everything. Since r20 the auxiliary phases are repairable
+    too: a ``replayed-bytes-mismatch`` conviction in a PowerSGD factor
+    round queues its ``honest - served`` correction for the factor
+    buffers, and one in state averaging for the averaged-state
+    application — the same pre-step-exact / bounded-staleness split as
+    gradient repair, landed at the phase's own drain site via the
+    ``prefix=`` scoping on :meth:`apply`/:meth:`drain`/:meth:`pending`
+    (phase corrections never cross-apply to another phase's buffers).
+    With aux repair off, factor/state convictions stay detection +
+    proof exactly as in r19.
     """
 
-    def __init__(self, accept_prefix: Optional[str] = None,
+    def __init__(self, accept_prefix=None,
                  max_actions: int = MAX_ACTIONS):
+        if isinstance(accept_prefix, (list, tuple, set, frozenset)):
+            accept_prefix = tuple(sorted(accept_prefix))
         self.accept_prefix = accept_prefix
         self.max_actions = max_actions
         self._lock = threading.Lock()
@@ -193,9 +202,17 @@ class RepairPlane:
         self.applied_stale = 0
         self.dropped_alien = 0
 
+    def accepts(self, prefix: str) -> bool:
+        """Whether this plane takes corrections for ``prefix`` (the
+        audit's submit gate keys on this)."""
+        if self.accept_prefix is None:
+            return True
+        if isinstance(self.accept_prefix, tuple):
+            return prefix in self.accept_prefix
+        return prefix == self.accept_prefix
+
     def submit(self, action: RepairAction) -> bool:
-        if (self.accept_prefix is not None
-                and action.prefix != self.accept_prefix):
+        if not self.accepts(action.prefix):
             with self._lock:
                 self.skipped_prefix += 1
             return False
@@ -215,23 +232,35 @@ class RepairPlane:
             action.owner[:16], action.honest.size)
         return True
 
-    def pending(self) -> int:
+    def pending(self, prefix: Optional[str] = None) -> int:
         with self._lock:
-            return len(self._actions)
+            if prefix is None:
+                return len(self._actions)
+            return sum(1 for a in self._actions if a.prefix == prefix)
 
-    def drain(self) -> List[RepairAction]:
+    def drain(self, prefix: Optional[str] = None) -> List[RepairAction]:
+        """Take queued corrections. ``prefix`` scopes the drain to one
+        round family (the r20 multi-phase plane: the gradient drain
+        must not swallow a factor-round correction destined for the
+        factor buffers, and vice versa); None drains everything."""
         with self._lock:
-            out, self._actions = self._actions, []
+            if prefix is None:
+                out, self._actions = self._actions, []
+                return out
+            out = [a for a in self._actions if a.prefix == prefix]
+            self._actions = [a for a in self._actions
+                             if a.prefix != prefix]
             return out
 
-    def apply(self, arrays: Sequence[np.ndarray]) -> int:
-        """Drain and apply every queued correction onto ``arrays``;
-        returns the number that actually LANDED. Counts exact
-        (pre-step assign) vs stale (post-step compensation) landings;
-        a correction dropped for an alien target layout is counted
-        separately and never inflates ``applied`` (the repair oracles
-        key on it)."""
-        actions = self.drain()
+    def apply(self, arrays: Sequence[np.ndarray],
+              prefix: Optional[str] = None) -> int:
+        """Drain (scoped by ``prefix``) and apply every queued
+        correction onto ``arrays``; returns the number that actually
+        LANDED. Counts exact (pre-step assign) vs stale (post-step
+        compensation) landings; a correction dropped for an alien
+        target layout is counted separately and never inflates
+        ``applied`` (the repair oracles key on it)."""
+        actions = self.drain(prefix)
         n = 0
         for a in actions:
             exact = apply_flat_correction(arrays, a)

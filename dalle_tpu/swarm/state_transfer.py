@@ -12,6 +12,14 @@ sends a request carrying its own address and a nonce; the server streams
 the serialized state back in chunks over the data plane (frames are capped
 well under the transport's 64 MB limit; tensors are compressed with the
 same SizeAdaptive codec used for state averaging, task.py:125-126).
+
+The chunked-stream-with-failover shape defined here — advertise servers
+under a TTL'd DHT key, pull framed chunks with bounded retries, fail
+over to the next advertised server, validate before adopting — is the
+template the r20 evidence-by-reference plane
+(:class:`~dalle_tpu.swarm.audit.EvidencePlane`) reuses for oversized
+audit proofs, with the roles inverted: there the *content hash* is the
+advertisement key and integrity gate, here the epoch is.
 """
 
 from __future__ import annotations

@@ -138,9 +138,12 @@ class _AsyncWriter:
     At most one write per kind ('ckpt'/'backup') is queued behind the one
     in flight; a newer request of the same kind replaces the queued one
     (latest-wins — intermediate backups are droppable by design, exactly
-    like the reference aux peer's upload cadence; a superseded NUMBERED
-    checkpoint is logged at WARNING because its returned path will never
-    materialize). Memory bound: up to THREE device snapshots can be
+    like the reference aux peer's upload cadence). A NUMBERED checkpoint
+    is different: ``save()`` returned its path, so ``submit`` first
+    waits (bounded, ``SUPERSEDE_FLUSH_S``) for a queued ckpt to drain to
+    the worker rather than dropping it — only a wedged filesystem still
+    supersedes, logged at WARNING because the superseded returned path
+    will then never materialize. Memory bound: up to THREE device snapshots can be
     alive at once (one in flight + one queued per kind) when writes are
     slower than both save cadences — ~2.2 GB of stale flagship state
     worst-case; at the production cadence (backup every 5 epochs, ckpt
@@ -164,12 +167,32 @@ class _AsyncWriter:
             target=self._run, name="ckpt-writer", daemon=True)
         self._thread.start()
 
+    #: how long submit() will stall the caller to let a queued NUMBERED
+    #: checkpoint drain before superseding it (save()'s returned-path
+    #: promise); matches close()'s healthy-write bound
+    SUPERSEDE_FLUSH_S = 300.0
+
     def submit(self, kind: str, fn, label: str) -> None:
         with self._lock:
+            if kind == "ckpt" and any(k == kind
+                                      for k, _f, _l in self._queued):
+                # a dropped numbered checkpoint breaks save()'s
+                # returned-path promise — wait (bounded) for the queued
+                # one to reach the worker instead of superseding it.
+                # This only triggers when writes are slower than the
+                # ckpt cadence; the bound keeps a wedged filesystem
+                # from hanging the training thread.
+                deadline = time.monotonic() + self.SUPERSEDE_FLUSH_S
+                while any(k == kind for k, _f, _l in self._queued):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._work.wait(left)
             for i, (k, _f, lbl) in enumerate(self._queued):
                 if k == kind:
-                    # a dropped numbered checkpoint breaks save()'s
-                    # returned-path promise — make the supersession loud
+                    # still queued after the bounded wait (ckpt), or a
+                    # droppable-by-design backup — supersede, loudly
+                    # for ckpt since its returned path will never exist
                     log = (logger.warning if kind == "ckpt"
                            else logger.info)
                     log("checkpoint writer busy: superseding queued "

@@ -89,7 +89,12 @@ schedule:
   aux-phase attackers are each convicted in every honest ledger via a
   proof-carrying receipt — with at least one peer convicting while it
   held no local evidence of its own (proof alone convicts); every
-  attack seam actually fired (phase-scoped injected counters);
+  attack seam actually fired (phase-scoped injected counters). Since
+  r20 the evidence rides BY REFERENCE (the inline cap is forced under
+  every bundle's size): honest peers publish descriptors, fetch
+  foreign bundles digest-checked, aux convictions REPAIR the
+  factor/state averages bit-exactly, and a poison phase pins that
+  unfetchable/forged descriptors are rejected with no ledger effect;
 - a **nofix** pass (attacks on; audits ON, repair OFF, aux off) — the
   r15 reference: detection without correction, so convicted honest
   survivors DIVERGE from the analytic reference — the regression the
@@ -300,32 +305,56 @@ class SoakPeer:
         # corroboration (the aux-phase oracle), an unverifiable one is
         # dropped without ledger effect
         verifier = None
+        self.evidence_plane = None
         if gossip and audit_policy is not None:
             from dalle_tpu.swarm.allreduce import CHUNK_ELEMS
-            from dalle_tpu.swarm.audit import ProofVerifier
+            from dalle_tpu.swarm.audit import (EvidencePlane,
+                                               ProofVerifier)
+            # r20 evidence by reference: each peer serves its own
+            # over-budget proof bundles from its mailbox and fetches
+            # foreign ones by digest — small chunks and tight budgets
+            # so the fetch plane (multi-chunk streams, failover, the
+            # rejection taxonomy) runs for real at soak size
+            self.evidence_plane = EvidencePlane(
+                self.dht, prefix, budget_s=8.0, retries=2,
+                fetch_timeout=1.0, chunk_bytes=2048,
+                tracer=self.tracer)
             verifier = ProofVerifier(
                 prefix, frac=audit_policy.frac,
                 chunk_elems=CHUNK_ELEMS, codec=wire_codec,
                 screen=screen, max_peer_weight=max_peer_weight,
                 pinned=(wire_codec if wire_codec != compression.NONE
-                        else None))
+                        else None),
+                fetcher=self.evidence_plane)
         self.gossip = (StrikeGossip(self.dht, self.ledger, prefix,
                                     verifier=verifier)
                        if gossip else None)
+        if self.gossip is not None and self.evidence_plane is not None:
+            self.gossip.evidence_store = self.evidence_plane
         # round repair (r16): the audit's honest reconstruction patches
         # this peer's averaged vector BEFORE the state applies it (the
         # pre-step, bit-exact landing site); OFF keeps the r15
-        # detection-only bytes
+        # detection-only bytes. Since r20 the plane also accepts this
+        # peer's aux-phase prefixes, so factor/state convictions queue
+        # corrections for their own drain sites (never the gradient's).
         self.repair_plane = None
         if repair:
             from dalle_tpu.swarm.repair import RepairPlane
-            self.repair_plane = RepairPlane(accept_prefix=prefix)
+            accept = [prefix] + [f"{prefix}_{s}"
+                                 for s in (aux_rounds or [])]
+            self.repair_plane = RepairPlane(
+                accept_prefix=tuple(accept))
         # aux averaging phases (r16): suffixes of extra per-epoch
         # butterfly rounds this peer joins — "p" (the PowerSGD factor
         # stand-in) and "state" (state averaging), each audited under
-        # its own prefix; the averaged result is discarded (the rounds
-        # exist to exercise the per-phase audit + proof plane)
+        # its own prefix; since r20 a conviction there also REPAIRS
+        # the round's averaged bytes (the aux-repair oracle)
         self.aux_rounds = list(aux_rounds or [])
+        # r20 aux-repair oracle inputs: corrections applied to THIS
+        # peer's aux averages per suffix, and whether every repaired
+        # average landed bit-exact on the honest analytic reference
+        self.aux_repairs: Dict[str, int] = {}
+        self.aux_repair_clean: Dict[str, bool] = {}
         # first epoch each offender showed up in this ledger, split by
         # evidence plane (score = any; remote = gossiped receipts;
         # proof = verified-proof convictions) — the soaks' "struck
@@ -420,8 +449,16 @@ class SoakPeer:
                     try:
                         with obs_span(self.tracer, "swarm", "audit",
                                       trace):
-                            rep = audit_round(self.dht, ra, self.ledger,
-                                              repair=self.repair_plane)
+                            # evidence_limit=0: the by-reference plane
+                            # serves bundles of any size, so never
+                            # degrade the conviction to a capped
+                            # accusation for size alone
+                            rep = audit_round(
+                                self.dht, ra, self.ledger,
+                                repair=self.repair_plane,
+                                evidence_limit=(
+                                    0 if self.evidence_plane
+                                    is not None else None))
                         for cls, key in (("failed", "fail"),
                                          ("omitted", "omit"),
                                          ("unserved", "unserved")):
@@ -432,19 +469,22 @@ class SoakPeer:
                         self.errors.append(
                             f"audit at epoch {self.epoch}: {e!r}")
                 # aux averaging phases (PowerSGD factor stand-in +
-                # state averaging), each under its own audited prefix;
-                # results are discarded — the rounds exist so the
-                # per-phase audit and the proof-receipt plane run for
-                # real. No repair: corrections outside the gradient
-                # plane are detection-only by design.
+                # state averaging), each under its own audited prefix.
+                # Since r20 an aux conviction REPAIRS the round's own
+                # averaged bytes at its phase-scoped drain site — the
+                # bit-exactness is recorded for the aux-repair oracle.
                 for suffix in self.aux_rounds:
                     self._aux_round(suffix)
                 # round repair: drain the audit's corrections into the
                 # averaged vector BEFORE it reaches the state — the
-                # pre-step landing site, bit-exact by assignment
+                # pre-step landing site, bit-exact by assignment. The
+                # drain is prefix-scoped: an aux-phase correction must
+                # never land in the gradient vector (same element
+                # count here, so an unscoped drain WOULD corrupt).
                 if self.repair_plane is not None:
                     try:
-                        self.repair_plane.apply([averaged])
+                        self.repair_plane.apply([averaged],
+                                                prefix=self.prefix)
                     except Exception as e:  # noqa: BLE001 - degraded
                         self.errors.append(
                             f"repair at epoch {self.epoch}: {e!r}")
@@ -501,6 +541,8 @@ class SoakPeer:
                 # native node down while survivors may still be
                 # mid-conversation with it
                 self.server.stop()
+                if self.evidence_plane is not None:
+                    self.evidence_plane.stop()
                 self.node.shutdown()
             # survivors keep their StateServer up past the loop (a late
             # joiner must still find a server); finish() tears it down
@@ -530,8 +572,13 @@ class SoakPeer:
         announce there, so the pair forms a 2-member butterfly whose
         challenged owners serve transcripts like any round; a chaos
         plan's phase-scoped ``wrong_gather_part`` op fires at this
-        owner seam and nowhere else. Failures degrade (the aux round
-        is side-channel: the main state never touches it)."""
+        owner seam and nowhere else. Since r20 a conviction here also
+        REPAIRS: the phase-scoped correction is drained into this
+        round's own averaged bytes and pinned against the honest
+        analytic reference (both members contribute the same g with
+        weight 1.0, so the honest average IS g bit-exactly). Failures
+        degrade (the aux round is side-channel: the main state never
+        touches it)."""
         aux_prefix = f"{self.prefix}_{suffix}"
         ra = (RoundAudit(aux_prefix, self.epoch, self.audit_policy)
               if self.audit_policy is not None else None)
@@ -541,7 +588,7 @@ class SoakPeer:
                            min_group_size=2, ledger=self.ledger)
             if g is None or g.size <= 1:
                 return  # the partner is on another epoch: idle round
-            run_allreduce(
+            out = run_allreduce(
                 self.dht, g, aux_prefix, self.epoch,
                 [grads_for_epoch(self.epoch,
                                  full_scale=self.full_scale)],
@@ -551,13 +598,18 @@ class SoakPeer:
                 screen=self.screen,
                 max_peer_weight=self.max_peer_weight, audit=ra,
                 pin_codec=self.wire_codec != compression.NONE)
+            avg = out[0]
         except Exception as e:  # noqa: BLE001 - degraded aux round
             self.errors.append(
                 f"aux {suffix} at epoch {self.epoch}: {e!r}")
             return
         if ra is not None and ra.begun:
             try:
-                rep = audit_round(self.dht, ra, self.ledger)
+                rep = audit_round(self.dht, ra, self.ledger,
+                                  repair=self.repair_plane,
+                                  evidence_limit=(
+                                      0 if self.evidence_plane
+                                      is not None else None))
                 for cls, key in (("failed", "fail"),
                                  ("omitted", "omit"),
                                  ("unserved", "unserved")):
@@ -567,6 +619,26 @@ class SoakPeer:
             except Exception as e:  # noqa: BLE001 - degraded
                 self.errors.append(
                     f"aux {suffix} audit at epoch {self.epoch}: {e!r}")
+        # r20 aux repair: the conviction's correction lands in THIS
+        # round's averaged factors/state (the phase's own drain site),
+        # and must restore the honest bytes exactly
+        if (self.repair_plane is not None
+                and self.repair_plane.accepts(aux_prefix)
+                and self.repair_plane.pending(aux_prefix)):
+            try:
+                n = self.repair_plane.apply([avg], prefix=aux_prefix)
+            except Exception as e:  # noqa: BLE001 - degraded
+                self.errors.append(
+                    f"aux {suffix} repair at epoch {self.epoch}: {e!r}")
+                return
+            if n:
+                honest = grads_for_epoch(self.epoch,
+                                         full_scale=self.full_scale)
+                exact = avg.tobytes() == honest.tobytes()
+                self.aux_repairs[suffix] = \
+                    self.aux_repairs.get(suffix, 0) + n
+                self.aux_repair_clean[suffix] = \
+                    self.aux_repair_clean.get(suffix, True) and exact
 
     def finish(self) -> None:
         """Join the loop and tear down whatever the death path didn't."""
@@ -574,6 +646,8 @@ class SoakPeer:
                                      - time.monotonic()) + 30.0)
         if not self.died:
             self.server.stop()
+            if self.evidence_plane is not None:
+                self.evidence_plane.stop()
             self.node.shutdown()
 
     def result(self, killed: bool) -> Dict:
@@ -597,6 +671,17 @@ class SoakPeer:
                     "repairs": (self.repair_plane.snapshot()
                                 if self.repair_plane is not None
                                 else {}),
+                    "aux_repairs": dict(self.aux_repairs),
+                    "aux_repair_clean": dict(self.aux_repair_clean),
+                    "proof_fetch": (self.evidence_plane.counters()
+                                    if self.evidence_plane is not None
+                                    else {}),
+                    "proofs_by_reference": (
+                        self.gossip.proofs_by_reference
+                        if self.gossip is not None else 0),
+                    "proofs_rejected": (
+                        self.gossip.proofs_rejected
+                        if self.gossip is not None else 0),
                     "peer_id": self.node.peer_id,
                     "injected": dict(getattr(self.dht, "injected", {})),
                     # flight-ring excerpt (last rounds) — collected by
@@ -1004,10 +1089,99 @@ def build_hostile_schedule(seed: int, n_peers: int, epochs: int) -> dict:
             "aux": aux}
 
 
+def _poison_phase(peers: List[SoakPeer], attacker_idx: set,
+                  violations: List[str], tag: str) -> dict:
+    """Zero-ledger-effect oracle for hostile by-reference receipts:
+    after the pass's epoch loops finish (nodes still up), one honest
+    issuer publishes two REAL signed receipts against innocent fake
+    pids whose evidence descriptors are poisoned — one UNFETCHABLE
+    (the digest's chunks were never posted anywhere) and one FORGED
+    (chunks exist but hash to a different digest). Every other peer
+    folds them through the real gossip plane; the verifier's fetch
+    must fail closed: both receipts rejected, and NO ledger anywhere
+    gains either pid."""
+    import msgpack
+    honest = [p for i, p in enumerate(peers)
+              if i not in attacker_idx and p.gossip is not None
+              and p.evidence_plane is not None]
+    if len(honest) < 2:
+        return {"skipped": "no honest issuer/audience pair"}
+    issuer, audience = honest[0], honest[1:]
+    addr = issuer.node.visible_address
+    step = 2048
+    garbage = b"\x5b" * 4096
+    unfetch_digest = hashlib.sha256(
+        b"poison: chunks never posted").digest()
+    forged_digest = hashlib.sha256(
+        b"poison: chunks hash to something else").digest()
+    # the forged bundle's chunks really exist in the issuer's mailbox
+    # — only the digest in the descriptor lies about their content
+    issuer.evidence_plane._post_chunks(
+        forged_digest, [garbage[:step], garbage[step:]])
+    sentinels = {}
+    for mark, digest in ((b"\xa1", unfetch_digest),
+                         (b"\xa2", forged_digest)):
+        sentinels[mark * 4096] = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": digest,
+             "size": len(garbage), "n_chunks": 2, "chunk": step,
+             "addr": addr}, use_bin_type=True)
+
+    class _LyingStore:
+        """Evidence store that returns a pre-poisoned descriptor for
+        each sentinel evidence blob instead of honestly parking it."""
+
+        def publish(self, evidence, reserve=False):
+            return sentinels.get(bytes(evidence))
+
+    issuer.ledger.drain_events()  # leftovers must not hit the shim
+    issuer.gossip.evidence_store = _LyingStore()
+    # innocent pids must look like real peer ids (64-hex) or the fold
+    # drops the receipt before the verifier ever prices it
+    innocents = [
+        hashlib.sha256(f"poison-unfetchable-{tag}".encode()).hexdigest(),
+        hashlib.sha256(f"poison-forged-{tag}".encode()).hexdigest()]
+    issuer.ledger.requeue_events(
+        [(issuer.epoch, pid, "owner-audit-fail", ev)
+         for pid, ev in zip(innocents, sentinels)])
+    issuer.gossip.publish_once()
+    before = {p.name: p.gossip.proofs_rejected for p in audience}
+    poll_deadline = time.monotonic() + 30.0
+    while time.monotonic() < poll_deadline:
+        lagging = [p for p in audience
+                   if p.gossip.proofs_rejected - before[p.name] < 2]
+        if not lagging:
+            break
+        for p in lagging:
+            p.gossip.fold_once()
+        time.sleep(0.1)
+    rejected = {}
+    for p in audience:
+        delta = p.gossip.proofs_rejected - before[p.name]
+        rejected[p.name] = delta
+        if delta < 2:
+            violations.append(
+                f"[{tag}] {p.name} did not reject both poison "
+                f"receipts (rejected {delta}/2) — an unverifiable "
+                "by-reference proof was not failed closed")
+    ledger_hits = []
+    for p in peers:
+        for pid in innocents:
+            if pid in p.ledger.snapshot() \
+                    or p.ledger.proof_convictions(pid):
+                ledger_hits.append((p.name, pid))
+                violations.append(
+                    f"[{tag}] {p.name}'s ledger convicted innocent "
+                    f"{pid} from poisoned evidence — unfetchable/"
+                    "forged receipts must have NO ledger effect")
+    return {"issuer": issuer.name, "innocents": innocents,
+            "rejected": rejected, "ledger_hits": ledger_hits}
+
+
 def _hostile_pass(args, schedule: dict, attacks_on: bool,
                   audits_on: bool, violations: List[str],
                   tag: str, repair_on: bool = False,
-                  aux_on: bool = False) -> List[Dict]:
+                  aux_on: bool = False,
+                  poison_out: Optional[dict] = None) -> List[Dict]:
     """One full swarm run of the hostile-owner schedule. Every peer
     arms screen + clamp + gossip; ``audits_on`` additionally arms the
     verified-aggregation layer (frac=1.0 — every part challenged every
@@ -1054,17 +1228,34 @@ def _hostile_pass(args, schedule: dict, attacks_on: bool,
                  repair=repair_on and audits_on,
                  aux_rounds=aux_by_peer.get(i))
         for i, node in enumerate(nodes)]
-    for p in peers:
-        p.start()
-    while time.monotonic() < deadline:
-        if all(not p.thread.is_alive() for p in peers):
-            break
-        time.sleep(0.2)
-    for p in peers:
-        p.finish()
+    # r20 flagship forcing: shrink the inline proof cap for the pass
+    # so every conviction's evidence exceeds it and the receipt ships
+    # BY REFERENCE (the over-PROOF_MAX_BYTES path tier-1 must gate);
+    # restored in the finally so a pytest-driven fast soak cannot
+    # leak the shrunk cap into other tests in the same process
+    from dalle_tpu.swarm import health as health_mod
+    old_cap = health_mod.PROOF_MAX_BYTES
+    if audits_on and getattr(args, "proof_inline_max", 0):
+        health_mod.PROOF_MAX_BYTES = int(args.proof_inline_max)
+    try:
+        for p in peers:
+            p.start()
+        while time.monotonic() < deadline:
+            if all(not p.thread.is_alive() for p in peers):
+                break
+            time.sleep(0.2)
+        attacker_idx = {a["peer"] for a in schedule["attacks"]} \
+            if attacks_on else set()
+        if poison_out is not None and audits_on:
+            # nodes are still up (finish() has not run): the poison
+            # phase rides the real wire planes end to end
+            poison_out.update(_poison_phase(peers, attacker_idx,
+                                            violations, tag))
+        for p in peers:
+            p.finish()
+    finally:
+        health_mod.PROOF_MAX_BYTES = old_cap
     results = []
-    attacker_idx = {a["peer"] for a in schedule["attacks"]} \
-        if attacks_on else set()
     for i, p in enumerate(peers):
         r = p.result(killed=False)
         r["attacker"] = i in attacker_idx
@@ -1099,7 +1290,16 @@ def run_hostile(args) -> dict:
       averaging) are each convicted in every honest ledger via a
       proof-carrying receipt — peers outside those rounds hold ZERO
       local evidence at proof time (conviction with no local
-      corroboration);
+      corroboration). Since r20 the pass also gates the flagship
+      trust plane: the inline proof cap is forced tiny
+      (``--proof-inline-max``) so every receipt ships its evidence BY
+      REFERENCE — honest peers must publish by reference AND convict
+      from bundles they FETCHED (digest-checked, chunked, with
+      failover); the aux partner's conviction must REPAIR its
+      factor/state average bit-exactly onto the honest reference; and
+      a post-pass poison phase publishes an UNFETCHABLE and a FORGED
+      by-reference receipt against innocent pids — both must be
+      rejected by every folding peer with zero ledger effect;
     - **nofix** (attacks on, audits ON, repair OFF, aux off) — the
       r15 reference: detection without correction, so every honest
       member that gathered a wrong part DIVERGES from the analytic
@@ -1139,10 +1339,20 @@ def run_hostile(args) -> dict:
             violations.append(
                 f"[ctl] {r['name']} fingerprint {r['fingerprint']} != "
                 f"analytic {want} — audits/repair changed the bytes")
+        pf = r.get("proof_fetch") or {}
+        if (r.get("proofs_by_reference") or r.get("aux_repairs")
+                or any(pf.values())):
+            violations.append(
+                f"[ctl] {r['name']} touched the evidence/repair planes "
+                f"on an honest swarm: byref="
+                f"{r.get('proofs_by_reference')} fetch={pf} "
+                f"aux={r.get('aux_repairs')}")
 
+    poison: dict = {}
     attack = _hostile_pass(args, schedule, attacks_on=True,
                            audits_on=True, violations=violations,
-                           tag="atk", repair_on=True, aux_on=True)
+                           tag="atk", repair_on=True, aux_on=True,
+                           poison_out=poison)
     # -- attack oracles ----------------------------------------------------
     by_kind = {r["attack_kind"]: r for r in attack if r["attacker"]}
     wrong_pid = by_kind["wrong_gather_part"]["peer_id"]
@@ -1263,6 +1473,39 @@ def run_hostile(args) -> dict:
         violations.append(
             f"[atk] omitted victim {victim['name']} never convicted "
             f"the omitting owner within 2 epochs (first: {omitted})")
+    # -- r20 by-reference oracles: with the inline cap forced tiny,
+    # every conviction's evidence exceeds it — so every honest peer
+    # must have PUBLISHED at least one by-reference receipt (it
+    # convicts the wrong-part owner locally at frac=1.0) and FETCHED
+    # at least one foreign evidence bundle to convict on ------------------
+    if getattr(args, "proof_inline_max", 0):
+        for r in attack:
+            if r["attacker"]:
+                continue
+            if not r.get("proofs_by_reference"):
+                violations.append(
+                    f"[atk] {r['name']} never published a receipt by "
+                    f"reference with the inline cap forced to "
+                    f"{args.proof_inline_max} bytes")
+            if not r.get("proof_fetch", {}).get("ok"):
+                violations.append(
+                    f"[atk] {r['name']} never fetched a foreign "
+                    f"evidence bundle: {r.get('proof_fetch')}")
+    # -- r20 aux repair: the pair partner's conviction must have
+    # REPAIRED its factor/state average bit-exactly onto the honest
+    # reference (detection-only was the r19 residual) ---------------------
+    for suffix, pair in (schedule["aux"].items() if run_aux else ()):
+        partner = attack[pair["partner"]]
+        if not partner["aux_repairs"].get(suffix):
+            violations.append(
+                f"[atk] aux partner {partner['name']} convicted the "
+                f"{suffix}-phase owner but applied no aux repair: "
+                f"{partner['aux_repairs']}")
+        elif not partner["aux_repair_clean"].get(suffix):
+            violations.append(
+                f"[atk] aux partner {partner['name']}'s repaired "
+                f"{suffix} average is not bit-exact on the honest "
+                "reference")
 
     nofix = _hostile_pass(args, schedule, attacks_on=True,
                           audits_on=True, violations=violations,
@@ -1314,12 +1557,13 @@ def run_hostile(args) -> dict:
                        "allreduce_timeout": args.allreduce_timeout,
                        "deadline": args.deadline,
                        "wire_bits": args.wire_bits, "ef": args.ef,
-                       "pipeline": args.pipeline},
+                       "pipeline": args.pipeline,
+                       "proof_inline_max": args.proof_inline_max},
             "schedule": schedule,
             "elapsed_s": round(time.monotonic() - t0, 1),
             "artifacts": {"flight": flight_path},
             "control": control, "attack": attack, "nofix": nofix,
-            "transparency": transparency,
+            "transparency": transparency, "poison": poison,
             "violations": violations, "pass": not violations}
 
 
@@ -1374,6 +1618,13 @@ def main(argv=None) -> int:
                              "under out-of-order part completion")
     parser.add_argument("--no-pipeline", dest="pipeline",
                         action="store_false")
+    parser.add_argument("--proof-inline-max", type=int, default=512,
+                        help="hostile mode only: forced inline proof "
+                             "cap in bytes — every conviction's "
+                             "evidence exceeds it, so receipts ship "
+                             "BY REFERENCE (the flagship "
+                             "over-PROOF_MAX_BYTES path); 0 keeps "
+                             "the production 4 MiB cap")
     parser.add_argument("--inject-oracle-failure", action="store_true",
                         help="TESTING the failure-dump path: peer0 "
                              "corrupts its final apply so the "

@@ -289,6 +289,68 @@ MUTATIONS: List[Mutation] = [
             "is BEFORE the join, so the post-join exemption must not "
             "swallow it)",
     ),
+    Mutation(
+        name="evidence-fetch-completion-lock-dropped",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/swarm/audit.py",
+        anchor="            with self._cv:\n"
+               "                job[\"blob\"] = blob\n"
+               "                job[\"done\"] = True\n"
+               "                self._inflight.pop(digest, None)\n"
+               "                if blob is not None:\n"
+               "                    self.fetch_ok += 1\n"
+               "                    self.fetch_bytes += len(blob)\n"
+               "                    if job.get(\"failover\"):\n"
+               "                        self.fetch_failover += 1\n"
+               "                    self._retain_locked(digest, blob)\n"
+               "                else:\n"
+               "                    self.fetch_failed += 1\n"
+               "                self._cv.notify_all()",
+        replacement="            job[\"blob\"] = blob\n"
+                    "            job[\"done\"] = True\n"
+                    "            self._inflight.pop(digest, None)\n"
+                    "            if blob is not None:\n"
+                    "                self.fetch_ok += 1\n"
+                    "                self.fetch_bytes += len(blob)\n"
+                    "                if job.get(\"failover\"):\n"
+                    "                    self.fetch_failover += 1\n"
+                    "                self._retain_locked(digest, blob)\n"
+                    "            else:\n"
+                    "                self.fetch_failed += 1",
+        why="the r20 evidence fetch worker lands a finished job — "
+            "blob, done flag, in-flight-table pop, counters, retained-"
+            "bundle insert — under _cv, while verifier threads "
+            "cv-wait on the same job dict in fetch() and counters() "
+            "snapshots the totals; dropping the worker-side lock "
+            "races the completion against the waiter's bounded wait "
+            "(a fetch could time out AND return the blob) and tears "
+            "the counter snapshot",
+    ),
+    Mutation(
+        name="evidence-plane-field-init-moved",
+        rule="shared-write-unlocked",
+        path="dalle_tpu/swarm/audit.py",
+        anchor="        self._refresh_due = time.monotonic() "
+               "+ self.serve_ttl / 4\n"
+               "        self._thread = threading.Thread("
+               "target=self._run, daemon=True,\n"
+               "                                        "
+               "name=\"evidence-fetch\")\n"
+               "        self._thread.start()",
+        replacement="        self._thread = threading.Thread("
+                    "target=self._run, daemon=True,\n"
+                    "                                        "
+                    "name=\"evidence-fetch\")\n"
+                    "        self._thread.start()\n"
+                    "        self._refresh_due = time.monotonic() "
+                    "+ self.serve_ttl / 4",
+        why="the evidence plane's worker is started LAST in __init__ "
+            "so every field init happens-before its first read; "
+            "moving the serve-refresh deadline init after "
+            "Thread.start() races the worker's idle-loop read of "
+            "_refresh_due (under _cv) against an unlocked post-start "
+            "write — the init-before-start seed no longer covers it",
+    ),
 ]
 
 

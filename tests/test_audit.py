@@ -917,6 +917,26 @@ class TestHostileOwnerSoak:
         assert len(honest) == 3
         # convicted ⇒ corrected: every honest member repaired
         assert all(r["repairs"]["applied"] >= 1 for r in honest)
+        # r20: with the inline cap forced tiny, every honest peer
+        # published its conviction evidence BY REFERENCE and convicted
+        # on bundles it FETCHED (digest-checked) from other mailboxes
+        assert report["params"]["proof_inline_max"] == 512
+        assert all(r["proofs_by_reference"] >= 1 for r in honest)
+        assert all(r["proof_fetch"]["ok"] >= 1 for r in honest)
+        # r20: the aux pair partners repaired their factor/state
+        # averages bit-exactly onto the honest reference
+        aux = report["schedule"]["aux"]
+        by_index = {r["name"]: r for r in report["attack"]}
+        for suffix, pair in aux.items():
+            partner = by_index[f"peer{pair['partner']}"]
+            assert partner["aux_repairs"].get(suffix, 0) >= 1
+            assert partner["aux_repair_clean"].get(suffix) is True
+        # r20: the poison phase ran and every audience peer rejected
+        # both the unfetchable and the forged by-reference receipt
+        assert report["poison"].get("issuer")
+        assert report["poison"]["ledger_hits"] == []
+        assert all(v >= 2
+                   for v in report["poison"]["rejected"].values())
         # and the nofix pass reproduces the r15 divergence the repair
         # closes (honest fingerprints differ from the attack pass's)
         nofix_honest = [r for r in report["nofix"] if not r["attacker"]]

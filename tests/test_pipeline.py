@@ -283,6 +283,73 @@ class TestPipelinedRound:
                 nd.shutdown()
 
 
+# -- r20 deterministic pipelined reduction ---------------------------------
+
+class TestDeterministicPipelinedReduction:
+    """r20: the gather drain lands contributions in arrival order, but
+    the owner folds them at the round seam in roster-index order — so a
+    pipelined round's bytes are a pure function of (roster, inputs,
+    codec), reproducible across independent runs, and the transcript's
+    recorded applied order is roster-derived by construction."""
+
+    def _one_run(self, base, prefix, *, pipelined, ras=None,
+                 ledgers=None):
+        nodes = _det_swarm(3, base=base)
+        try:
+            # float wire (NONE codec): f32 accumulation is genuinely
+            # order-SENSITIVE here, unlike the integer-exact u4 setup
+            # above — arrival-order folding would make two runs of the
+            # same schedule disagree whenever the drain reorders
+            tensors = _tensors(3, size=9000, seed=13)
+            res, reps = _round(nodes, prefix, 0, tensors,
+                               pipelined=pipelined,
+                               codec=compression.NONE, ras=ras,
+                               ledgers=ledgers)
+            assert all(r["complete"] for r in reps)
+            return [flatten_tensors(r).tobytes() for r in res]
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+    def test_two_independent_runs_bit_identical(self):
+        """Same identities, same inputs, fresh swarm each time: every
+        member's pipelined round bytes match across the two runs (and
+        across members within a run)."""
+        run_a = self._one_run(191, "det-a", pipelined=True)
+        run_b = self._one_run(191, "det-b", pipelined=True)
+        assert len(set(run_a)) == 1  # members agree within a run
+        assert run_a == run_b        # and across runs, bit-exactly
+
+    def test_pipelined_float_round_replays_clean(self):
+        """The roster-order fold is what the transcript records: a
+        frac=1.0 audit of a float-codec PIPELINED round replays every
+        honest owner bit-exactly (the recorded-order contract, now a
+        roster-pinned invariant)."""
+        policy = AuditPolicy(frac=1.0, fetch_timeout=2.0)
+        ras = [RoundAudit("det-r", 0, policy) for _ in range(3)]
+        ledgers = [PeerHealthLedger() for _ in range(3)]
+        nodes = _det_swarm(3, base=201)
+        try:
+            tensors = _tensors(3, size=9000, seed=13)
+            _res, reps = _round(nodes, "det-r", 0, tensors,
+                                pipelined=True, codec=compression.NONE,
+                                ras=ras, ledgers=ledgers)
+            assert all(r["complete"] for r in reps)
+            for i in range(3):
+                rep = audit_round(nodes[i], ras[i], ledgers[i])
+                assert rep["audited"], (i, rep)
+                assert not rep["failed"] and not rep["unserved"] \
+                    and not rep["omitted"], (i, rep)
+                assert ledgers[i].snapshot() == {}
+                # the applied order the transcript recorded is the
+                # roster order — pinned, not incidental
+                assert ras[i].order == sorted(ras[i].order), \
+                    (i, ras[i].order)
+        finally:
+            for nd in nodes:
+                nd.shutdown()
+
+
 # -- observability: hop rows + spans ---------------------------------------
 
 class TestHopObservability:

@@ -556,3 +556,251 @@ class TestChallengeUnchanged:
         b = challenged_parts("run_grads_p", 5, 64, 0.3)
         c = challenged_parts("run_state", 5, 64, 0.3)
         assert a != b or b != c
+
+
+# -- r20 evidence by reference: fetch plane + rejection taxonomy -----------
+
+class TestByReferenceEvidence:
+    """The r20 by-reference proof plane: oversize evidence rides the
+    receipt as a digest + mailbox descriptor, verifiers fetch the
+    chunked bundle (budgeted, hash-checked, failover-capable) and
+    replay it under the unchanged all-or-nothing predicate. Every
+    fetch-plane failure below is a REJECTION with zero ledger effect —
+    the attacker-writable descriptor can waste a bounded fetch budget,
+    never a ledger entry."""
+
+    def _plane(self, node, **kw):
+        from dalle_tpu.swarm.audit import EvidencePlane
+        args = dict(budget_s=6.0, retries=2, fetch_timeout=1.0,
+                    chunk_bytes=4096)
+        args.update(kw)
+        return EvidencePlane(node, "rp", **args)
+
+    def _fold_desc(self, screen, desc, peer, fetcher, epoch=0):
+        """Fold one receipt whose proof is a by-ref descriptor."""
+        receipt = make_receipt(Identity.generate(), "rp", peer,
+                               "owner-audit-fail", epoch, proof=desc)
+        led = PeerHealthLedger()
+        gossip = StrikeGossip(
+            types.SimpleNamespace(
+                peer_id="cc" * 32, identity=Identity.generate(),
+                get=lambda key, latest=True: {
+                    "s": types.SimpleNamespace(value=receipt)}),
+            led, "rp", verifier=_verifier(screen, fetcher=fetcher))
+        gossip.fold_once()
+        return led, gossip
+
+    def test_descriptor_validation_is_strict(self):
+        from dalle_tpu.swarm.audit import parse_evidence_ref
+        good = {"digest": b"\x07" * 32, "size": 5000, "n_chunks": 2,
+                "chunk": 4096, "addr": "addr1"}
+        assert parse_evidence_ref(good, 1 << 20) is not None
+        bad = [
+            dict(good, digest=b"\x07" * 31),        # wrong digest len
+            dict(good, size=0),                     # empty claim
+            dict(good, size=(1 << 20) + 1),         # over fetch budget
+            dict(good, chunk=512),                  # sub-floor chunk
+            dict(good, n_chunks=3),                 # chunking mismatch
+            dict(good, addr="a" * 300),             # oversized addr
+            {},                                     # missing fields
+        ]
+        for b in bad:
+            assert parse_evidence_ref(b, 1 << 20) is None
+
+    def test_over_budget_conviction_end_to_end(self, wrong_owner_round,
+                                               monkeypatch):
+        """Issuer parks over-cap evidence by reference; an outsider
+        with ZERO local evidence fetches, replays and convicts; the
+        conviction re-serves the bundle for failover."""
+        import dalle_tpu.swarm.health as health_mod
+        from dalle_tpu.swarm.audit import evidence_servers_key
+        nodes, pids, bad_i, _o, ras, ledgers, screen, _t = \
+            wrong_owner_round
+        epoch, peer, reason, evidence = _evidence_from(
+            ras, ledgers, nodes, 4, bad_i, pids)
+        # shrink the inline cap so THIS real evidence counts as
+        # oversize (a >4MiB round would dwarf the test substrate)
+        monkeypatch.setattr(health_mod, "PROOF_MAX_BYTES", 1000)
+        assert len(evidence) > 1000
+        issuer_led = PeerHealthLedger()
+        issuer_led.requeue_events([(epoch, peer, reason, evidence)])
+        store = self._plane(nodes[4])
+        fetcher = self._plane(nodes[0])
+        try:
+            issuer = StrikeGossip(nodes[4], issuer_led, "rp")
+            issuer.evidence_store = store
+            assert issuer.publish_once() == 1
+            assert issuer.proofs_by_reference == 1
+            assert store.counters()["published"] == 1
+            outsider = PeerHealthLedger()
+            fold = StrikeGossip(nodes[0], outsider, "rp",
+                                verifier=_verifier(screen,
+                                                   fetcher=fetcher))
+            assert fold.fold_once() >= 1
+            assert fold.proofs_convicted == 1
+            assert outsider.penalized(peer) is True
+            c = fetcher.counters()
+            assert c["ok"] == 1 and c["bytes"] == len(evidence)
+            # conviction re-serves: the verifier re-published the
+            # bundle and advertised itself for failover
+            assert c["reserved"] == 1
+            ads = nodes[0].get(evidence_servers_key("rp")) or {}
+            import hashlib
+            dg = hashlib.sha256(evidence).hexdigest()
+            movers = [k for k in ads
+                      if (k.decode() if isinstance(k, bytes)
+                          else str(k)).startswith(dg + ".")]
+            assert len(movers) >= 2  # issuer + re-server
+        finally:
+            store.stop()
+            fetcher.stop()
+
+    def test_digest_mismatch_rejected(self, wrong_owner_round):
+        """Served bytes that do not hash to the descriptor digest are
+        discarded before any caller sees them."""
+        import time as _time
+        from dalle_tpu.swarm.audit import _TCHDR, _evidence_tag
+        nodes, pids, bad_i, _o, _ras, _led, screen, _t = \
+            wrong_owner_round
+        blob = b"not the evidence" * 200
+        wrong_digest = bytes(32)  # hashes to nothing served
+        step = 1024
+        pieces = [blob[o:o + step] for o in range(0, len(blob), step)]
+        exp = _time.time() + 60
+        for ci, piece in enumerate(pieces):
+            nodes[4].post(_evidence_tag(wrong_digest, ci),
+                          _TCHDR.pack(ci, len(pieces)) + piece, exp)
+        import msgpack
+        desc = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": wrong_digest,
+             "size": len(blob), "n_chunks": len(pieces), "chunk": step,
+             "addr": nodes[4].visible_address}, use_bin_type=True)
+        fetcher = self._plane(nodes[0])
+        try:
+            led, gossip = self._fold_desc(screen, desc, pids[bad_i],
+                                          fetcher)
+            assert gossip.proofs_rejected == 1
+            assert led.snapshot() == {}
+            assert fetcher.counters()["failed"] == 1
+        finally:
+            fetcher.stop()
+
+    def test_truncated_chunk_stream_rejected(self, wrong_owner_round):
+        """Chunks all arrive but sum short of the claimed size."""
+        import time as _time
+        from dalle_tpu.swarm.audit import _TCHDR, _evidence_tag
+        nodes, pids, bad_i, _o, _ras, _led, screen, _t = \
+            wrong_owner_round
+        digest = b"\x11" * 32
+        exp = _time.time() + 60
+        for ci in range(2):
+            nodes[4].post(_evidence_tag(digest, ci),
+                          _TCHDR.pack(ci, 2) + b"q" * 512, exp)
+        import msgpack
+        desc = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": digest, "size": 4096,
+             "n_chunks": 2, "chunk": 2048,
+             "addr": nodes[4].visible_address}, use_bin_type=True)
+        fetcher = self._plane(nodes[0])
+        try:
+            led, gossip = self._fold_desc(screen, desc, pids[bad_i],
+                                          fetcher)
+            assert gossip.proofs_rejected == 1
+            assert led.snapshot() == {}
+        finally:
+            fetcher.stop()
+
+    def test_oversize_claim_rejected_before_any_io(self,
+                                                   wrong_owner_round):
+        """A descriptor claiming more than the fetch byte budget dies
+        at validation — no allocation, no wire traffic."""
+        nodes, pids, bad_i, _o, _ras, _led, screen, _t = \
+            wrong_owner_round
+        import msgpack
+        desc = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": b"\x22" * 32,
+             "size": (1 << 20) + 1, "n_chunks": 257, "chunk": 4096,
+             "addr": nodes[4].visible_address}, use_bin_type=True)
+        fetcher = self._plane(nodes[0], max_bytes=1 << 20)
+        try:
+            led, gossip = self._fold_desc(screen, desc, pids[bad_i],
+                                          fetcher)
+            assert gossip.proofs_rejected == 1
+            assert led.snapshot() == {}
+            assert fetcher.counters()["attempted"] == 0
+        finally:
+            fetcher.stop()
+
+    def test_unfetchable_within_budget_rejected(self,
+                                                wrong_owner_round):
+        """Nothing serves the digest: the fetch burns its bounded
+        budget and the receipt folds to nothing."""
+        nodes, pids, bad_i, _o, _ras, _led, screen, _t = \
+            wrong_owner_round
+        import msgpack
+        desc = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": b"\x33" * 32, "size": 2048,
+             "n_chunks": 1, "chunk": 2048,
+             "addr": nodes[4].visible_address}, use_bin_type=True)
+        fetcher = self._plane(nodes[0], budget_s=3.0, retries=1,
+                              fetch_timeout=0.3)
+        try:
+            led, gossip = self._fold_desc(screen, desc, pids[bad_i],
+                                          fetcher)
+            assert gossip.proofs_rejected == 1
+            assert led.snapshot() == {}
+            c = fetcher.counters()
+            assert c["attempted"] == 1
+            assert c["failed"] + c["timeouts"] >= 1
+        finally:
+            fetcher.stop()
+
+    def test_wrong_mailbox_reference_rejected(self, wrong_owner_round):
+        """Chunks live on one peer, the descriptor names another (and
+        nothing advertises the digest): no failover path exists."""
+        import time as _time
+        from dalle_tpu.swarm.audit import _TCHDR, _evidence_tag
+        nodes, pids, bad_i, _o, _ras, _led, screen, _t = \
+            wrong_owner_round
+        blob = b"parked elsewhere" * 64
+        import hashlib
+        digest = hashlib.sha256(blob).digest()
+        nodes[4].post(_evidence_tag(digest, 0),
+                      _TCHDR.pack(0, 1) + blob, _time.time() + 60)
+        import msgpack
+        desc = msgpack.packb(
+            {"v": 2, "byref": 1, "digest": digest, "size": len(blob),
+             "n_chunks": 1, "chunk": 4096,
+             "addr": nodes[3].visible_address},  # wrong mailbox
+            use_bin_type=True)
+        fetcher = self._plane(nodes[0], budget_s=3.0, retries=1,
+                              fetch_timeout=0.3)
+        try:
+            led, gossip = self._fold_desc(screen, desc, pids[bad_i],
+                                          fetcher)
+            assert gossip.proofs_rejected == 1
+            assert led.snapshot() == {}
+        finally:
+            fetcher.stop()
+
+    def test_failover_to_advertised_server(self, wrong_owner_round):
+        """A dead issuer address fails over to a peer that advertised
+        the digest under the evsrv key."""
+        nodes, _pids, _b, _o, _ras, _led, _screen, _t = \
+            wrong_owner_round
+        bundle = b"survivable evidence" * 100
+        server = self._plane(nodes[4])
+        fetcher = self._plane(nodes[1])
+        try:
+            import msgpack
+            desc = msgpack.unpackb(server.publish(bundle), raw=False)
+            desc["addr"] = nodes[3].visible_address  # serves nothing
+            from dalle_tpu.swarm.audit import parse_evidence_ref
+            ref = parse_evidence_ref(desc, 1 << 30)
+            assert ref is not None
+            got = fetcher.fetch(ref)
+            assert got == bundle
+            assert fetcher.counters()["failover"] == 1
+        finally:
+            server.stop()
+            fetcher.stop()
